@@ -1,0 +1,11 @@
+"""Test harness: force JAX onto an 8-device virtual CPU mesh so multi-chip
+sharding logic runs without TPU quota (SURVEY.md §4 test strategy)."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,60 @@
+import pytest
+
+from tritonk8ssupervisor_tpu.config import catalog
+
+
+def test_all_generations_present():
+    assert set(catalog.ACCELERATORS) == {"v4", "v5e", "v5p", "v6e"}
+
+
+def test_accelerator_type_names():
+    # v5e/v6e count chips; v4/v5p count TensorCores (2 per chip)
+    assert catalog.accelerator_type_name("v5e", "4x4") == "v5litepod-16"
+    assert catalog.accelerator_type_name("v6e", "2x4") == "v6e-8"
+    assert catalog.accelerator_type_name("v4", "2x2x1") == "v4-8"
+    assert catalog.accelerator_type_name("v5p", "2x2x2") == "v5p-16"
+
+
+def test_invalid_topology_for_generation():
+    with pytest.raises(ValueError, match="not a valid v5e slice"):
+        catalog.accelerator_type_name("v5e", "3x3")
+    with pytest.raises(ValueError, match="not a valid v4 slice"):
+        catalog.accelerator_type_name("v4", "4x4")  # v4 is 3D
+
+
+def test_unknown_generation():
+    with pytest.raises(ValueError, match="unknown TPU generation"):
+        catalog.get_spec("v99")
+
+
+def test_host_packing_v5e():
+    spec = catalog.get_spec("v5e")
+    assert spec.hosts(spec.topology("2x2")) == 1  # 4 chips, single host
+    assert spec.hosts(spec.topology("4x4")) == 2  # 16 chips over 8-chip hosts
+    assert spec.hosts(spec.topology("16x16")) == 32
+    assert spec.chips_on_host(spec.topology("2x2")) == 4
+    assert spec.chips_on_host(spec.topology("4x4")) == 8
+
+
+def test_host_packing_v4():
+    spec = catalog.get_spec("v4")
+    assert spec.hosts(spec.topology("2x2x2")) == 2  # 8 chips, 4/host
+
+
+def test_topology_dims_match_ndim():
+    for spec in catalog.ACCELERATORS.values():
+        for t in spec.topologies:
+            assert spec.topology(t).ndim == spec.topology_ndim
+            assert spec.topology(t).chips <= spec.max_chips
+
+
+def test_every_slice_has_a_machine_type():
+    # every valid topology must map to a GKE machine type
+    from tritonk8ssupervisor_tpu.config.schema import ClusterConfig
+
+    for gen, spec in catalog.ACCELERATORS.items():
+        for t in spec.topologies:
+            cfg = ClusterConfig(
+                project="p", zone=spec.zones[0], generation=gen, topology=t
+            )
+            assert cfg.gke_machine_type.startswith("ct")

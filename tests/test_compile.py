@@ -1,0 +1,91 @@
+import json
+
+import yaml
+
+from tritonk8ssupervisor_tpu.config import compile as cc
+from tritonk8ssupervisor_tpu.config.schema import ClusterConfig
+
+
+def cfg(**overrides):
+    base = dict(project="my-proj", zone="us-west4-a", generation="v5e", topology="4x4")
+    base.update(overrides)
+    return ClusterConfig(**base)
+
+
+def test_tfvars_tpu_vm():
+    tf = cc.to_tfvars(cfg(mode="tpu-vm"))
+    assert tf["accelerator_type"] == "v5litepod-16"
+    assert tf["runtime_version"] == "v2-alpha-tpuv5-lite"
+    assert tf["num_slices"] == 1
+    assert "cluster_name" not in tf
+
+
+def test_tfvars_gke():
+    tf = cc.to_tfvars(cfg(mode="gke", num_slices=2))
+    assert tf["machine_type"] == "ct5lp-hightpu-8t"
+    assert tf["tpu_topology"] == "4x4"
+    assert tf["nodes_per_slice"] == 2
+    assert tf["num_slices"] == 2
+
+
+def test_write_tfvars(tmp_path):
+    path = cc.write_tfvars(cfg(mode="gke"), tmp_path)
+    assert path == tmp_path / "gke" / "terraform.tfvars.json"
+    data = json.loads(path.read_text())
+    assert data["project"] == "my-proj"
+
+
+def test_inventory():
+    inv = cc.to_inventory(cfg(), ["10.0.0.1", "10.0.0.2"])
+    assert "[TPUHOST]" in inv
+    assert "10.0.0.1\n10.0.0.2" in inv
+    assert "ansible_user=root" in inv
+
+
+def test_ansible_vars():
+    v = cc.to_ansible_vars(cfg(), coordinator_ip="10.0.0.1")
+    assert v["coordinator"] == "10.0.0.1"
+    assert v["expected_devices_per_host"] == 8
+    assert v["hosts_per_slice"] == 2
+    assert v["accelerator_type"] == "v5litepod-16"
+
+
+def test_write_ansible_configs(tmp_path):
+    cc.write_ansible_configs(cfg(), ["10.0.0.1"], tmp_path, coordinator_ip="10.0.0.1")
+    assert (tmp_path / "hosts").exists()
+    vars_yml = yaml.safe_load(
+        (tmp_path / "roles" / "tpuhost" / "vars" / "vars.yml").read_text()
+    )
+    assert vars_yml["coordinator"] == "10.0.0.1"
+
+
+def test_benchmark_job_spans_slice_hosts():
+    job = cc.to_benchmark_job(cfg())
+    spec = job["spec"]
+    assert spec["completions"] == 2 and spec["parallelism"] == 2
+    assert spec["completionMode"] == "Indexed"
+    pod = spec["template"]["spec"]
+    [container] = pod["containers"]
+    assert container["resources"]["limits"]["google.com/tpu"] == "8"
+    assert pod["nodeSelector"]["cloud.google.com/gke-tpu-accelerator"] == "tpu-v5-lite-podslice"
+    assert pod["nodeSelector"]["cloud.google.com/gke-tpu-topology"] == "4x4"
+    env = {e["name"]: e for e in container["env"]}
+    assert env["JAX_NUM_PROCESSES"]["value"] == "2"
+    assert "job-completion-index" in str(env["JAX_PROCESS_ID"])
+
+
+def test_single_host_job():
+    job = cc.to_benchmark_job(cfg(topology="2x2"))
+    assert job["spec"]["completions"] == 1
+    [container] = job["spec"]["template"]["spec"]["containers"]
+    assert container["resources"]["limits"]["google.com/tpu"] == "4"
+
+
+def test_write_manifests_multi_slice(tmp_path):
+    paths = cc.write_manifests(cfg(num_slices=2), tmp_path)
+    names = sorted(p.name for p in paths)
+    assert names == ["bench-job-0.yaml", "bench-job-1.yaml", "bench-service.yaml"]
+    job0 = yaml.safe_load((tmp_path / "bench-job-0.yaml").read_text())
+    assert job0["metadata"]["name"] == "resnet50-bench-0"
+    svc = yaml.safe_load((tmp_path / "bench-service.yaml").read_text())
+    assert svc["spec"]["clusterIP"] == "None"

@@ -1,0 +1,77 @@
+import pytest
+
+from tritonk8ssupervisor_tpu.config.schema import ClusterConfig, ConfigError
+
+
+def good_config(**overrides):
+    base = dict(project="my-proj", zone="us-west4-a", generation="v5e", topology="4x4")
+    base.update(overrides)
+    return ClusterConfig(**base)
+
+
+def test_valid_config_passes():
+    good_config().validate()
+
+
+def test_derived_properties():
+    cfg = good_config()
+    assert cfg.accelerator_type == "v5litepod-16"
+    assert cfg.chips_per_slice == 16
+    assert cfg.hosts_per_slice == 2
+    assert cfg.region == "us-west4"
+    assert cfg.effective_runtime_version == "v2-alpha-tpuv5-lite"
+    assert cfg.gke_machine_type == "ct5lp-hightpu-8t"
+
+
+def test_runtime_override():
+    assert good_config(runtime_version="custom").effective_runtime_version == "custom"
+
+
+def test_missing_project():
+    with pytest.raises(ConfigError, match="project is required"):
+        good_config(project="").validate()
+
+
+def test_bad_mode():
+    with pytest.raises(ConfigError, match="mode must be one of"):
+        good_config(mode="bare-metal").validate()
+
+
+def test_bad_cluster_name():
+    # reference enforced ^[a-zA-Z][0-9a-zA-Z]+$ on hostnames (setup.sh:276);
+    # GCP names must additionally be lowercase
+    with pytest.raises(ConfigError, match="cluster_name"):
+        good_config(cluster_name="Bad_Name").validate()
+
+
+@pytest.mark.parametrize("n", [0, 10, -1])
+def test_slice_count_limits(n):
+    # same 1-9 guard-rail as the reference node count (setup.sh:297-307)
+    with pytest.raises(ConfigError, match="num_slices"):
+        good_config(num_slices=n).validate()
+
+
+def test_zone_capacity_check():
+    with pytest.raises(ConfigError, match="no v5e capacity"):
+        good_config(zone="us-central2-b").validate()
+
+
+def test_errors_are_batched():
+    with pytest.raises(ConfigError) as ei:
+        ClusterConfig(project="", zone="", cluster_name="X", num_slices=0).validate()
+    msg = str(ei.value)
+    for fragment in ("project", "cluster_name", "num_slices", "zone is required"):
+        assert fragment in msg
+
+
+def test_flat_round_trip():
+    cfg = good_config(num_slices=3, env_name="my env")
+    flat = cfg.to_flat()
+    assert flat["NUM_SLICES"] == "3"
+    restored = ClusterConfig.from_flat(flat)
+    assert restored == cfg
+
+
+def test_from_flat_ignores_unknown_keys():
+    cfg = ClusterConfig.from_flat({"PROJECT": "p", "SDC_URL": "legacy"})
+    assert cfg.project == "p"

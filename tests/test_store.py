@@ -1,0 +1,30 @@
+from tritonk8ssupervisor_tpu.config.schema import ClusterConfig
+from tritonk8ssupervisor_tpu.config.store import (
+    export_to_env,
+    load_config_file,
+    save_config_file,
+)
+
+
+def test_save_load_round_trip(tmp_path):
+    cfg = ClusterConfig(project="p", zone="us-west4-a", num_slices=2)
+    path = tmp_path / "config"
+    save_config_file(cfg, path)
+    assert load_config_file(path) == cfg
+
+
+def test_load_skips_comments_and_blanks(tmp_path):
+    path = tmp_path / "config"
+    path.write_text("# a comment\n\nPROJECT=p\nZONE=us-west4-a\nnot a kv line\n")
+    cfg = load_config_file(path)
+    assert cfg.project == "p"
+    assert cfg.zone == "us-west4-a"
+
+
+def test_export_to_env():
+    cfg = ClusterConfig(project="p", zone="z")
+    env: dict = {}
+    export_to_env(cfg, env)
+    assert env["PROJECT"] == "p"
+    assert env["ZONE"] == "z"
+    assert env["NUM_SLICES"] == "1"

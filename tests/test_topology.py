@@ -1,0 +1,35 @@
+import pytest
+
+from tritonk8ssupervisor_tpu.utils.topology import Topology, hosts_for, parse_topology
+
+
+def test_parse_2d():
+    topo = parse_topology("4x4")
+    assert topo.dims == (4, 4)
+    assert topo.chips == 16
+    assert topo.ndim == 2
+    assert str(topo) == "4x4"
+
+
+def test_parse_3d():
+    topo = parse_topology("2x2x4")
+    assert topo.dims == (2, 2, 4)
+    assert topo.chips == 16
+    assert topo.ndim == 3
+
+
+@pytest.mark.parametrize("bad", ["", "4", "4x", "x4", "4x4x4x4", "ax4", "0x4", "-1x2"])
+def test_parse_rejects(bad):
+    with pytest.raises(ValueError):
+        parse_topology(bad)
+
+
+def test_parse_strips_whitespace():
+    assert parse_topology(" 2x2 ") == Topology((2, 2))
+
+
+@pytest.mark.parametrize(
+    "chips,per_host,hosts", [(4, 8, 1), (8, 8, 1), (16, 8, 2), (16, 4, 4), (1, 8, 1)]
+)
+def test_hosts_for(chips, per_host, hosts):
+    assert hosts_for(chips, per_host) == hosts

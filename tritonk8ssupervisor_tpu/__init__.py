@@ -1,0 +1,21 @@
+"""TPU-native cluster-provisioning framework.
+
+A ground-up rebuild of the capabilities of cheapRoc/tritonK8ssupervisor
+(reference: /root/reference/setup.sh and friends) for Google Cloud TPU:
+an interactive wizard that provisions TPU VMs / GKE TPU node pools with
+Terraform, configures hosts (libtpu + JAX) with Ansible, wires the GKE TPU
+device plugin, gates on readiness, runs a JAX ResNet-50 benchmark as a K8s
+Job, and tears everything down with one command.
+
+Layer map (mirrors SURVEY.md §1):
+  L0 CLI/UX           -> tritonk8ssupervisor_tpu.cli        (reference setup.sh:8-92)
+  L1 Config & state   -> tritonk8ssupervisor_tpu.config     (reference setup.sh:199-254,543-549)
+  L2 Infra (Terraform)-> terraform/ + infra.terraform       (reference terraform/{master,host})
+  L3 Host config      -> ansible/roles/tpuhost + infra.ansible (reference roles/dockersetup)
+  L4 Control plane    -> ansible/roles/gkejoin, manifests/  (reference roles/ranchermaster+rancherhost)
+  L5 Readiness        -> infra.readiness                    (reference setup.sh:59-85)
+  L6 Workloads        -> models/, parallel/, ops/, benchmarks (reference docs/detailed.md:255-371)
+  L7 Docs             -> docs/
+"""
+
+__version__ = "0.1.0"

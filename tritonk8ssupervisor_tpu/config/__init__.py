@@ -1,0 +1,15 @@
+from tritonk8ssupervisor_tpu.config.catalog import (  # noqa: F401
+    ACCELERATORS,
+    AcceleratorSpec,
+    accelerator_type_name,
+    get_spec,
+)
+from tritonk8ssupervisor_tpu.config.schema import (  # noqa: F401
+    ClusterConfig,
+    ConfigError,
+)
+from tritonk8ssupervisor_tpu.config.store import (  # noqa: F401
+    export_to_env,
+    load_config_file,
+    save_config_file,
+)

@@ -1,0 +1,228 @@
+"""Compile the validated ClusterConfig into tool-specific artifacts.
+
+The reference did this with string concatenation: generated HCL
+(updateTerraformConfig, setup.sh:162-198), an Ansible inventory + vars.yml
+(createAnsibleConfigs, setup.sh:116-137), and a sed-patched ansible.cfg
+(setup.sh:133). We generate *data* instead of code: a terraform.tfvars.json
+consumed by static, reviewable Terraform modules (no HCL codegen), a YAML
+inventory, and Kubernetes Job manifests with `google.com/tpu` resource
+requests (the benchmark-workload analogue of docs/benchmarks.md).
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import yaml
+
+from tritonk8ssupervisor_tpu.config.schema import ClusterConfig
+
+BENCH_IMAGE_DEFAULT = "python:3.11-slim"
+
+
+# ---------------------------------------------------------------- terraform
+
+
+def to_tfvars(config: ClusterConfig) -> dict:
+    """Variables for terraform/tpu-vm or terraform/gke root modules.
+
+    Replaces the reference's per-VM `module` block codegen loop
+    (setup.sh:145-152) — fan-out lives in HCL `count` now, driven by
+    `num_slices` / node counts here.
+    """
+    common = {
+        "project": config.project,
+        "zone": config.zone,
+        "network": config.network,
+        "subnetwork": config.subnetwork,
+        "name_prefix": config.node_prefix,
+        "num_slices": config.num_slices,
+    }
+    if config.mode == "tpu-vm":
+        return common | {
+            "accelerator_type": config.accelerator_type,
+            "runtime_version": config.effective_runtime_version,
+        }
+    return common | {
+        "cluster_name": config.cluster_name,
+        "machine_type": config.gke_machine_type,
+        "tpu_topology": str(config.parsed_topology),
+        "nodes_per_slice": config.hosts_per_slice,
+    }
+
+
+def write_tfvars(config: ClusterConfig, terraform_dir: Path) -> Path:
+    out = terraform_dir / config.mode / "terraform.tfvars.json"
+    out.parent.mkdir(parents=True, exist_ok=True)
+    out.write_text(json.dumps(to_tfvars(config), indent=2, sort_keys=True) + "\n")
+    return out
+
+
+# ------------------------------------------------------------------ ansible
+
+
+def to_inventory(config: ClusterConfig, host_ips: list[str]) -> str:
+    """INI inventory, the analogue of the [MASTER]/[HOST] groups the
+    reference built from masters.ip/hosts.ip (setup.sh:123-126)."""
+    lines = ["[TPUHOST]"]
+    lines += host_ips
+    lines += [
+        "",
+        "[TPUHOST:vars]",
+        "ansible_user=root",
+        "ansible_python_interpreter=/usr/bin/python3",
+        "",
+    ]
+    return "\n".join(lines)
+
+
+def to_ansible_vars(config: ClusterConfig, coordinator_ip: str = "") -> dict:
+    """vars.yml analogue (reference setup.sh:128-131 wrote master IP + env
+    name/description for the ranchermaster role)."""
+    return {
+        "coordinator": coordinator_ip,
+        "kubernetes_name": config.env_name,
+        "kubernetes_description": config.env_description,
+        "tpu_generation": config.generation,
+        "accelerator_type": config.accelerator_type,
+        "runtime_version": config.effective_runtime_version,
+        "expected_devices_per_host": config.spec.chips_on_host(config.parsed_topology),
+        "hosts_per_slice": config.hosts_per_slice,
+        "project": config.project,
+        "zone": config.zone,
+        "cluster_name": config.cluster_name,
+        "mode": config.mode,
+    }
+
+
+def write_ansible_configs(
+    config: ClusterConfig, host_ips: list[str], ansible_dir: Path, coordinator_ip: str = ""
+) -> None:
+    ansible_dir.mkdir(parents=True, exist_ok=True)
+    (ansible_dir / "hosts").write_text(to_inventory(config, host_ips))
+    vars_dir = ansible_dir / "roles" / "tpuhost" / "vars"
+    vars_dir.mkdir(parents=True, exist_ok=True)
+    (vars_dir / "vars.yml").write_text(
+        yaml.safe_dump(to_ansible_vars(config, coordinator_ip), sort_keys=True)
+    )
+
+
+# -------------------------------------------------------------- k8s manifests
+
+
+def to_benchmark_job(
+    config: ClusterConfig,
+    *,
+    name: str = "resnet50-bench",
+    image: str = BENCH_IMAGE_DEFAULT,
+    command: list[str] | None = None,
+    slice_index: int = 0,
+) -> dict:
+    """ResNet-50 benchmark as an Indexed Job spanning every host of a slice.
+
+    This is the TPU-native re-expression of the reference's benchmark
+    container workload (docs/benchmarks.md:1-4) and its node-join logic
+    (rancherhost/tasks/main.yml:26-34): instead of a rancher/agent phoning
+    home, K8s schedules one pod per TPU host; the completion index + a
+    headless service give jax.distributed.initialize its coordinator.
+    """
+    spec = config.spec
+    topo = config.parsed_topology
+    hosts = config.hosts_per_slice
+    chips_on_host = spec.chips_on_host(topo)
+    svc = f"{name}-svc"
+    container = {
+        "name": "bench",
+        "image": image,
+        "command": command
+        or ["python", "-m", "tritonk8ssupervisor_tpu.benchmarks.resnet50"],
+        "resources": {
+            "requests": {"google.com/tpu": str(chips_on_host)},
+            "limits": {"google.com/tpu": str(chips_on_host)},
+        },
+        "env": [
+            # jax.distributed.initialize() on GKE reads these (the analogue
+            # of the registrationUrl handoff, rancherhost/tasks/main.yml:19-24)
+            {"name": "JAX_COORDINATOR_ADDRESS", "value": f"{name}-0.{svc}:8476"},
+            {"name": "JAX_NUM_PROCESSES", "value": str(hosts)},
+            {
+                "name": "JAX_PROCESS_ID",
+                "valueFrom": {
+                    "fieldRef": {
+                        "fieldPath": "metadata.annotations['batch.kubernetes.io/job-completion-index']"
+                    }
+                },
+            },
+            {"name": "TPU_TOPOLOGY", "value": str(topo)},
+            {"name": "TPU_WORKER_HOSTNAMES", "value": svc},
+        ],
+        "ports": [{"containerPort": 8476}],
+    }
+    return {
+        "apiVersion": "batch/v1",
+        "kind": "Job",
+        "metadata": {
+            "name": f"{name}-{slice_index}" if config.num_slices > 1 else name,
+            "labels": {"app": name, "slice": str(slice_index)},
+        },
+        "spec": {
+            "completions": hosts,
+            "parallelism": hosts,
+            "completionMode": "Indexed",
+            "backoffLimit": 0,
+            "template": {
+                "metadata": {"labels": {"app": name}},
+                "spec": {
+                    "subdomain": svc,
+                    "restartPolicy": "Never",
+                    "nodeSelector": {
+                        "cloud.google.com/gke-tpu-accelerator": _gke_accelerator_label(
+                            config.generation
+                        ),
+                        "cloud.google.com/gke-tpu-topology": str(topo),
+                    },
+                    "containers": [container],
+                },
+            },
+        },
+    }
+
+
+def to_headless_service(name: str = "resnet50-bench") -> dict:
+    """Headless Service for pod-to-pod coordinator discovery (SURVEY.md §7
+    'hard parts': coordinator discovery inside K8s)."""
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {"name": f"{name}-svc"},
+        "spec": {
+            "clusterIP": "None",  # the k8s API literal, not YAML null
+            "selector": {"app": name},
+            "ports": [{"port": 8476, "name": "jax-coordinator"}],
+        },
+    }
+
+
+def _gke_accelerator_label(generation: str) -> str:
+    return {
+        "v4": "tpu-v4-podslice",
+        "v5e": "tpu-v5-lite-podslice",
+        "v5p": "tpu-v5p-slice",
+        "v6e": "tpu-v6e-slice",
+    }[generation]
+
+
+def write_manifests(config: ClusterConfig, manifests_dir: Path, **job_kwargs) -> list[Path]:
+    manifests_dir.mkdir(parents=True, exist_ok=True)
+    paths = []
+    svc = manifests_dir / "bench-service.yaml"
+    svc.write_text(yaml.safe_dump(to_headless_service(), sort_keys=False))
+    paths.append(svc)
+    for i in range(config.num_slices):
+        job = manifests_dir / f"bench-job-{i}.yaml"
+        job.write_text(
+            yaml.safe_dump(to_benchmark_job(config, slice_index=i, **job_kwargs), sort_keys=False)
+        )
+        paths.append(job)
+    return paths

@@ -1,0 +1,4 @@
+from tritonk8ssupervisor_tpu.utils.topology import (  # noqa: F401
+    Topology,
+    parse_topology,
+)
