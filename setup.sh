@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+#
+# TPU-native cluster provisioning — same two-command UX contract as the
+# reference (reference setup.sh:8-12): `./setup.sh` provisions,
+# `./setup.sh -c` destroys. The wizard/orchestration engine that the
+# reference kept in 551 lines of bash lives in the tested Python package;
+# this entrypoint only dispatches.
+
+set -o errexit -o pipefail
+
+cd "$(dirname "$0")"
+exec python3 -m tritonk8ssupervisor_tpu.cli.main --workdir "$PWD" "$@"

@@ -40,6 +40,7 @@ def test_inventory():
     assert "[TPUHOST]" in inv
     assert "10.0.0.1\n10.0.0.2" in inv
     assert "ansible_user=root" in inv
+    assert "localhost ansible_connection=local" in inv
 
 
 def test_ansible_vars():
@@ -53,9 +54,7 @@ def test_ansible_vars():
 def test_write_ansible_configs(tmp_path):
     cc.write_ansible_configs(cfg(), ["10.0.0.1"], tmp_path, coordinator_ip="10.0.0.1")
     assert (tmp_path / "hosts").exists()
-    vars_yml = yaml.safe_load(
-        (tmp_path / "roles" / "tpuhost" / "vars" / "vars.yml").read_text()
-    )
+    vars_yml = yaml.safe_load((tmp_path / "group_vars" / "all.yml").read_text())
     assert vars_yml["coordinator"] == "10.0.0.1"
 
 

@@ -1,0 +1,107 @@
+"""GCP environment discovery with a fake gcloud runner — the analogue of the
+reference's `triton env` bootstrap + SSH key scan (setup.sh:209-239)."""
+
+import subprocess
+
+import pytest
+
+from tritonk8ssupervisor_tpu.cli import discovery
+
+
+def fake_runner(responses):
+    """responses: {subcommand-tuple-suffix: (returncode, stdout)}"""
+
+    def run(args, **kwargs):
+        for key, (code, out) in responses.items():
+            if tuple(args[1 : 1 + len(key)]) == key:
+                return subprocess.CompletedProcess(args, code, stdout=out, stderr="")
+        return subprocess.CompletedProcess(args, 1, stdout="", stderr="unknown")
+
+    return run
+
+
+def test_discover_reads_gcloud_config():
+    run = fake_runner(
+        {
+            ("config", "get-value", "project"): (0, "my-proj\n"),
+            ("config", "get-value", "account"): (0, "me@example.com\n"),
+            ("config", "get-value", "compute/zone"): (0, "us-east5-b\n"),
+        }
+    )
+    env = discovery.discover(run)
+    assert env.project == "my-proj"
+    assert env.account == "me@example.com"
+    assert env.zone == "us-east5-b"
+
+
+def test_discover_unset_and_failure_are_empty():
+    run = fake_runner({("config", "get-value", "project"): (0, "(unset)\n")})
+    env = discovery.discover(run)
+    assert env == discovery.GcloudEnv()
+
+
+def test_discover_tolerates_missing_gcloud():
+    def run(args, **kwargs):
+        raise OSError("no gcloud")
+
+    assert discovery.discover(run) == discovery.GcloudEnv()
+
+
+def test_require_credentials_passes_with_account():
+    discovery.require_credentials(discovery.GcloudEnv(account="me@x.com"))
+
+
+def test_require_credentials_falls_back_to_auth_list():
+    env = discovery.GcloudEnv()
+    run = fake_runner({("auth", "list"): (0, "sa@proj.iam.gserviceaccount.com\n")})
+    discovery.require_credentials(env, run)
+    assert env.account == "sa@proj.iam.gserviceaccount.com"
+
+
+def test_require_credentials_hard_fails_with_guidance():
+    run = fake_runner({})
+    with pytest.raises(discovery.DiscoveryError, match="gcloud auth login"):
+        discovery.require_credentials(discovery.GcloudEnv(), run)
+
+
+def test_find_ssh_key_prefers_gce_key(tmp_path):
+    (tmp_path / "id_rsa").write_text("k")
+    (tmp_path / "google_compute_engine").write_text("k")
+    assert discovery.find_ssh_key(tmp_path).name == "google_compute_engine"
+
+
+def test_find_ssh_key_missing_aborts_like_reference(tmp_path):
+    with pytest.raises(discovery.DiscoveryError, match="config-ssh"):
+        discovery.find_ssh_key(tmp_path)
+
+
+def test_list_tpu_zones_probes_each_zone():
+    # only us-west4-a still offers v5e in this fake world
+    def run(args, **kwargs):
+        zone = next(a.split("=")[1] for a in args if a.startswith("--zone="))
+        out = (
+            f"projects/p/locations/{zone}/acceleratorTypes/v5litepod-16\n"
+            if zone == "us-west4-a"
+            else ""
+        )
+        return subprocess.CompletedProcess(args, 0, stdout=out, stderr="")
+
+    assert discovery.list_tpu_zones("v5e", run) == ["us-west4-a"]
+
+
+def test_list_tpu_zones_gcloud_failure_falls_back():
+    run = fake_runner({})  # every call returns returncode 1
+    from tritonk8ssupervisor_tpu.config import catalog
+
+    assert discovery.list_tpu_zones("v5e", run) == list(
+        catalog.ACCELERATORS["v5e"].zones
+    )
+
+
+def test_list_tpu_zones_falls_back_to_catalog():
+    from tritonk8ssupervisor_tpu.config import catalog
+
+    run = fake_runner({})
+    assert discovery.list_tpu_zones("v6e", run) == list(
+        catalog.ACCELERATORS["v6e"].zones
+    )

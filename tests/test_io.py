@@ -1,0 +1,64 @@
+"""Prompter primitives: getArgument semantics (reference setup.sh:94-110),
+menu bounds re-prompting (setup.sh:337-356), literal-yes gate (setup.sh:471-482)."""
+
+import io
+
+import pytest
+
+from tritonk8ssupervisor_tpu.cli.io import EndOfInput, Prompter
+
+
+def make_prompter(*lines):
+    out = io.StringIO()
+    return Prompter(io.StringIO("\n".join(lines) + "\n"), out), out
+
+
+def test_ask_returns_input():
+    p, _ = make_prompter("hello")
+    assert p.ask("Name", "default") == "hello"
+
+
+def test_ask_empty_returns_default():
+    p, _ = make_prompter("")
+    assert p.ask("Name", "default") == "default"
+
+
+def test_ask_strips_whitespace():
+    p, _ = make_prompter("  spaced  ")
+    assert p.ask("Name") == "spaced"
+
+
+def test_ask_eof_raises():
+    p = Prompter(io.StringIO(""), io.StringIO())
+    with pytest.raises(EndOfInput):
+        p.ask("Name")
+
+
+def test_ask_validated_reprompts_until_valid():
+    p, out = make_prompter("BAD", "ok")
+    validate = lambda v: "" if v.islower() else "lowercase only"
+    assert p.ask_validated("Name", "", validate) == "ok"
+    assert "lowercase only" in out.getvalue()
+
+
+def test_menu_returns_zero_based_index():
+    p, _ = make_prompter("2")
+    assert p.menu("Pick:", ["a", "b", "c"]) == 1
+
+
+def test_menu_default_on_empty():
+    p, _ = make_prompter("")
+    assert p.menu("Pick:", ["a", "b", "c"], default_index=2) == 2
+
+
+def test_menu_reprompts_on_out_of_range_and_garbage():
+    p, out = make_prompter("9", "zzz", "1")
+    assert p.menu("Pick:", ["a", "b"]) == 0
+    assert out.getvalue().count("! enter a number") == 2
+
+
+def test_confirm_literal_yes_only():
+    for answer, expected in [("yes", True), ("y", True), ("YES", True),
+                             ("no", False), ("", False), ("sure", False)]:
+        p, _ = make_prompter(answer)
+        assert p.confirm("Go?") is expected, answer

@@ -1,0 +1,187 @@
+"""End-to-end pipeline run against stub terraform/ansible/gcloud binaries —
+the whole SURVEY.md §3.1 call stack (provision) and §3.2 (teardown) without
+touching GCP. The reference could only be tested by burning real Triton VMs;
+this harness is the §4 improvement."""
+
+import json
+import os
+import stat
+import textwrap
+
+import pytest
+
+from tritonk8ssupervisor_tpu.cli.main import main
+from tritonk8ssupervisor_tpu.provision.state import RunPaths
+
+
+def write_stub(bin_dir, name, script):
+    path = bin_dir / name
+    path.write_text("#!/usr/bin/env bash\n" + textwrap.dedent(script))
+    path.chmod(path.stat().st_mode | stat.S_IEXEC)
+    return path
+
+
+@pytest.fixture
+def fake_world(tmp_path, monkeypatch):
+    """A workdir with terraform/ansible layout + stub binaries + fake HOME."""
+    work = tmp_path / "repo"
+    for sub in ("terraform/tpu-vm", "terraform/gke", "ansible"):
+        (work / sub).mkdir(parents=True)
+    (work / "ansible" / "ansible.cfg").write_text(
+        "[defaults]\nhost_key_checking = False\nprivate_key_file =\n"
+    )
+    (work / "ansible" / "clusterUp.yml").write_text("[]\n")
+
+    home = tmp_path / "home"
+    (home / ".ssh").mkdir(parents=True)
+    (home / ".ssh" / "id_rsa").write_text("fake-key\n")
+    monkeypatch.setenv("HOME", str(home))
+
+    bin_dir = tmp_path / "bin"
+    bin_dir.mkdir()
+    calls_log = tmp_path / "calls.log"
+    monkeypatch.setenv("CALLS_LOG", str(calls_log))
+    monkeypatch.setenv("PATH", f"{bin_dir}:{os.environ['PATH']}")
+
+    write_stub(
+        bin_dir,
+        "terraform",
+        """
+        echo "terraform $*" >> "$CALLS_LOG"
+        case "$1" in
+          init) ;;
+          apply) echo '{"resources": [{"type": "google_tpu_v2_vm"}]}' > terraform.tfstate ;;
+          output) echo '{"host_ips": {"value": [["10.0.0.1", "10.0.0.2"]]}}' ;;
+          destroy) rm -f terraform.tfstate ;;
+        esac
+        """,
+    )
+    write_stub(
+        bin_dir,
+        "ansible-playbook",
+        'echo "ansible-playbook $*" >> "$CALLS_LOG"\n',
+    )
+    write_stub(
+        bin_dir,
+        "gcloud",
+        """
+        echo "gcloud $*" >> "$CALLS_LOG"
+        case "$*" in
+          "config get-value project") echo stub-proj ;;
+          "config get-value account") echo me@stub.test ;;
+          "config get-value compute/zone") echo "" ;;
+          *describe*) echo READY ;;
+        esac
+        """,
+    )
+    write_stub(bin_dir, "ssh-keygen", 'echo "ssh-keygen $*" >> "$CALLS_LOG"\n')
+    write_stub(
+        bin_dir,
+        "kubectl",
+        """
+        echo "kubectl $*" >> "$CALLS_LOG"
+        echo '{"items": [
+          {"metadata": {"name": "n1"},
+           "status": {"allocatable": {"google.com/tpu": "4"},
+                      "conditions": [{"type": "Ready", "status": "True"}]}}]}'
+        """,
+    )
+    return work, calls_log
+
+
+def saved_config(work, **overrides):
+    lines = {
+        "PROJECT": "file-proj", "ZONE": "us-west4-a", "MODE": "tpu-vm",
+        "GENERATION": "v5e", "TOPOLOGY": "4x4", "NUM_SLICES": "1",
+    }
+    lines.update(overrides)
+    path = work / "given.config"
+    path.write_text("\n".join(f"{k}={v}" for k, v in lines.items()) + "\n")
+    return path
+
+
+def test_provision_then_clean_tpu_vm(fake_world, capsys):
+    work, calls_log = fake_world
+    config_path = saved_config(work)
+
+    rc = main(["--yes", "--config", str(config_path), "--workdir", str(work)])
+    assert rc == 0, capsys.readouterr().out
+
+    paths = RunPaths(work)
+    calls = calls_log.read_text()
+    assert "terraform init" in calls and "terraform apply" in calls
+    assert "ansible-playbook -i hosts clusterUp.yml" in calls
+    assert "describe" in calls  # readiness probed the TPU state
+    assert paths.config_file.exists()
+    assert json.loads(paths.hosts_file.read_text())["coordinator_ip"] == "10.0.0.1"
+    assert "10.0.0.1" in paths.inventory.read_text()
+    assert (paths.manifests_dir / "bench-service.yaml").exists()
+    assert "private_key_file = " in paths.ansible_cfg.read_text()
+    # phase timing recorded (north-star wall-clock, SURVEY.md §5)
+    phases = [json.loads(l)["phase"] for l in paths.runlog.read_text().splitlines()]
+    assert "terraform-apply" in phases and "readiness-wait" in phases
+
+    out = capsys.readouterr().out
+    assert "Cluster is ready" in out
+    assert "TOTAL" in out
+
+    # teardown scrubs everything (setup.sh:484-521 analogue)
+    rc = main(["-c", "--yes", "--workdir", str(work)])
+    assert rc == 0
+    assert not paths.config_file.exists()
+    assert not paths.hosts_file.exists()
+    assert "ssh-keygen -R 10.0.0.1" in calls_log.read_text()
+
+
+def test_provision_gke_mode(fake_world, capsys):
+    work, calls_log = fake_world
+    config_path = saved_config(
+        work, MODE="gke", TOPOLOGY="2x2", CLUSTER_NAME="stub-cluster"
+    )
+    rc = main(["--yes", "--config", str(config_path), "--workdir", str(work)])
+    assert rc == 0, capsys.readouterr().out
+    assert "kubectl get nodes" in calls_log.read_text()
+    out = capsys.readouterr().out
+    assert "get-credentials stub-cluster" in out
+
+
+def test_resume_detected_on_second_run(fake_world, capsys):
+    work, _ = fake_world
+    config_path = saved_config(work)
+    assert main(["--yes", "--config", str(config_path), "--workdir", str(work)]) == 0
+    capsys.readouterr()
+    # second run without --config resumes from the saved config file
+    assert main(["--yes", "--workdir", str(work)]) == 0
+    assert "Previous run detected" in capsys.readouterr().out
+
+
+def test_clean_without_config_is_noop(fake_world, capsys):
+    work, _ = fake_world
+    assert main(["-c", "--yes", "--workdir", str(work)]) == 0
+    assert "nothing to clean" in capsys.readouterr().out
+
+
+def test_explicit_config_overrides_saved(fake_world, capsys):
+    work, _ = fake_world
+    first = saved_config(work)
+    assert main(["--yes", "--config", str(first), "--workdir", str(work)]) == 0
+    capsys.readouterr()
+    # second run with a DIFFERENT explicit config must use it, not the saved one
+    second = saved_config(work, TOPOLOGY="2x4")
+    assert main(["--yes", "--config", str(second), "--workdir", str(work)]) == 0
+    out = capsys.readouterr().out
+    assert "overriding saved" in out
+    from tritonk8ssupervisor_tpu.config import store
+
+    assert store.load_config_file(RunPaths(work).config_file).topology == "2x4"
+
+
+def test_missing_terraform_binary_is_friendly(fake_world, capsys):
+    work, _ = fake_world
+    # drop the terraform stub: Popen raises FileNotFoundError, which must
+    # surface as the friendly ERROR path, not a traceback
+    (work.parent / "bin" / "terraform").unlink()
+    rc = main(["--yes", "--config", str(saved_config(work)), "--workdir", str(work)])
+    assert rc == 1
+    err = capsys.readouterr().err
+    assert "ERROR:" in err and "terraform" in err

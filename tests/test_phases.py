@@ -1,0 +1,47 @@
+"""Phase timing + structured logs (SURVEY.md §5 tracing gap)."""
+
+import io
+import json
+
+import pytest
+
+from tritonk8ssupervisor_tpu.utils.phases import PhaseTimer
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_phase_timing_and_jsonl(tmp_path):
+    clock = FakeClock()
+    log = tmp_path / "runlog.jsonl"
+    out = io.StringIO()
+    timer = PhaseTimer(out=out, logfile=log, clock=clock, wall=lambda: 1000.0)
+    with timer.phase("terraform"):
+        clock.t += 12.5
+    with timer.phase("ansible"):
+        clock.t += 3.0
+    assert timer.durations == {"terraform": 12.5, "ansible": 3.0}
+    records = [json.loads(l) for l in log.read_text().splitlines()]
+    assert [r["status"] for r in records] == ["start", "done", "start", "done"]
+    assert records[1]["seconds"] == 12.5
+    timer.report()
+    assert "TOTAL" in out.getvalue()
+
+
+def test_failed_phase_logged_and_reraised(tmp_path):
+    clock = FakeClock()
+    log = tmp_path / "runlog.jsonl"
+    timer = PhaseTimer(out=io.StringIO(), logfile=log, clock=clock, wall=lambda: 0.0)
+    with pytest.raises(RuntimeError, match="boom"):
+        with timer.phase("terraform"):
+            clock.t += 1.0
+            raise RuntimeError("boom")
+    last = json.loads(log.read_text().splitlines()[-1])
+    assert last["status"] == "failed"
+    assert last["error"] == "boom"
+    assert timer.durations["terraform"] == 1.0

@@ -1,0 +1,276 @@
+"""Provision-layer units: state contract, terraform driver, ansible config
+generation, readiness probes, teardown — all with recording fakes in place
+of real binaries (SURVEY.md §4: fake-cluster harness)."""
+
+import io
+import json
+
+import pytest
+
+from tritonk8ssupervisor_tpu.cli.io import Prompter
+from tritonk8ssupervisor_tpu.config.schema import ClusterConfig
+from tritonk8ssupervisor_tpu.provision import (
+    ansible as ansible_mod,
+    readiness,
+    state,
+    teardown,
+    terraform as terraform_mod,
+)
+
+
+def cfg(**overrides):
+    base = dict(project="my-proj", zone="us-west4-a", generation="v5e",
+                topology="4x4", mode="tpu-vm")
+    base.update(overrides)
+    return ClusterConfig(**base)
+
+
+class RecordingRunner:
+    """Stands in for run_streaming/run_capture; scripted by command prefix."""
+
+    def __init__(self, responses=None, effects=None):
+        self.calls = []
+        self.responses = responses or {}
+        self.effects = effects or {}
+
+    def __call__(self, args, cwd=None, **kwargs):
+        self.calls.append((tuple(args), cwd))
+        for prefix, effect in self.effects.items():
+            if tuple(args[: len(prefix)]) == prefix:
+                effect(cwd)
+        for prefix, out in self.responses.items():
+            if tuple(args[: len(prefix)]) == prefix:
+                return out
+        return ""
+
+    def commands(self):
+        return [" ".join(args) for args, _ in self.calls]
+
+
+# ----------------------------------------------------------------- state
+
+
+def test_cluster_hosts_round_trip(tmp_path):
+    hosts = state.ClusterHosts(
+        host_ips=[["10.0.0.1", "10.0.0.2"], ["10.0.1.1"]], coordinator_ip="10.0.0.1"
+    )
+    path = tmp_path / "hosts.json"
+    hosts.save(path)
+    loaded = state.ClusterHosts.load(path)
+    assert loaded == hosts
+    assert loaded.flat_ips == ["10.0.0.1", "10.0.0.2", "10.0.1.1"]
+
+
+def test_load_hosts_missing_aborts_like_reference(tmp_path):
+    paths = state.RunPaths(tmp_path)
+    with pytest.raises(state.MissingStateError, match="terraform"):
+        state.load_hosts(paths)
+
+
+# -------------------------------------------------------------- terraform
+
+
+def make_paths(tmp_path, mode="tpu-vm"):
+    paths = state.RunPaths(tmp_path)
+    paths.terraform_module(mode).mkdir(parents=True, exist_ok=True)
+    return paths
+
+
+def test_terraform_apply_sequences_and_persists_hosts(tmp_path):
+    paths = make_paths(tmp_path)
+    config = cfg()
+    run = RecordingRunner()
+    quiet = RecordingRunner(
+        responses={
+            ("terraform", "output", "-json"): json.dumps(
+                {"host_ips": {"value": [["10.0.0.1", "10.0.0.2"]]}}
+            )
+        }
+    )
+    hosts = terraform_mod.apply(config, paths, run=run, run_quiet=quiet)
+    assert run.commands() == [
+        "terraform init -input=false -no-color",
+        "terraform apply -auto-approve -input=false -no-color",
+    ]
+    assert run.calls[0][1] == paths.terraform_module("tpu-vm")
+    assert hosts.coordinator_ip == "10.0.0.1"
+    assert paths.tfvars("tpu-vm").exists()
+    assert state.load_hosts(paths).flat_ips == ["10.0.0.1", "10.0.0.2"]
+
+
+def test_terraform_gke_outputs(tmp_path):
+    paths = make_paths(tmp_path, "gke")
+    quiet = RecordingRunner(
+        responses={
+            ("terraform", "output", "-json"): json.dumps(
+                {"endpoint": {"value": "34.1.2.3"}}
+            )
+        }
+    )
+    hosts = terraform_mod.apply(cfg(mode="gke"), paths, run=RecordingRunner(), run_quiet=quiet)
+    assert hosts.gke_endpoint == "34.1.2.3"
+    assert hosts.flat_ips == []
+
+
+def test_already_applied_idempotency(tmp_path):
+    paths = make_paths(tmp_path)
+    config = cfg()
+    assert not terraform_mod.already_applied(config, paths)
+    paths.tfstate("tpu-vm").write_text(json.dumps({"resources": []}))
+    assert not terraform_mod.already_applied(config, paths)
+    paths.tfstate("tpu-vm").write_text(json.dumps({"resources": [{"type": "x"}]}))
+    assert terraform_mod.already_applied(config, paths)
+
+
+def test_destroy_skips_without_state(tmp_path):
+    paths = make_paths(tmp_path)
+    run = RecordingRunner()
+    terraform_mod.destroy(cfg(), paths, run=run)
+    assert run.calls == []
+    paths.tfstate("tpu-vm").write_text("{}")
+    terraform_mod.destroy(cfg(), paths, run=run)
+    assert "terraform destroy" in run.commands()[0]
+
+
+# ---------------------------------------------------------------- ansible
+
+
+def test_patch_and_reset_private_key(tmp_path):
+    cfg_file = tmp_path / "ansible.cfg"
+    cfg_file.write_text("[defaults]\nhost_key_checking = False\nprivate_key_file =\n")
+    ansible_mod.patch_private_key(cfg_file, "/home/me/.ssh/key")
+    assert "private_key_file = /home/me/.ssh/key" in cfg_file.read_text()
+    ansible_mod.reset_private_key(cfg_file)
+    assert "private_key_file = \n" in cfg_file.read_text() or \
+        "private_key_file =\n" in cfg_file.read_text()
+
+
+def test_write_runtime_configs(tmp_path):
+    paths = state.RunPaths(tmp_path)
+    paths.ansible_dir.mkdir()
+    paths.ansible_cfg.write_text("[defaults]\nprivate_key_file =\n")
+    hosts = state.ClusterHosts(host_ips=[["10.0.0.1"]], coordinator_ip="10.0.0.1")
+    ansible_mod.write_runtime_configs(cfg(), hosts, paths, ssh_key="/k")
+    assert "10.0.0.1" in paths.inventory.read_text()
+    assert (paths.ansible_dir / "group_vars" / "all.yml").exists()
+    assert "private_key_file = /k" in paths.ansible_cfg.read_text()
+
+
+def test_run_playbook_command(tmp_path):
+    paths = state.RunPaths(tmp_path)
+    run = RecordingRunner()
+    ansible_mod.run_playbook(paths, run=run)
+    assert run.commands() == ["ansible-playbook -i hosts clusterUp.yml"]
+    assert run.calls[0][1] == paths.ansible_dir
+
+
+# -------------------------------------------------------------- readiness
+
+
+def gke_node(name, tpu="8", ready=True):
+    return {
+        "metadata": {"name": name},
+        "status": {
+            "allocatable": {"google.com/tpu": tpu, "cpu": "96"},
+            "conditions": [{"type": "Ready", "status": "True" if ready else "False"}],
+        },
+    }
+
+
+def test_gke_probe_counts_nodes_and_chips():
+    config = cfg(mode="gke")  # 4x4 v5e -> 2 hosts x 8 chips
+    quiet = RecordingRunner(
+        responses={("kubectl",): json.dumps({"items": [gke_node("n1")]})}
+    )
+    assert "1/2 TPU nodes" in readiness.gke_tpu_probe(config, quiet)
+
+    quiet = RecordingRunner(
+        responses={
+            ("kubectl",): json.dumps(
+                {"items": [gke_node("n1"), gke_node("n2", ready=False)]}
+            )
+        }
+    )
+    assert "not Ready" in readiness.gke_tpu_probe(config, quiet)
+
+    quiet = RecordingRunner(
+        responses={
+            ("kubectl",): json.dumps({"items": [gke_node("n1"), gke_node("n2")]})
+        }
+    )
+    assert readiness.gke_tpu_probe(config, quiet) == ""
+
+
+def test_tpu_vm_probe_states():
+    config = cfg()
+    quiet = RecordingRunner(responses={("gcloud",): "CREATING\n"})
+    assert "CREATING" in readiness.tpu_vm_probe(config, ["n-0"], quiet)
+    quiet = RecordingRunner(responses={("gcloud",): "READY\n"})
+    assert readiness.tpu_vm_probe(config, ["n-0", "n-1"], quiet) == ""
+
+
+def test_poll_until_ready_and_timeout():
+    attempts = []
+
+    def probe():
+        attempts.append(1)
+        return "" if len(attempts) >= 3 else "booting"
+
+    readiness.poll(probe, interval=0.0, timeout=60, sleep=lambda s: None,
+                   echo=lambda line: None)
+    assert len(attempts) == 3
+
+    with pytest.raises(readiness.NotReadyError, match="stuck"):
+        readiness.poll(lambda: "stuck", interval=0.0, timeout=0.0,
+                       sleep=lambda s: None, echo=lambda line: None)
+
+
+def test_jax_smoke_command_asserts_device_count():
+    cmd = readiness.jax_smoke_command(8)
+    assert "jax.local_device_count()" in cmd and "== 8" in cmd
+
+
+# --------------------------------------------------------------- teardown
+
+
+def test_teardown_full_scrub(tmp_path):
+    paths = make_paths(tmp_path)
+    config = cfg()
+    # simulate a completed run's residue
+    paths.tfstate("tpu-vm").write_text(json.dumps({"resources": [{}]}))
+    paths.tfvars("tpu-vm").write_text("{}")
+    state.ClusterHosts(host_ips=[["10.0.0.1"]], coordinator_ip="10.0.0.1").save(
+        paths.hosts_file
+    )
+    paths.ansible_dir.mkdir()
+    paths.ansible_cfg.write_text("[defaults]\nprivate_key_file = /k\n")
+    paths.inventory.write_text("[TPUHOST]\n10.0.0.1\n")
+    (paths.ansible_dir / "group_vars").mkdir()
+    (paths.ansible_dir / "group_vars" / "all.yml").write_text("x: 1\n")
+    paths.manifests_dir.mkdir(parents=True)
+    (paths.manifests_dir / "job.yaml").write_text("{}")
+    paths.config_file.write_text("PROJECT=my-proj\n")
+    paths.runlog.write_text("{}\n")
+
+    run = RecordingRunner()
+    prompter = Prompter(io.StringIO("yes\n"), io.StringIO())
+    assert teardown.clean(config, paths, prompter, run=run) is True
+
+    assert "terraform destroy" in " ".join(run.commands())
+    assert "ssh-keygen -R 10.0.0.1" in run.commands()
+    for gone in (
+        paths.tfstate("tpu-vm"), paths.tfvars("tpu-vm"), paths.hosts_file,
+        paths.inventory, paths.config_file, paths.runlog, paths.manifests_dir,
+    ):
+        assert not gone.exists(), gone
+    assert "private_key_file = " in paths.ansible_cfg.read_text()
+
+
+def test_teardown_abort_leaves_everything(tmp_path):
+    paths = make_paths(tmp_path)
+    paths.config_file.write_text("PROJECT=my-proj\n")
+    run = RecordingRunner()
+    prompter = Prompter(io.StringIO("no\n"), io.StringIO())
+    assert teardown.clean(cfg(), paths, prompter, run=run) is False
+    assert run.calls == []
+    assert paths.config_file.exists()

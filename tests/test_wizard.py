@@ -1,0 +1,103 @@
+"""Wizard end-to-end with scripted input — the test the reference's inline
+wizard (setup.sh:255-451) could never have."""
+
+import io
+
+from tritonk8ssupervisor_tpu.cli import discovery, wizard
+from tritonk8ssupervisor_tpu.cli.io import Prompter
+
+
+def catalog_zones(gen):
+    from tritonk8ssupervisor_tpu.config import catalog
+
+    return list(catalog.ACCELERATORS[gen].zones)
+
+
+def run_scripted(lines, env=None, zone_lister=catalog_zones):
+    out = io.StringIO()
+    prompter = Prompter(io.StringIO("\n".join(lines) + "\n"), out)
+    config = wizard.run_wizard(
+        prompter,
+        env=env or discovery.GcloudEnv(project="test-proj"),
+        zone_lister=zone_lister,
+    )
+    return config, out.getvalue()
+
+
+ALL_DEFAULTS = [
+    "",  # project (default from gcloud env)
+    "",  # env name
+    "",  # env description
+    "",  # cluster name
+    "",  # node prefix
+    "",  # mode menu -> gke default
+    "",  # generation menu -> v5e default
+    "",  # topology menu -> 2x2 default
+    "",  # num slices
+    "",  # zone menu
+    "",  # network
+    "",  # subnetwork
+]
+
+
+def test_all_defaults_yields_valid_config():
+    config, _ = run_scripted(ALL_DEFAULTS)
+    config.validate()
+    assert config.project == "test-proj"
+    assert config.mode == "gke"
+    assert config.generation == "v5e"
+    assert config.topology == "2x2"
+    assert config.num_slices == 1
+    assert config.zone == "us-west4-a"
+
+
+def test_custom_selection():
+    lines = [
+        "other-proj", "prod tpus", "production slice", "prod-cluster",
+        "prodnode",
+        "2",      # mode -> tpu-vm
+        "1",      # generation menu (sorted: v4, v5e, v5p, v6e) -> v4
+        "2",      # topology -> second v4 topology (2x2x2)
+        "3",      # slices
+        "1",      # zone menu -> us-central2-b (v4's only zone)
+        "prod-net", "prod-subnet",
+    ]
+    config, _ = run_scripted(lines)
+    assert config.project == "other-proj"
+    assert config.mode == "tpu-vm"
+    assert config.generation == "v4"
+    assert config.topology == "2x2x2"
+    assert config.num_slices == 3
+    assert config.zone == "us-central2-b"
+    assert config.network == "prod-net"
+
+
+def test_invalid_names_reprompt():
+    lines = list(ALL_DEFAULTS)
+    # inject a bad cluster name then a good one
+    lines[3:4] = ["Bad_Name", "good-name"]
+    config, output = run_scripted(lines)
+    assert config.cluster_name == "good-name"
+    assert "RFC1035" in output
+
+
+def test_slice_count_guard_rail():
+    lines = list(ALL_DEFAULTS)
+    lines[8:9] = ["42", "9"]  # over the 1-9 cap, then at the cap
+    config, output = run_scripted(lines)
+    assert config.num_slices == 9
+    assert "no HA support" in output
+
+
+def test_verify_config_summary_and_gate():
+    config, _ = run_scripted(ALL_DEFAULTS)
+    out = io.StringIO()
+    prompter = Prompter(io.StringIO("yes\n"), out)
+    assert wizard.verify_config(config, prompter) is True
+    text = out.getvalue()
+    assert "test-proj" in text
+    assert "v5litepod-4" in text   # accelerator type shown
+    assert "ct5lp-hightpu-4t" in text  # GKE machine type shown
+
+    prompter = Prompter(io.StringIO("no\n"), io.StringIO())
+    assert wizard.verify_config(config, prompter) is False

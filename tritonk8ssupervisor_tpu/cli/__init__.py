@@ -1,0 +1,6 @@
+"""Interactive CLI: prompts, environment discovery, wizard, orchestration.
+
+The TPU-native rebuild of the reference's L0 layer — the `setup.sh` wizard
+(reference setup.sh:8-92 `main`, 94-110 `getArgument`, 255-451
+`getConfigFromUser`, 452-483 `verifyConfig`).
+"""

@@ -1,0 +1,145 @@
+"""GCP environment discovery.
+
+The reference bootstrapped credentials by ``eval $(triton env)`` and then
+scanned ``~/.ssh`` for the private key whose fingerprint matched
+``$SDC_KEY_ID``, hard-failing (with cleanup) when absent
+(setConfigFromTritonENV, reference setup.sh:209-239). The TPU/GCP analogue
+discovers project/account/zone from ``gcloud config``, verifies credentials
+exist, and locates the SSH private key Ansible will use for TPU VMs.
+
+All subprocess execution goes through an injectable runner so tests use a
+fake gcloud (SURVEY.md §4: testability designed in, not bolted on).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import subprocess
+from pathlib import Path
+from typing import Callable
+
+
+class DiscoveryError(RuntimeError):
+    """Environment is not usable; message says how to fix it."""
+
+
+@dataclasses.dataclass
+class GcloudEnv:
+    """What `gcloud config` knows — the SDC_URL/SDC_ACCOUNT/SDC_KEY_ID
+    analogue (reference setup.sh:211-213)."""
+
+    project: str = ""
+    account: str = ""
+    zone: str = ""
+
+
+Runner = Callable[..., "subprocess.CompletedProcess[str]"]
+
+
+def _default_runner(args, **kwargs):
+    return subprocess.run(
+        args, capture_output=True, text=True, timeout=30, **kwargs
+    )
+
+
+def _gcloud_get(key: str, run: Runner) -> str:
+    try:
+        proc = run(["gcloud", "config", "get-value", key])
+    except (OSError, subprocess.SubprocessError):
+        return ""
+    if proc.returncode != 0:
+        return ""
+    value = proc.stdout.strip()
+    return "" if value in ("", "(unset)") else value
+
+
+def discover(run: Runner = _default_runner) -> GcloudEnv:
+    """Pull project/account/zone from gcloud config; empty fields mean
+    "unknown" and the wizard prompts for them instead."""
+    return GcloudEnv(
+        project=_gcloud_get("project", run),
+        account=_gcloud_get("account", run),
+        zone=_gcloud_get("compute/zone", run),
+    )
+
+
+def require_credentials(env: GcloudEnv, run: Runner = _default_runner) -> None:
+    """Hard-fail with guidance when no usable identity exists — the analogue
+    of the reference aborting (and cleaning up) when the Triton SSH key was
+    missing (setup.sh:231-237)."""
+    if env.account:
+        return
+    try:
+        proc = run(["gcloud", "auth", "list", "--format=value(account)"])
+        if proc.returncode == 0 and proc.stdout.strip():
+            env.account = proc.stdout.strip().splitlines()[0]
+            return
+    except (OSError, subprocess.SubprocessError):
+        pass
+    raise DiscoveryError(
+        "no GCP credentials found: run `gcloud auth login` and "
+        "`gcloud auth application-default login`, then re-run setup.sh"
+    )
+
+
+# Candidate private keys, most specific first. The reference matched keys by
+# MD5 fingerprint against $SDC_KEY_ID (setup.sh:215-230); GCP instead
+# installs gcloud's own key (or any key in project metadata), so we take the
+# first existing candidate and let the operator override.
+_SSH_KEY_CANDIDATES = ("google_compute_engine", "id_ed25519", "id_rsa")
+
+
+def find_ssh_key(ssh_dir: Path | None = None) -> Path:
+    """Locate the private key Ansible should use for TPU VM SSH.
+
+    Raises DiscoveryError when none exists, mirroring the reference's
+    missing-key abort (setup.sh:231-237).
+    """
+    ssh_dir = ssh_dir if ssh_dir is not None else Path.home() / ".ssh"
+    for name in _SSH_KEY_CANDIDATES:
+        candidate = ssh_dir / name
+        if candidate.is_file():
+            return candidate
+    raise DiscoveryError(
+        f"no SSH private key found in {ssh_dir} "
+        f"(looked for {', '.join(_SSH_KEY_CANDIDATES)}); "
+        "run `gcloud compute config-ssh` to create one"
+    )
+
+
+def list_tpu_zones(generation: str, run: Runner = _default_runner) -> list[str]:
+    """Zones offering `generation`, live when credentials allow, otherwise
+    the static catalog — the same live-with-fallback pattern as the
+    reference's `triton networks`/`triton packages` menus (setup.sh:257-259).
+
+    `gcloud compute tpus accelerator-types list` is zone-scoped, so each
+    catalog zone is probed individually; any gcloud failure falls back to
+    the static catalog.
+    """
+    from tritonk8ssupervisor_tpu.config import catalog
+
+    spec = catalog.get_spec(generation)
+    live: list[str] = []
+    for zone in spec.zones:
+        try:
+            proc = run(
+                [
+                    "gcloud",
+                    "compute",
+                    "tpus",
+                    "accelerator-types",
+                    "list",
+                    f"--zone={zone}",
+                    "--format=value(name)",
+                ]
+            )
+        except (OSError, subprocess.SubprocessError):
+            return list(spec.zones)
+        if proc.returncode != 0:
+            return list(spec.zones)
+        # name format: projects/P/locations/ZONE/acceleratorTypes/TYPE
+        for line in proc.stdout.strip().splitlines():
+            if line.split("/")[-1].startswith(spec.type_prefix + "-"):
+                live.append(zone)
+                break
+    return live or list(spec.zones)

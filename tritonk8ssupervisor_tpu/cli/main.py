@@ -1,0 +1,219 @@
+"""Pipeline orchestrator — the rebuild of `main` (reference setup.sh:8-92).
+
+Same sequence as the reference (SURVEY.md §3.1): previous-run guard →
+environment discovery → wizard → human verification gate → persist config →
+terraform apply → host configuration (ansible) → readiness wait → success
+banner — plus what the reference lacked: every phase is timed
+(utils/phases.py), since wall-clock-to-ready is the north-star metric.
+
+`./setup.sh -c` dispatches to teardown (cleanRunner analogue,
+setup.sh:9-12, 484-521).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from tritonk8ssupervisor_tpu.cli import discovery, wizard
+from tritonk8ssupervisor_tpu.cli.io import EndOfInput, Prompter
+from tritonk8ssupervisor_tpu.config import compile as compiler
+from tritonk8ssupervisor_tpu.config import store
+from tritonk8ssupervisor_tpu.config.schema import ClusterConfig, ConfigError
+from tritonk8ssupervisor_tpu.provision import (
+    ansible as ansible_mod,
+    readiness,
+    runner as run_mod,
+    state,
+    teardown,
+    terraform as terraform_mod,
+)
+from tritonk8ssupervisor_tpu.utils.phases import PhaseTimer
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="setup.sh",
+        description="Provision a TPU-backed Kubernetes cluster on GCP.",
+    )
+    # the reference's single flag (setup.sh:9-12)
+    parser.add_argument(
+        "-c", "--clean", action="store_true", help="destroy the cluster and all state"
+    )
+    parser.add_argument(
+        "--yes", action="store_true", help="skip confirmation gates (CI use)"
+    )
+    parser.add_argument(
+        "--config",
+        type=Path,
+        default=None,
+        help="load config from file instead of the interactive wizard",
+    )
+    parser.add_argument(
+        "--workdir",
+        type=Path,
+        default=Path.cwd(),
+        help="repo root holding terraform/ and ansible/ (default: cwd)",
+    )
+    parser.add_argument(
+        "--skip-readiness",
+        action="store_true",
+        help="do not wait for the cluster to become ready",
+    )
+    parser.add_argument(
+        "--readiness-timeout", type=float, default=900.0, metavar="SECONDS"
+    )
+    return parser
+
+
+def main(argv: list[str] | None = None, prompter: Prompter | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    prompter = prompter or Prompter()
+    paths = state.RunPaths(args.workdir)
+    try:
+        if args.clean:
+            return clean(args, paths, prompter)
+        return provision(args, paths, prompter)
+    except (
+        ConfigError,
+        discovery.DiscoveryError,
+        state.MissingStateError,
+        readiness.NotReadyError,
+        run_mod.CommandError,
+        EndOfInput,
+    ) as e:
+        print(f"ERROR: {e}", file=sys.stderr)
+        return 1
+    except KeyboardInterrupt:
+        print("\nInterrupted; nothing further was changed. "
+              "Re-run ./setup.sh to resume or ./setup.sh -c to clean up.",
+              file=sys.stderr)
+        return 130
+    except BrokenPipeError:
+        # stdout consumer (e.g. `| head`) went away; not an error of ours
+        return 0
+
+
+def clean(args, paths: state.RunPaths, prompter: Prompter) -> int:
+    if not paths.config_file.exists():
+        prompter.say("No config file found — nothing to clean.")
+        return 0
+    config = store.load_config_file(paths.config_file)
+    ok = teardown.clean(config, paths, prompter, assume_yes=args.yes)
+    return 0 if ok else 1
+
+
+def provision(args, paths: state.RunPaths, prompter: Prompter) -> int:
+    # Refuse-if-previous-run guard (setup.sh:14-18, 241-244): a config file
+    # means a provision is (or was) in flight; converge or clean first. An
+    # explicit --config always wins over the saved one.
+    resuming = paths.config_file.exists() and args.config is None
+    if resuming:
+        prompter.say(
+            f"Previous run detected ({paths.config_file} exists); "
+            "resuming with the saved configuration. Run ./setup.sh -c to start over."
+        )
+    elif paths.config_file.exists():
+        prompter.say(
+            f"NOTE: overriding saved {paths.config_file} with --config {args.config}"
+        )
+
+    timer = PhaseTimer(logfile=paths.runlog)
+
+    with timer.phase("discover-environment"):
+        env = discovery.discover()
+        discovery.require_credentials(env)
+
+    if args.config is not None:
+        config = store.load_config_file(args.config)
+        if not config.project:
+            config.project = env.project
+        config.validate()
+    elif resuming:
+        config = store.load_config_file(paths.config_file)
+        config.validate()
+    else:
+        config = wizard.run_wizard(prompter, env=env)
+
+    # Fail the SSH-key precondition BEFORE any resources are created — the
+    # reference validated its key up front too (setup.sh:231-237).
+    ssh_key: Path | str = ""
+    if config.mode == "tpu-vm":
+        ssh_key = discovery.find_ssh_key()
+
+    if not args.yes and not wizard.verify_config(config, prompter):
+        prompter.say("Aborted; nothing was provisioned.")
+        return 1
+
+    store.save_config_file(config, paths.config_file)
+    store.export_to_env(config)
+
+    with timer.phase("terraform-apply"):
+        if terraform_mod.already_applied(config, paths):
+            prompter.say("terraform state present; converging existing deployment")
+        hosts = terraform_mod.apply(config, paths)
+
+    with timer.phase("host-configuration"):
+        ansible_mod.write_runtime_configs(config, hosts, paths, ssh_key=ssh_key)
+        ansible_mod.run_playbook(paths)
+
+    if not args.skip_readiness:
+        with timer.phase("readiness-wait"):
+            wait_ready(config, args.readiness_timeout)
+
+    with timer.phase("compile-manifests"):
+        manifest_paths = compiler.write_manifests(config, paths.manifests_dir)
+
+    banner(config, hosts, manifest_paths, prompter)
+    timer.report()
+    return 0
+
+
+def wait_ready(config: ClusterConfig, timeout: float) -> None:
+    if config.mode == "gke":
+        readiness.poll(
+            lambda: readiness.gke_tpu_probe(config), timeout=timeout
+        )
+    else:
+        names = [
+            f"{config.node_prefix}-{i}" for i in range(config.num_slices)
+        ]
+        readiness.poll(
+            lambda: readiness.tpu_vm_probe(config, names), timeout=timeout
+        )
+
+
+def banner(config, hosts: state.ClusterHosts, manifest_paths, prompter: Prompter) -> None:
+    """Success banner with the URLs of record — the dashboard/kubectl-config
+    URL printout analogue (setup.sh:49-91)."""
+    prompter.say("")
+    prompter.say("---------------------------------------------------------")
+    prompter.say(" Cluster is ready.")
+    prompter.say("---------------------------------------------------------")
+    if config.mode == "gke":
+        prompter.say(
+            "  Workloads:  https://console.cloud.google.com/kubernetes/"
+            f"workload/overview?project={config.project}"
+        )
+        prompter.say(
+            f"  kubeconfig: gcloud container clusters get-credentials "
+            f"{config.cluster_name} --zone {config.zone} --project {config.project}"
+        )
+        prompter.say(
+            f"  Benchmark:  kubectl apply -f {manifest_paths[0].parent}/"
+        )
+    else:
+        for i, slice_ips in enumerate(hosts.host_ips):
+            prompter.say(f"  slice {i}: {', '.join(slice_ips)}")
+        prompter.say(
+            f"  SSH:       gcloud compute tpus tpu-vm ssh {config.node_prefix}-0 "
+            f"--zone {config.zone}"
+        )
+        prompter.say(
+            "  Benchmark: python -m tritonk8ssupervisor_tpu.benchmarks.resnet50"
+        )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -64,7 +64,10 @@ def write_tfvars(config: ClusterConfig, terraform_dir: Path) -> Path:
 
 def to_inventory(config: ClusterConfig, host_ips: list[str]) -> str:
     """INI inventory, the analogue of the [MASTER]/[HOST] groups the
-    reference built from masters.ip/hosts.ip (setup.sh:123-126)."""
+    reference built from masters.ip/hosts.ip (setup.sh:123-126). The
+    [LOCAL] group hosts the gkejoin play, which drives gcloud/kubectl from
+    the control machine (the ranchermaster local_action analogue,
+    ranchermaster/tasks/main.yml:51-52)."""
     lines = ["[TPUHOST]"]
     lines += host_ips
     lines += [
@@ -72,6 +75,9 @@ def to_inventory(config: ClusterConfig, host_ips: list[str]) -> str:
         "[TPUHOST:vars]",
         "ansible_user=root",
         "ansible_python_interpreter=/usr/bin/python3",
+        "",
+        "[LOCAL]",
+        "localhost ansible_connection=local",
         "",
     ]
     return "\n".join(lines)
@@ -99,11 +105,14 @@ def to_ansible_vars(config: ClusterConfig, coordinator_ip: str = "") -> dict:
 def write_ansible_configs(
     config: ClusterConfig, host_ips: list[str], ansible_dir: Path, coordinator_ip: str = ""
 ) -> None:
+    """Generated vars go to group_vars/all.yml so every play sees them (the
+    reference funnelled one vars.yml into each play via vars_files,
+    clusterUp.yml:12,22)."""
     ansible_dir.mkdir(parents=True, exist_ok=True)
     (ansible_dir / "hosts").write_text(to_inventory(config, host_ips))
-    vars_dir = ansible_dir / "roles" / "tpuhost" / "vars"
+    vars_dir = ansible_dir / "group_vars"
     vars_dir.mkdir(parents=True, exist_ok=True)
-    (vars_dir / "vars.yml").write_text(
+    (vars_dir / "all.yml").write_text(
         yaml.safe_dump(to_ansible_vars(config, coordinator_ip), sort_keys=True)
     )
 

@@ -1,0 +1,8 @@
+"""Provisioning runners: terraform, ansible, readiness, teardown.
+
+The process-boundary layer — where the reference shelled out to
+`terraform get && terraform apply` (setup.sh:154-158), `ansible-playbook`
+(setup.sh:111-115), and `curl`/`ssh` readiness probing (setup.sh:59-85).
+Every runner takes an injectable subprocess function so the whole pipeline
+is testable with stub binaries (SURVEY.md §4: fake-cluster harness).
+"""

@@ -1,0 +1,66 @@
+"""Ansible driver: generate runtime configs, run the playbook.
+
+Rebuild of `createAnsibleConfigs` + `runAnsible` (reference
+setup.sh:116-137, 111-115): fail fast when terraform left no endpoints
+(setup.sh:117-120), generate the inventory and role vars, point
+ansible.cfg at the discovered SSH key (the sed at setup.sh:133), then
+`ansible-playbook -i hosts clusterUp.yml`.
+"""
+
+from __future__ import annotations
+
+import re
+from pathlib import Path
+
+from tritonk8ssupervisor_tpu.config import compile as compiler
+from tritonk8ssupervisor_tpu.config.schema import ClusterConfig
+from tritonk8ssupervisor_tpu.provision import runner as run_mod
+from tritonk8ssupervisor_tpu.provision.state import ClusterHosts, RunPaths
+
+_KEY_LINE = re.compile(r"^private_key_file\s*=.*$", re.MULTILINE)
+
+
+def patch_private_key(ansible_cfg: Path, key_path: Path | str) -> None:
+    """Point ansible.cfg at the SSH key — the runtime sed (setup.sh:133).
+    Reversed by teardown (setup.sh:511)."""
+    text = ansible_cfg.read_text()
+    new = f"private_key_file = {key_path}"
+    if _KEY_LINE.search(text):
+        text = _KEY_LINE.sub(new, text)
+    else:
+        text = text.rstrip("\n") + "\n" + new + "\n"
+    ansible_cfg.write_text(text)
+
+
+def reset_private_key(ansible_cfg: Path) -> None:
+    if ansible_cfg.exists():
+        patch_private_key(ansible_cfg, "")
+
+
+def write_runtime_configs(
+    config: ClusterConfig,
+    hosts: ClusterHosts,
+    paths: RunPaths,
+    ssh_key: Path | str = "",
+) -> None:
+    compiler.write_ansible_configs(
+        config,
+        hosts.flat_ips,
+        paths.ansible_dir,
+        coordinator_ip=hosts.coordinator_ip,
+    )
+    if ssh_key and paths.ansible_cfg.exists():
+        patch_private_key(paths.ansible_cfg, ssh_key)
+
+
+def run_playbook(
+    paths: RunPaths,
+    run: run_mod.RunFn = run_mod.run_streaming,
+    extra_args: list[str] | None = None,
+) -> None:
+    """`cd ansible && ansible-playbook -i hosts clusterUp.yml`
+    (setup.sh:111-115)."""
+    run(
+        ["ansible-playbook", "-i", "hosts", "clusterUp.yml"] + (extra_args or []),
+        cwd=paths.ansible_dir,
+    )

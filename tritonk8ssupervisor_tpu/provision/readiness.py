@@ -1,0 +1,134 @@
+"""Cluster readiness probes.
+
+The reference's readiness layer was a scrape-and-kill workaround: curl the
+K8s dashboard through the Rancher proxy every 15 s, and on a particular
+error SSH in and docker-stop a wedged container (setup.sh:59-85, marked
+`# BUG`). The rebuild makes readiness deterministic (SURVEY.md §7 "hard
+parts"): poll declared conditions — K8s node Ready + allocatable
+`google.com/tpu` chips for GKE, TPU VM state READY + a JAX device-count
+smoke test over SSH for standalone slices — with bounded timeouts.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Callable
+
+from tritonk8ssupervisor_tpu.config.schema import ClusterConfig
+from tritonk8ssupervisor_tpu.provision import runner as run_mod
+
+
+class NotReadyError(RuntimeError):
+    """Cluster did not become ready within the timeout."""
+
+
+def poll(
+    probe: Callable[[], str],
+    *,
+    interval: float = 15.0,
+    timeout: float = 900.0,
+    sleep: Callable[[float], None] = time.sleep,
+    echo: Callable[[str], None] = lambda line: print(line, flush=True),
+) -> None:
+    """Run `probe` until it returns "" (ready) or the timeout lapses.
+
+    A non-empty return is the human-readable "why not yet" — echoed like
+    the reference's progress ticker (setup.sh:62,80) but with content.
+    Probe exceptions count as "not yet" (transient API errors mid-boot).
+    The 15 s cadence matches the reference's dashboard poll (setup.sh:66).
+    """
+    deadline = time.monotonic() + timeout
+    while True:
+        try:
+            why_not = probe()
+        except Exception as e:  # noqa: BLE001 - transient infra errors
+            why_not = f"probe error: {e}"
+        if not why_not:
+            return
+        if time.monotonic() >= deadline:
+            raise NotReadyError(f"timed out after {timeout:.0f}s: {why_not}")
+        echo(f"  ... {why_not}")
+        sleep(interval)
+
+
+# ------------------------------------------------------------------ GKE mode
+
+
+def gke_tpu_probe(
+    config: ClusterConfig,
+    run_quiet: run_mod.RunFn = run_mod.run_capture,
+) -> str:
+    """Ready when every node is Ready and the summed allocatable
+    `google.com/tpu` covers the requested chips."""
+    raw = run_quiet(["kubectl", "get", "nodes", "-o", "json"])
+    nodes = json.loads(raw).get("items", [])
+    expected_hosts = config.num_slices * config.hosts_per_slice
+    tpu_nodes = [
+        n
+        for n in nodes
+        if "google.com/tpu" in n.get("status", {}).get("allocatable", {})
+    ]
+    if len(tpu_nodes) < expected_hosts:
+        return f"{len(tpu_nodes)}/{expected_hosts} TPU nodes registered"
+    not_ready = [
+        n["metadata"]["name"]
+        for n in tpu_nodes
+        if not _node_is_ready(n)
+    ]
+    if not_ready:
+        return f"nodes not Ready: {', '.join(sorted(not_ready)[:3])}"
+    allocatable = sum(
+        int(n["status"]["allocatable"]["google.com/tpu"]) for n in tpu_nodes
+    )
+    expected_chips = config.num_slices * config.chips_per_slice
+    if allocatable < expected_chips:
+        return f"{allocatable}/{expected_chips} TPU chips allocatable"
+    return ""
+
+
+def _node_is_ready(node: dict) -> bool:
+    for cond in node.get("status", {}).get("conditions", []):
+        if cond.get("type") == "Ready":
+            return cond.get("status") == "True"
+    return False
+
+
+# --------------------------------------------------------------- tpu-vm mode
+
+
+def tpu_vm_probe(
+    config: ClusterConfig,
+    slice_names: list[str],
+    run_quiet: run_mod.RunFn = run_mod.run_capture,
+) -> str:
+    """Ready when every slice's Cloud TPU state is READY."""
+    for name in slice_names:
+        raw = run_quiet(
+            [
+                "gcloud",
+                "compute",
+                "tpus",
+                "tpu-vm",
+                "describe",
+                name,
+                f"--zone={config.zone}",
+                "--format=value(state)",
+            ]
+        )
+        state = raw.strip()
+        if state != "READY":
+            return f"slice {name} is {state or 'UNKNOWN'}"
+    return ""
+
+
+def jax_smoke_command(expected_devices: int) -> str:
+    """The per-host acceptance test: JAX must actually see the chips —
+    "TPU chips usable" != "VM booted" (SURVEY.md §7 readiness semantics).
+    Run via `gcloud compute tpus tpu-vm ssh --command=...` or ansible."""
+    return (
+        "python3 -c \"import jax; n = jax.local_device_count(); "
+        f"assert n == {expected_devices}, "
+        f"f'expected {expected_devices} TPU devices, saw {{n}}'; "
+        "print(f'JAX OK: {n} devices')\""
+    )

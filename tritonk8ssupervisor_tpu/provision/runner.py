@@ -1,0 +1,81 @@
+"""Streaming subprocess execution shared by the terraform/ansible/kubectl
+drivers.
+
+The reference ran child tools inline in the shell with `set -o errexit`
+(setup.sh:3-4) so a non-zero exit aborted the run. `run_streaming` keeps
+that contract (raise on failure) while letting tests substitute a recording
+fake.
+"""
+
+from __future__ import annotations
+
+import subprocess
+from pathlib import Path
+from typing import Callable, Sequence
+
+
+class CommandError(RuntimeError):
+    def __init__(self, args: Sequence[str], returncode: int, tail: str = ""):
+        self.args_run = list(args)
+        self.returncode = returncode
+        super().__init__(
+            f"command failed ({returncode}): {' '.join(args)}"
+            + (f"\n{tail}" if tail else "")
+        )
+
+
+# Signature shared by the real runner and test fakes: returns captured
+# stdout (streamed live too, like the reference's inline terraform output).
+RunFn = Callable[..., str]
+
+
+def run_streaming(
+    args: Sequence[str],
+    cwd: Path | None = None,
+    env: dict | None = None,
+    echo: Callable[[str], None] = lambda line: print(line, flush=True),
+) -> str:
+    try:
+        proc = subprocess.Popen(
+            list(args),
+            cwd=str(cwd) if cwd else None,
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+    except OSError as e:
+        # missing binary / missing cwd -> same friendly path as a failure
+        raise CommandError(args, 127, tail=str(e)) from e
+    captured: list[str] = []
+    assert proc.stdout is not None
+    for line in proc.stdout:
+        line = line.rstrip("\n")
+        captured.append(line)
+        echo(line)
+    proc.wait()
+    output = "\n".join(captured)
+    if proc.returncode != 0:
+        raise CommandError(args, proc.returncode, tail="\n".join(captured[-20:]))
+    return output
+
+
+def run_capture(
+    args: Sequence[str],
+    cwd: Path | None = None,
+    env: dict | None = None,
+) -> str:
+    """Quiet variant for machine-read output (terraform output -json etc.)."""
+    try:
+        proc = subprocess.run(
+            list(args),
+            cwd=str(cwd) if cwd else None,
+            env=env,
+            capture_output=True,
+            text=True,
+        )
+    except OSError as e:
+        raise CommandError(args, 127, tail=str(e)) from e
+    if proc.returncode != 0:
+        raise CommandError(args, proc.returncode, tail=proc.stderr[-2000:])
+    return proc.stdout

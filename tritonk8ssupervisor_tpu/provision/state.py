@@ -1,0 +1,105 @@
+"""On-disk layout of generated artifacts and runtime state.
+
+The reference's resume-after-crash property came from files-as-phase-contract:
+`config` (setup.sh:199-208), generated `rancher.tf` + tfstate (skip-if-present,
+setup.sh:139-143), `masters.ip`/`hosts.ip` (terraform local-exec,
+terraform/master/main.tf:29-31), the Ansible inventory/vars
+(setup.sh:116-137), and `kubernetes_environment.id`
+(ranchermaster/tasks/main.yml:51-52). This module centralises the same
+contract so every phase — and the teardown scrub (cleanRunner,
+setup.sh:509-513) — agrees on what lives where.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from pathlib import Path
+
+
+@dataclasses.dataclass(frozen=True)
+class RunPaths:
+    """All paths the pipeline reads/writes, rooted at the repo checkout."""
+
+    root: Path
+
+    @property
+    def config_file(self) -> Path:
+        # the reference `config` file (setup.sh:199-208)
+        return self.root / "config"
+
+    @property
+    def terraform_dir(self) -> Path:
+        return self.root / "terraform"
+
+    def terraform_module(self, mode: str) -> Path:
+        # static module dirs (no generated rancher.tf analogue)
+        return self.terraform_dir / mode
+
+    def tfvars(self, mode: str) -> Path:
+        return self.terraform_module(mode) / "terraform.tfvars.json"
+
+    def tfstate(self, mode: str) -> Path:
+        return self.terraform_module(mode) / "terraform.tfstate"
+
+    @property
+    def hosts_file(self) -> Path:
+        # masters.ip / hosts.ip analogue, one JSON file instead of two
+        return self.terraform_dir / "hosts.json"
+
+    @property
+    def ansible_dir(self) -> Path:
+        return self.root / "ansible"
+
+    @property
+    def inventory(self) -> Path:
+        return self.ansible_dir / "hosts"
+
+    @property
+    def ansible_cfg(self) -> Path:
+        return self.ansible_dir / "ansible.cfg"
+
+    @property
+    def manifests_dir(self) -> Path:
+        return self.root / "manifests" / "generated"
+
+    @property
+    def runlog(self) -> Path:
+        return self.root / "runlog.jsonl"
+
+
+@dataclasses.dataclass
+class ClusterHosts:
+    """Provisioned endpoints — what terraform's local-exec used to append to
+    masters.ip/hosts.ip (terraform/master/main.tf:29-31)."""
+
+    # per-slice list of worker host IPs (tpu-vm mode); flat for gke nodes
+    host_ips: list  # list[list[str]]
+    coordinator_ip: str = ""  # first host of slice 0 (the "master" analogue)
+    gke_endpoint: str = ""  # gke mode: cluster control-plane endpoint
+
+    @property
+    def flat_ips(self) -> list[str]:
+        return [ip for slice_ips in self.host_ips for ip in slice_ips]
+
+    def save(self, path: Path) -> None:
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(json.dumps(dataclasses.asdict(self), indent=2) + "\n")
+
+    @classmethod
+    def load(cls, path: Path) -> "ClusterHosts":
+        return cls(**json.loads(path.read_text()))
+
+
+class MissingStateError(RuntimeError):
+    """A phase's input file is absent — the analogue of the reference's
+    missing-ip-file abort (setup.sh:117-120)."""
+
+
+def load_hosts(paths: RunPaths) -> ClusterHosts:
+    if not paths.hosts_file.exists():
+        raise MissingStateError(
+            f"{paths.hosts_file} missing — terraform did not record any "
+            "hosts; the apply likely failed (check quota / API errors) "
+        )
+    return ClusterHosts.load(paths.hosts_file)

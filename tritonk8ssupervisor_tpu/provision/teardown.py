@@ -1,0 +1,92 @@
+"""Teardown: destroy infrastructure and scrub all generated state.
+
+Rebuild of `cleanRunner` (reference setup.sh:484-521): list the doomed
+resources and confirm (487-497), `terraform destroy` (498-503), scrub SSH
+known_hosts per IP (504-508), then delete every generated artifact so the
+next run starts clean (509-513) and reset the ansible.cfg key path (511).
+"""
+
+from __future__ import annotations
+
+import shutil
+
+from tritonk8ssupervisor_tpu.cli.io import Prompter
+from tritonk8ssupervisor_tpu.config.schema import ClusterConfig
+from tritonk8ssupervisor_tpu.provision import ansible as ansible_mod
+from tritonk8ssupervisor_tpu.provision import runner as run_mod
+from tritonk8ssupervisor_tpu.provision import terraform as terraform_mod
+from tritonk8ssupervisor_tpu.provision.state import ClusterHosts, RunPaths
+
+
+def clean(
+    config: ClusterConfig,
+    paths: RunPaths,
+    prompter: Prompter,
+    run: run_mod.RunFn = run_mod.run_streaming,
+    assume_yes: bool = False,
+) -> bool:
+    """Returns True when teardown ran, False when the user aborted."""
+    doomed = _describe_doomed(config, paths)
+    prompter.say("The following resources will be DESTROYED:")
+    for line in doomed:
+        prompter.say(f"  - {line}")
+    if not assume_yes and not prompter.confirm("Destroy and remove all state?"):
+        prompter.say("Aborted; nothing was changed.")
+        return False
+
+    terraform_mod.destroy(config, paths, run)
+    _scrub_known_hosts(paths, run)
+    _remove_generated_state(config, paths)
+    prompter.say("Clean. Re-run ./setup.sh to provision again.")
+    return True
+
+
+def _describe_doomed(config: ClusterConfig, paths: RunPaths) -> list[str]:
+    """The doomed-VM listing (setup.sh:487-491), from recorded state."""
+    lines = [
+        f"{config.mode} deployment in project {config.project} "
+        f"(zone {config.zone})"
+    ]
+    if paths.hosts_file.exists():
+        hosts = ClusterHosts.load(paths.hosts_file)
+        for ip in hosts.flat_ips:
+            lines.append(f"TPU host {ip}")
+        if hosts.gke_endpoint:
+            lines.append(f"GKE cluster endpoint {hosts.gke_endpoint}")
+    else:
+        lines.append("(no recorded hosts — terraform state only)")
+    return lines
+
+
+def _scrub_known_hosts(paths: RunPaths, run: run_mod.RunFn) -> None:
+    """ssh-keygen -R per host IP (setup.sh:504-508) so re-provisioned VMs
+    with recycled IPs don't trip host-key verification."""
+    if not paths.hosts_file.exists():
+        return
+    hosts = ClusterHosts.load(paths.hosts_file)
+    for ip in hosts.flat_ips:
+        try:
+            run(["ssh-keygen", "-R", ip])
+        except run_mod.CommandError:
+            pass  # absent entries are fine, same as the reference's `|| true`
+
+
+def _remove_generated_state(config: ClusterConfig, paths: RunPaths) -> None:
+    """Delete everything a run generated (setup.sh:509-513)."""
+    for mode in ("tpu-vm", "gke"):
+        for name in (
+            "terraform.tfvars.json",
+            "terraform.tfstate",
+            "terraform.tfstate.backup",
+        ):
+            (paths.terraform_module(mode) / name).unlink(missing_ok=True)
+        shutil.rmtree(
+            paths.terraform_module(mode) / ".terraform", ignore_errors=True
+        )
+    paths.hosts_file.unlink(missing_ok=True)
+    paths.inventory.unlink(missing_ok=True)
+    (paths.ansible_dir / "group_vars" / "all.yml").unlink(missing_ok=True)
+    shutil.rmtree(paths.manifests_dir, ignore_errors=True)
+    paths.config_file.unlink(missing_ok=True)
+    paths.runlog.unlink(missing_ok=True)
+    ansible_mod.reset_private_key(paths.ansible_cfg)

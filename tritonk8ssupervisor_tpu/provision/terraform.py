@@ -1,0 +1,97 @@
+"""Terraform driver.
+
+Rebuild of `runTerraformTasks` (reference setup.sh:138-161) minus its HCL
+code generation: the reference string-concatenated a root module per run
+(`updateTerraformConfig`, setup.sh:162-198); here the modules under
+terraform/{tpu-vm,gke}/ are static HCL with `count` fan-out and all
+per-run data flows through terraform.tfvars.json (config/compile.py).
+
+Phase contract: on success the provisioned endpoints are persisted to
+terraform/hosts.json — the masters.ip/hosts.ip analogue
+(terraform/master/main.tf:29-31) that the ansible layer requires
+(setup.sh:117-120).
+"""
+
+from __future__ import annotations
+
+import json
+
+from tritonk8ssupervisor_tpu.config import compile as compiler
+from tritonk8ssupervisor_tpu.config.schema import ClusterConfig
+from tritonk8ssupervisor_tpu.provision import runner as run_mod
+from tritonk8ssupervisor_tpu.provision.state import ClusterHosts, RunPaths
+
+
+def already_applied(config: ClusterConfig, paths: RunPaths) -> bool:
+    """Skip-if-provisioned idempotency (setup.sh:139-143): a non-empty
+    tfstate means apply already ran; re-running converges via terraform."""
+    state_file = paths.tfstate(config.mode)
+    if not state_file.exists():
+        return False
+    try:
+        state = json.loads(state_file.read_text())
+    except json.JSONDecodeError:
+        return False
+    return bool(state.get("resources"))
+
+
+def apply(
+    config: ClusterConfig,
+    paths: RunPaths,
+    run: run_mod.RunFn = run_mod.run_streaming,
+    run_quiet: run_mod.RunFn = run_mod.run_capture,
+) -> ClusterHosts:
+    """terraform init + apply, then persist endpoints.
+
+    `terraform get && terraform apply` analogue (setup.sh:154-158); output
+    collection replaces the reference's local-exec IP appending.
+    """
+    module_dir = paths.terraform_module(config.mode)
+    compiler.write_tfvars(config, paths.terraform_dir)
+    run(["terraform", "init", "-input=false", "-no-color"], cwd=module_dir)
+    run(
+        ["terraform", "apply", "-auto-approve", "-input=false", "-no-color"],
+        cwd=module_dir,
+    )
+    hosts = collect_outputs(config, paths, run_quiet)
+    hosts.save(paths.hosts_file)
+    return hosts
+
+
+def collect_outputs(
+    config: ClusterConfig,
+    paths: RunPaths,
+    run_quiet: run_mod.RunFn = run_mod.run_capture,
+) -> ClusterHosts:
+    """Read `terraform output -json` into ClusterHosts.
+
+    Expected outputs (declared in terraform/{tpu-vm,gke}/outputs.tf):
+    - tpu-vm: `host_ips` = list (per slice) of lists of worker IPs
+    - gke:    `endpoint` = control-plane endpoint, `node_pool` = name
+    """
+    module_dir = paths.terraform_module(config.mode)
+    raw = run_quiet(["terraform", "output", "-json"], cwd=module_dir)
+    outputs = {k: v.get("value") for k, v in json.loads(raw or "{}").items()}
+    if config.mode == "tpu-vm":
+        host_ips = outputs.get("host_ips") or []
+        coordinator = host_ips[0][0] if host_ips and host_ips[0] else ""
+        return ClusterHosts(host_ips=host_ips, coordinator_ip=coordinator)
+    return ClusterHosts(
+        host_ips=[],
+        gke_endpoint=outputs.get("endpoint") or "",
+    )
+
+
+def destroy(
+    config: ClusterConfig,
+    paths: RunPaths,
+    run: run_mod.RunFn = run_mod.run_streaming,
+) -> None:
+    """`terraform destroy -force` analogue (setup.sh:498-503)."""
+    module_dir = paths.terraform_module(config.mode)
+    if not paths.tfstate(config.mode).exists():
+        return
+    run(
+        ["terraform", "destroy", "-auto-approve", "-input=false", "-no-color"],
+        cwd=module_dir,
+    )

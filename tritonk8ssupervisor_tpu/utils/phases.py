@@ -1,0 +1,108 @@
+"""Phase-timestamped structured logging.
+
+The reference's only run-time observability was echoed banner sections
+(reference setup.sh:33-46) and a progress-dots ticker (setup.sh:62,80); no
+phase was ever timed, so the <15 min wall-clock-to-ready north star could
+not even be measured. Here every pipeline phase is timed and logged twice:
+a human-readable line to stdout and a JSON line to a run log, so the tool
+itself produces the number the benchmark targets (SURVEY.md §5 "Tracing").
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import sys
+import time
+from pathlib import Path
+from typing import Callable, TextIO
+
+
+class PhaseTimer:
+    """Times named pipeline phases and emits structured logs.
+
+    Usage::
+
+        timer = PhaseTimer(logfile=Path("runlog.jsonl"))
+        with timer.phase("terraform"):
+            run_terraform(...)
+        timer.report()   # per-phase + total wall-clock summary
+    """
+
+    def __init__(
+        self,
+        out: TextIO | None = None,
+        logfile: Path | None = None,
+        clock: Callable[[], float] = time.monotonic,
+        wall: Callable[[], float] = time.time,
+    ) -> None:
+        self._out = out if out is not None else sys.stdout
+        self._logfile = logfile
+        self._clock = clock
+        self._wall = wall
+        self.durations: dict[str, float] = {}
+        self._t0 = clock()
+
+    def _emit(self, record: dict) -> None:
+        phase = record["phase"]
+        status = record["status"]
+        if status == "start":
+            line = f"==> {phase}"
+        elif status == "done":
+            line = f"==> {phase} done in {record['seconds']:.1f}s"
+        else:
+            line = f"==> {phase} FAILED after {record['seconds']:.1f}s: {record.get('error', '')}"
+        print(line, file=self._out, flush=True)
+        if self._logfile is not None:
+            with self._logfile.open("a") as f:
+                f.write(json.dumps(record, sort_keys=True) + "\n")
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        start = self._clock()
+        self._emit({"ts": self._wall(), "phase": name, "status": "start"})
+        try:
+            yield
+        except BaseException as e:
+            seconds = self._clock() - start
+            self.durations[name] = self.durations.get(name, 0.0) + seconds
+            self._emit(
+                {
+                    "ts": self._wall(),
+                    "phase": name,
+                    "status": "failed",
+                    "seconds": round(seconds, 3),
+                    "error": str(e),
+                }
+            )
+            raise
+        seconds = self._clock() - start
+        self.durations[name] = self.durations.get(name, 0.0) + seconds
+        self._emit(
+            {
+                "ts": self._wall(),
+                "phase": name,
+                "status": "done",
+                "seconds": round(seconds, 3),
+            }
+        )
+
+    @property
+    def total(self) -> float:
+        """Sum of timed phases — excludes time spent at interactive prompts,
+        which would otherwise corrupt the wall-clock-to-ready metric."""
+        return sum(self.durations.values())
+
+    @property
+    def elapsed(self) -> float:
+        """Clock time since construction, prompts included."""
+        return self._clock() - self._t0
+
+    def report(self) -> None:
+        """Print the per-phase wall-clock table — the measured answer to the
+        reference's unmeasured setup->ready time (SURVEY.md §6)."""
+        print("", file=self._out)
+        print("Phase timing:", file=self._out)
+        for name, seconds in self.durations.items():
+            print(f"  {name:<24} {seconds:8.1f}s", file=self._out)
+        print(f"  {'TOTAL':<24} {self.total:8.1f}s", file=self._out, flush=True)
