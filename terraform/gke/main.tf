@@ -1,0 +1,80 @@
+# GKE cluster with TPU node pools.
+#
+# The control-plane rebuild of the reference's Rancher stack: instead of a
+# master VM running rancher/server + an HTTP API to create a Kubernetes
+# environment and join agents (reference ranchermaster/tasks/main.yml:6-49,
+# rancherhost/tasks/main.yml:26-34), a managed GKE control plane and TPU
+# node pools whose nodes register themselves — the entire L3/L4 node-join
+# machinery becomes declarative.
+#
+# Multi-host slices: a node pool with placement_policy.tpu_topology gives
+# the pool's nodes a single physical slice with ICI between chips; GKE
+# injects the TPU device plugin (google.com/tpu) and topology metadata that
+# the benchmark Job's jax.distributed.initialize consumes
+# (config/compile.py to_benchmark_job).
+
+terraform {
+  required_providers {
+    google = {
+      source  = "hashicorp/google"
+      version = ">= 5.0"
+    }
+  }
+}
+
+provider "google" {
+  project = var.project
+  zone    = var.zone
+}
+
+resource "google_container_cluster" "cluster" {
+  name     = var.cluster_name
+  location = var.zone
+
+  # The default pool only hosts system pods (the master's "everything else"
+  # role in the reference); TPU pools are added per slice below.
+  initial_node_count       = 1
+  remove_default_node_pool = false
+
+  network    = var.network
+  subnetwork = var.subnetwork
+
+  release_channel {
+    channel = "REGULAR"
+  }
+}
+
+resource "google_container_node_pool" "tpu_pool" {
+  count = var.num_slices
+
+  name     = "${var.name_prefix}-${count.index}"
+  cluster  = google_container_cluster.cluster.name
+  location = var.zone
+
+  # All hosts of one slice, scheduled together on one physical slice.
+  node_count = var.nodes_per_slice
+
+  # GKE rejects compact placement / tpu_topology for single-host slice
+  # pools — the chips are already co-located on one machine.
+  dynamic "placement_policy" {
+    for_each = var.nodes_per_slice > 1 ? [1] : []
+    content {
+      type         = "COMPACT"
+      tpu_topology = var.tpu_topology
+    }
+  }
+
+  node_config {
+    machine_type = var.machine_type
+
+    # GKE reserves google.com/tpu on these nodes; workloads request chips
+    # the way the reference's docs deployed workloads onto joined nodes
+    # (reference docs/detailed.md:255-371).
+    labels = {
+      role  = "tpu-worker"
+      slice = tostring(count.index)
+    }
+
+    oauth_scopes = ["https://www.googleapis.com/auth/cloud-platform"]
+  }
+}

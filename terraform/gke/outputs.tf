@@ -1,0 +1,21 @@
+# Outputs read by provision/terraform.py collect_outputs (the
+# masters.ip/hosts.ip analogue, reference terraform/master/main.tf:29-31).
+
+output "endpoint" {
+  description = "GKE control-plane endpoint"
+  value       = google_container_cluster.cluster.endpoint
+}
+
+output "cluster_name" {
+  value = google_container_cluster.cluster.name
+}
+
+output "node_pools" {
+  description = "TPU node pool names, one per slice"
+  value       = [for pool in google_container_node_pool.tpu_pool : pool.name]
+}
+
+output "get_credentials_command" {
+  description = "The kubeconfig command of record (the dashboard/kubectl URL banner analogue, reference setup.sh:88-89)"
+  value       = "gcloud container clusters get-credentials ${google_container_cluster.cluster.name} --zone ${var.zone} --project ${var.project}"
+}

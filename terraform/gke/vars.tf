@@ -1,0 +1,63 @@
+# Variables for the GKE cluster + TPU node-pool module.
+#
+# Parity map to the reference's master module vars (reference
+# terraform/master/vars.tf:1-23): the Rancher master VM becomes a managed
+# GKE control plane; the worker "package" menu becomes machine_type +
+# tpu_topology. Values arrive via terraform.tfvars.json
+# (config/compile.py to_tfvars, gke branch).
+
+variable "project" {
+  type        = string
+  description = "GCP project to provision into"
+}
+
+variable "zone" {
+  type        = string
+  description = "Zone with TPU capacity"
+}
+
+variable "cluster_name" {
+  type        = string
+  default     = "tpu-dev"
+  description = "GKE cluster name (the master hostname analogue, reference setup.sh:274-283)"
+}
+
+variable "name_prefix" {
+  type        = string
+  default     = "tpunode"
+  description = "TPU node-pool name prefix (the node-prefix analogue, reference setup.sh:286-295)"
+}
+
+variable "num_slices" {
+  type        = number
+  default     = 1
+  description = "TPU node pools (one per slice), 1-9 wizard-capped (reference setup.sh:297-307)"
+}
+
+variable "machine_type" {
+  type        = string
+  description = "TPU machine type packing the slice's chips-per-host, e.g. ct5lp-hightpu-8t"
+}
+
+variable "tpu_topology" {
+  type        = string
+  description = "Physical slice topology, e.g. 4x4 (drives GKE placement)"
+}
+
+variable "nodes_per_slice" {
+  type        = number
+  default     = 1
+  description = "TPU VM hosts backing each slice (topology chips / chips-per-host)"
+}
+
+variable "network" {
+  type        = string
+  default     = "default"
+  description = "VPC network"
+}
+
+variable "subnetwork" {
+  type        = string
+  default     = "default"
+  description = "VPC subnetwork"
+}

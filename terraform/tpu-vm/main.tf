@@ -1,0 +1,50 @@
+# Standalone Cloud TPU VM slices.
+#
+# The TPU-native rebuild of the reference's per-VM Triton module
+# (reference terraform/host/main.tf:1-36). What changes and why:
+#  - `triton_machine` KVM -> `google_tpu_v2_vm`: one resource is a whole
+#    pod slice (possibly many hosts), not a single VM.
+#  - the remote-exec bootstrap (sleep 30 + root key copy + python install,
+#    reference terraform/master/main.tf:13-27) is gone: TPU runtime images
+#    ship python3, and SSH keys come from project metadata.
+#  - the local-exec IP-file append (reference terraform/master/main.tf:29-31)
+#    is replaced by declared outputs (outputs.tf) read via
+#    `terraform output -json` (provision/terraform.py).
+
+terraform {
+  required_providers {
+    google = {
+      source  = "hashicorp/google"
+      version = ">= 5.0"
+    }
+  }
+}
+
+provider "google" {
+  project = var.project
+  zone    = var.zone
+}
+
+resource "google_tpu_v2_vm" "slice" {
+  count = var.num_slices
+
+  # Names match the readiness prober's expectation (provision/readiness.py
+  # polls `gcloud compute tpus tpu-vm describe <name_prefix>-<i>`).
+  name             = "${var.name_prefix}-${count.index}"
+  zone             = var.zone
+  accelerator_type = var.accelerator_type
+  runtime_version  = var.runtime_version
+
+  network_config {
+    network            = var.network
+    subnetwork         = var.subnetwork
+    enable_external_ips = true
+  }
+
+  # Same operator-facing tags idea as the reference's duplicated tags
+  # blocks (terraform/host/main.tf:6-8,33-35), minus the duplication.
+  labels = {
+    role  = "tpu-worker"
+    slice = tostring(count.index)
+  }
+}

@@ -1,0 +1,29 @@
+# Declared outputs replace the reference's local-exec appends to
+# masters.ip / hosts.ip (reference terraform/master/main.tf:29-31,
+# terraform/host/main.tf:29-31). provision/terraform.py persists these to
+# terraform/hosts.json — the phase contract the ansible layer requires
+# (reference setup.sh:117-120).
+
+output "host_ips" {
+  description = "Per-slice list of worker host external IPs (Ansible inventory source)"
+  value = [
+    for slice in google_tpu_v2_vm.slice : [
+      for endpoint in slice.network_endpoints :
+      endpoint.access_config[0].external_ip
+    ]
+  ]
+}
+
+output "internal_ips" {
+  description = "Per-slice list of worker host internal IPs (coordinator address source)"
+  value = [
+    for slice in google_tpu_v2_vm.slice : [
+      for endpoint in slice.network_endpoints : endpoint.ip_address
+    ]
+  ]
+}
+
+output "slice_names" {
+  description = "Cloud TPU resource names, one per slice"
+  value       = [for slice in google_tpu_v2_vm.slice : slice.name]
+}

@@ -1,0 +1,57 @@
+# Variables for the standalone Cloud TPU VM slice module.
+#
+# Parity map to the reference's host module vars (reference
+# terraform/host/vars.tf:1-23): hostname -> name_prefix (+ count fan-out),
+# networks -> network/subnetwork, image -> runtime_version,
+# package -> accelerator_type, root_authorized_keys -> (GCP project SSH
+# metadata; no per-VM key injection needed).
+#
+# Unlike the reference — which code-generated one module block per VM in
+# bash (setup.sh:148-152) — fan-out lives in HCL `count`, driven by
+# num_slices from terraform.tfvars.json (config/compile.py).
+
+variable "project" {
+  type        = string
+  description = "GCP project to provision into"
+}
+
+variable "zone" {
+  type        = string
+  description = "Zone with TPU capacity (validated by the wizard catalog)"
+}
+
+variable "name_prefix" {
+  type        = string
+  default     = "tpunode"
+  description = "Slice VM name prefix; slices are <prefix>-0..N-1"
+}
+
+variable "num_slices" {
+  type        = number
+  default     = 1
+  description = "Independent TPU slices to provision (1-9, wizard-capped)"
+}
+
+variable "accelerator_type" {
+  type        = string
+  default     = "v5litepod-4"
+  description = "Cloud TPU accelerator type, e.g. v5litepod-16 / v4-8"
+}
+
+variable "runtime_version" {
+  type        = string
+  default     = "v2-alpha-tpuv5-lite"
+  description = "TPU VM software version (the pinned-docker-engine analogue, reference dockersetup/tasks/main.yml:42-46)"
+}
+
+variable "network" {
+  type        = string
+  default     = "default"
+  description = "VPC network (the Joyent-SDC-Public default analogue, reference setup.sh:309-400)"
+}
+
+variable "subnetwork" {
+  type        = string
+  default     = "default"
+  description = "VPC subnetwork"
+}

@@ -35,24 +35,30 @@ def test_write_tfvars(tmp_path):
     assert data["project"] == "my-proj"
 
 
-def test_inventory():
-    inv = cc.to_inventory(cfg(), ["10.0.0.1", "10.0.0.2"])
+def test_inventory_per_slice_coordinators():
+    inv = cc.to_inventory(cfg(), [["10.0.0.1", "10.0.0.2"], ["10.0.1.1"]])
     assert "[TPUHOST]" in inv
-    assert "10.0.0.1\n10.0.0.2" in inv
+    # each host carries its slice's coordinator, not a global one
+    assert "10.0.0.1 slice_index=0 process_id=0 slice_coordinator=10.0.0.1" in inv
+    assert "10.0.0.2 slice_index=0 process_id=1 slice_coordinator=10.0.0.1" in inv
+    assert "10.0.1.1 slice_index=1 process_id=0 slice_coordinator=10.0.1.1" in inv
     assert "ansible_user=root" in inv
     assert "localhost ansible_connection=local" in inv
 
 
 def test_ansible_vars():
-    v = cc.to_ansible_vars(cfg(), coordinator_ip="10.0.0.1")
+    v = cc.to_ansible_vars(cfg(num_slices=2), coordinator_ip="10.0.0.1")
     assert v["coordinator"] == "10.0.0.1"
     assert v["expected_devices_per_host"] == 8
     assert v["hosts_per_slice"] == 2
+    assert v["num_slices"] == 2
+    assert v["expected_total_chips"] == 32
     assert v["accelerator_type"] == "v5litepod-16"
+    assert "jax.local_device_count()" in v["jax_smoke_cmd"]
 
 
 def test_write_ansible_configs(tmp_path):
-    cc.write_ansible_configs(cfg(), ["10.0.0.1"], tmp_path, coordinator_ip="10.0.0.1")
+    cc.write_ansible_configs(cfg(), [["10.0.0.1"]], tmp_path, coordinator_ip="10.0.0.1")
     assert (tmp_path / "hosts").exists()
     vars_yml = yaml.safe_load((tmp_path / "group_vars" / "all.yml").read_text())
     assert vars_yml["coordinator"] == "10.0.0.1"

@@ -1,0 +1,160 @@
+"""Infrastructure-as-data consistency tests: the generated tfvars must match
+the static HCL modules' declared variables, the generated ansible vars must
+cover what the roles consume, and the playbook must target the generated
+inventory groups. The reference had no such checks — its bash codegen and
+hand-written HCL could drift silently (SURVEY.md §4)."""
+
+import re
+import shutil
+import subprocess
+from pathlib import Path
+
+import pytest
+import yaml
+
+from tritonk8ssupervisor_tpu.config import compile as cc
+from tritonk8ssupervisor_tpu.config.schema import ClusterConfig
+
+REPO = Path(__file__).resolve().parent.parent
+
+_VARIABLE_RE = re.compile(r'^variable\s+"([^"]+)"', re.MULTILINE)
+
+
+def declared_variables(mode: str) -> set[str]:
+    text = (REPO / "terraform" / mode / "vars.tf").read_text()
+    return set(_VARIABLE_RE.findall(text))
+
+
+def cfg(**overrides):
+    base = dict(project="p", zone="us-west4-a", generation="v5e", topology="4x4")
+    base.update(overrides)
+    return ClusterConfig(**base)
+
+
+# ----------------------------------------------------------------- terraform
+
+
+@pytest.mark.parametrize("mode", ["tpu-vm", "gke"])
+def test_tfvars_keys_match_declared_variables(mode):
+    tfvars = set(cc.to_tfvars(cfg(mode=mode)))
+    declared = declared_variables(mode)
+    assert tfvars == declared, (
+        f"tfvars/{mode} drift: compiler emits {sorted(tfvars - declared)} "
+        f"undeclared; module declares {sorted(declared - tfvars)} unfed"
+    )
+
+
+def test_tpu_vm_resource_names_match_readiness_prober():
+    """provision/readiness.py polls `describe <name_prefix>-<i>`; the HCL
+    must name resources identically."""
+    main_tf = (REPO / "terraform" / "tpu-vm" / "main.tf").read_text()
+    assert '"${var.name_prefix}-${count.index}"' in main_tf
+
+
+def test_terraform_outputs_match_collector():
+    """provision/terraform.py collect_outputs reads host_ips / endpoint."""
+    assert 'output "host_ips"' in (REPO / "terraform" / "tpu-vm" / "outputs.tf").read_text()
+    assert 'output "endpoint"' in (REPO / "terraform" / "gke" / "outputs.tf").read_text()
+
+
+@pytest.mark.skipif(shutil.which("terraform") is None, reason="terraform not installed")
+@pytest.mark.parametrize("mode", ["tpu-vm", "gke"])
+def test_terraform_validate(mode, tmp_path):
+    module = tmp_path / mode
+    shutil.copytree(REPO / "terraform" / mode, module)
+    subprocess.run(["terraform", "init", "-backend=false"], cwd=module, check=True,
+                   capture_output=True)
+    proc = subprocess.run(["terraform", "validate"], cwd=module,
+                          capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# ------------------------------------------------------------------- ansible
+
+
+def load_yaml(relpath: str):
+    return yaml.safe_load((REPO / relpath).read_text())
+
+
+def test_playbook_targets_generated_inventory_groups():
+    plays = load_yaml("ansible/clusterUp.yml")
+    targets = [p["hosts"] for p in plays]
+    assert targets == ["TPUHOST", "LOCAL"]
+    inventory = cc.to_inventory(cfg(), ["10.0.0.1"])
+    for group in targets:
+        assert f"[{group}]" in inventory or group == "LOCAL" and "localhost" in inventory
+    roles = [role for p in plays for role in p["roles"]]
+    assert roles == ["tpuhost", "gkejoin"]
+
+
+def test_tpuhost_role_structure():
+    tasks = load_yaml("ansible/roles/tpuhost/tasks/main.yml")
+    names = [t["name"] for t in tasks]
+    # probe -> install -> env handoff -> acceptance test, mirroring
+    # dockersetup's probe->install shape plus the §7 readiness hard part
+    assert any("Probe" in n for n in names)
+    assert any("Install JAX" in n for n in names)
+    assert any("coordination environment" in n for n in names)
+    assert any("Verify JAX" in n for n in names)
+    smoke = next(t for t in tasks if "Verify JAX" in t["name"])
+    assert smoke["retries"] == 5  # bounded retry, not unbounded poll
+    install = next(t for t in tasks if "Install JAX" in t["name"])
+    assert "jax_version" in install["when"]  # idempotency gate actually gates
+
+
+def test_gkejoin_role_structure():
+    tasks = load_yaml("ansible/roles/gkejoin/tasks/main.yml")
+    names = [t["name"] for t in tasks]
+    assert any("credentials" in n for n in names)
+    wait = next(t for t in tasks if "node registration" in t["name"])
+    # the 30 x 10 s bounded poll, same budget as the reference's Rancher
+    # startup wait (ranchermaster/tasks/main.yml:17-19)
+    assert wait["retries"] == 30 and wait["delay"] == 10
+
+
+def test_generated_vars_cover_role_consumption():
+    """Every templated var the roles consume must come from the generated
+    group_vars/all.yml, the generated inventory hostvars, or the role
+    defaults — and per-cluster values must come from the GENERATOR, not
+    defaults (a default would silently freeze them at one-cluster shape)."""
+    generated = set(cc.to_ansible_vars(cfg(), coordinator_ip="10.0.0.1"))
+    inventory = cc.to_inventory(cfg(), [["10.0.0.1", "10.0.0.2"]])
+    hostvars = set(re.findall(r"(\w+)=", inventory))
+    provided = set(generated) | hostvars
+    defaults: set = set()
+    for role in ("tpuhost", "gkejoin"):
+        defaults |= set(load_yaml(f"ansible/roles/{role}/defaults/main.yml") or {})
+    consumed = set()
+    for role in ("tpuhost", "gkejoin"):
+        text = (REPO / "ansible" / "roles" / role / "tasks" / "main.yml").read_text()
+        consumed |= set(re.findall(r"{{\s*(\w+)", text))
+        consumed |= set(re.findall(r"when: (\w+)\s*==", text))
+        consumed |= set(re.findall(r"when: \((\w+)", text))
+        consumed |= set(re.findall(r"until: \((\w+)", text))
+    # registered task results are task-local, not vars
+    consumed -= {"jax_installed", "jax_install", "jax_smoke", "tpu_alloc", "n"}
+    missing = consumed - provided - defaults
+    assert not missing, f"roles consume undeclared vars: {sorted(missing)}"
+    # per-cluster values the roles rely on must be generator-supplied
+    per_cluster = {"hosts_per_slice", "num_slices", "expected_total_chips",
+                   "expected_devices_per_host", "cluster_name", "project",
+                   "zone", "mode", "jax_smoke_cmd"}
+    assert per_cluster <= generated, sorted(per_cluster - generated)
+
+
+def test_ansible_cfg_contract():
+    text = (REPO / "ansible" / "ansible.cfg").read_text()
+    assert "host_key_checking = False" in text
+    assert re.search(r"^private_key_file =\s*$", text, re.MULTILINE)
+
+
+@pytest.mark.skipif(shutil.which("ansible-playbook") is None,
+                    reason="ansible not installed")
+def test_playbook_syntax_check(tmp_path):
+    inv = tmp_path / "hosts"
+    inv.write_text(cc.to_inventory(cfg(), ["10.0.0.1"]))
+    proc = subprocess.run(
+        ["ansible-playbook", "-i", str(inv), "--syntax-check", "clusterUp.yml"],
+        cwd=REPO / "ansible", capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr

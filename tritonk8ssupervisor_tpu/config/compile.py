@@ -62,14 +62,27 @@ def write_tfvars(config: ClusterConfig, terraform_dir: Path) -> Path:
 # ------------------------------------------------------------------ ansible
 
 
-def to_inventory(config: ClusterConfig, host_ips: list[str]) -> str:
+def to_inventory(config: ClusterConfig, slice_ips: list[list[str]]) -> str:
     """INI inventory, the analogue of the [MASTER]/[HOST] groups the
-    reference built from masters.ip/hosts.ip (setup.sh:123-126). The
-    [LOCAL] group hosts the gkejoin play, which drives gcloud/kubectl from
-    the control machine (the ranchermaster local_action analogue,
+    reference built from masters.ip/hosts.ip (setup.sh:123-126).
+
+    `slice_ips` is per-slice (terraform output shape): each host line
+    carries its slice index, its position in the slice, and its slice's
+    coordinator (the slice's first host) as inventory hostvars — each TPU
+    slice is an independent JAX cluster, so the coordinator handoff
+    (reference rancherhost registrationUrl, rancherhost/tasks/main.yml:19-24)
+    must be per-slice, not global.
+
+    The [LOCAL] group hosts the gkejoin play, which drives gcloud/kubectl
+    from the control machine (the ranchermaster local_action analogue,
     ranchermaster/tasks/main.yml:51-52)."""
     lines = ["[TPUHOST]"]
-    lines += host_ips
+    for slice_index, ips in enumerate(slice_ips):
+        for process_id, ip in enumerate(ips):
+            lines.append(
+                f"{ip} slice_index={slice_index} process_id={process_id} "
+                f"slice_coordinator={ips[0]}"
+            )
     lines += [
         "",
         "[TPUHOST:vars]",
@@ -86,6 +99,7 @@ def to_inventory(config: ClusterConfig, host_ips: list[str]) -> str:
 def to_ansible_vars(config: ClusterConfig, coordinator_ip: str = "") -> dict:
     """vars.yml analogue (reference setup.sh:128-131 wrote master IP + env
     name/description for the ranchermaster role)."""
+    expected_per_host = config.spec.chips_on_host(config.parsed_topology)
     return {
         "coordinator": coordinator_ip,
         "kubernetes_name": config.env_name,
@@ -93,8 +107,13 @@ def to_ansible_vars(config: ClusterConfig, coordinator_ip: str = "") -> dict:
         "tpu_generation": config.generation,
         "accelerator_type": config.accelerator_type,
         "runtime_version": config.effective_runtime_version,
-        "expected_devices_per_host": config.spec.chips_on_host(config.parsed_topology),
+        "expected_devices_per_host": expected_per_host,
         "hosts_per_slice": config.hosts_per_slice,
+        "num_slices": config.num_slices,
+        "expected_total_chips": config.num_slices * config.chips_per_slice,
+        # one definition of the acceptance test for both the ansible role
+        # and the SSH readiness path (provision/readiness.py)
+        "jax_smoke_cmd": jax_smoke_command(expected_per_host),
         "project": config.project,
         "zone": config.zone,
         "cluster_name": config.cluster_name,
@@ -102,14 +121,30 @@ def to_ansible_vars(config: ClusterConfig, coordinator_ip: str = "") -> dict:
     }
 
 
+def jax_smoke_command(expected_devices: int) -> str:
+    """The per-host acceptance test: JAX must actually see the chips —
+    "TPU chips usable" != "VM booted" (SURVEY.md §7 readiness semantics).
+    Shared by the tpuhost ansible role (via to_ansible_vars) and the
+    tpu-vm SSH readiness path (provision/readiness.py)."""
+    return (
+        "python3 -c \"import jax; n = jax.local_device_count(); "
+        f"assert n == {expected_devices}, "
+        f"f'expected {expected_devices} TPU devices, saw {{n}}'; "
+        "print(f'JAX OK: {n} devices')\""
+    )
+
+
 def write_ansible_configs(
-    config: ClusterConfig, host_ips: list[str], ansible_dir: Path, coordinator_ip: str = ""
+    config: ClusterConfig,
+    slice_ips: list[list[str]],
+    ansible_dir: Path,
+    coordinator_ip: str = "",
 ) -> None:
     """Generated vars go to group_vars/all.yml so every play sees them (the
     reference funnelled one vars.yml into each play via vars_files,
     clusterUp.yml:12,22)."""
     ansible_dir.mkdir(parents=True, exist_ok=True)
-    (ansible_dir / "hosts").write_text(to_inventory(config, host_ips))
+    (ansible_dir / "hosts").write_text(to_inventory(config, slice_ips))
     vars_dir = ansible_dir / "group_vars"
     vars_dir.mkdir(parents=True, exist_ok=True)
     (vars_dir / "all.yml").write_text(

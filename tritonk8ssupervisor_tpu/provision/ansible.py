@@ -45,7 +45,7 @@ def write_runtime_configs(
 ) -> None:
     compiler.write_ansible_configs(
         config,
-        hosts.flat_ips,
+        hosts.host_ips,
         paths.ansible_dir,
         coordinator_ip=hosts.coordinator_ip,
     )

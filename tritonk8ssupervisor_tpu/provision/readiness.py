@@ -122,13 +122,6 @@ def tpu_vm_probe(
     return ""
 
 
-def jax_smoke_command(expected_devices: int) -> str:
-    """The per-host acceptance test: JAX must actually see the chips —
-    "TPU chips usable" != "VM booted" (SURVEY.md §7 readiness semantics).
-    Run via `gcloud compute tpus tpu-vm ssh --command=...` or ansible."""
-    return (
-        "python3 -c \"import jax; n = jax.local_device_count(); "
-        f"assert n == {expected_devices}, "
-        f"f'expected {expected_devices} TPU devices, saw {{n}}'; "
-        "print(f'JAX OK: {n} devices')\""
-    )
+# One definition of the per-host acceptance test, shared with the tpuhost
+# ansible role via to_ansible_vars (config/compile.py).
+from tritonk8ssupervisor_tpu.config.compile import jax_smoke_command  # noqa: E402,F401
