@@ -1,0 +1,66 @@
+#!/usr/bin/env python3
+"""Driver benchmark entrypoint: ONE JSON line on stdout.
+
+Runs the flagship ResNet-50 training benchmark (BASELINE.json metric:
+images/sec/chip) on whatever accelerator is present — the real TPU chip
+under the driver, the virtual CPU mesh in CI.
+
+vs_baseline is measured against the target recorded in BASELINE.md:
+1000 images/sec/chip for ResNet-50 bf16 on a v5e chip (the reference
+repo publishes no accelerator numbers — SURVEY.md §6 — so the target is
+the public ballpark for this chip generation, recorded up front so every
+round is comparable).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+# images/sec/chip target for ResNet-50 bf16 on TPU v5e (see BASELINE.md)
+TPU_BASELINE_IMG_S_CHIP = 1000.0
+
+
+def main() -> int:
+    import jax
+
+    on_tpu = jax.default_backend() not in ("cpu",)
+    from tritonk8ssupervisor_tpu.benchmarks.resnet50 import run_benchmark
+
+    if on_tpu:
+        result = run_benchmark(
+            model_name="resnet50",
+            batch_per_chip=256,
+            image_size=224,
+            steps=20,
+            warmup=5,
+        )
+    else:
+        # CPU smoke: tiny shapes, same code path end to end
+        result = run_benchmark(
+            model_name="resnet18",
+            batch_per_chip=8,
+            image_size=64,
+            num_classes=100,
+            steps=3,
+            warmup=1,
+        )
+
+    value = result["images_per_sec_per_chip"]
+    record = {
+        "metric": f"{result['model']}_images_per_sec_per_chip",
+        "value": round(value, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(value / TPU_BASELINE_IMG_S_CHIP, 4),
+        # context fields (driver reads the four above; humans read these)
+        "platform": result["platform"],
+        "num_chips": result["num_chips"],
+        "global_batch": result["global_batch"],
+        "step_ms": round(result["step_ms"], 2),
+    }
+    print(json.dumps(record, sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

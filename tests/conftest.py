@@ -1,11 +1,27 @@
 """Test harness: force JAX onto an 8-device virtual CPU mesh so multi-chip
-sharding logic runs without TPU quota (SURVEY.md §4 test strategy)."""
+sharding logic runs without TPU quota (SURVEY.md §4 test strategy).
+
+The dev image's sitecustomize registers and initialises the axon TPU
+backend at interpreter startup — before this conftest runs — so setting
+env vars is not enough: the already-initialised backend must be cleared
+and the platform re-pinned through jax.config.
+"""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+if jax.default_backend() != "cpu" or jax.device_count() != 8:
+    import jax.extend.backend as _jeb
+
+    _jeb.clear_backends()
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 8)
+    assert jax.default_backend() == "cpu" and jax.device_count() == 8

@@ -1,0 +1,52 @@
+"""Benchmark harness units (the heavy throughput path is covered by the CLI
+drive in bench.py / the driver; these pin the arithmetic and parity
+workloads)."""
+
+import hashlib
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+from tritonk8ssupervisor_tpu.benchmarks import containerbench
+
+
+def test_disk_benchmark_counts_bytes(tmp_path):
+    result = containerbench.disk_benchmark(tmp_path / "blob", total_bytes=1 << 20)
+    assert result["bytes"] == 1 << 20
+    assert result["mb_per_sec"] > 0
+    assert not (tmp_path / "blob").exists()  # cleans up after itself
+
+
+def test_cpu_benchmark_hashes_exact_byte_count():
+    # odd sizes must hash exactly `bytes` (throughput honesty)
+    r8 = containerbench.cpu_benchmark(total_bytes=16)
+    odd = containerbench.cpu_benchmark(total_bytes=13)
+    assert odd["bytes"] == 13
+    # deterministic: same seed, same digest
+    again = containerbench.cpu_benchmark(total_bytes=13)
+    assert odd["md5"] == again["md5"]
+    assert odd["md5"] != r8["md5"]
+    # verify digest equals hashing the truncated stream manually
+    rng = 0
+    data = b""
+    remaining = 13
+    while remaining > 0:
+        n = min(4 << 20, remaining)
+        rng = (rng * 6364136223846793005 + 1442695040888963407) & (2**64 - 1)
+        data += (rng.to_bytes(8, "little") * ((n + 7) // 8))[:n]
+        remaining -= n
+    assert odd["md5"] == hashlib.md5(data).hexdigest()
+
+
+def test_containerbench_cli_json(tmp_path):
+    proc = subprocess.run(
+        [sys.executable, "-m", "tritonk8ssupervisor_tpu.benchmarks.containerbench",
+         "--disk-bytes", "1048576", "--cpu-bytes", "1048576",
+         "--workdir", str(tmp_path), "--json"],
+        capture_output=True, text=True, timeout=120,
+        cwd=Path(__file__).resolve().parent.parent,
+    )
+    assert proc.returncode == 0, proc.stderr
+    records = [json.loads(line) for line in proc.stdout.strip().splitlines()]
+    assert [r["workload"] for r in records] == ["disk", "cpu"]

@@ -1,0 +1,55 @@
+"""Model forward/backward sanity on CPU (conftest forces an 8-device CPU
+mesh; small inputs keep it fast)."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tritonk8ssupervisor_tpu.models import ResNet18, ResNet50
+
+
+def test_resnet18_forward_shapes():
+    model = ResNet18(num_classes=10)
+    x = jnp.ones((2, 64, 64, 3), jnp.float32)
+    variables = model.init(jax.random.key(0), x, train=False)
+    logits = model.apply(variables, x, train=False)
+    assert logits.shape == (2, 10)
+    assert logits.dtype == jnp.float32  # head stays f32 for the softmax
+    assert "batch_stats" in variables
+
+
+def test_resnet_compute_is_bf16_params_f32():
+    model = ResNet18(num_classes=10)
+    x = jnp.ones((1, 32, 32, 3))
+    variables = model.init(jax.random.key(0), x, train=False)
+    leaves = jax.tree_util.tree_leaves(variables["params"])
+    assert all(leaf.dtype == jnp.float32 for leaf in leaves)
+
+
+def test_resnet50_structure():
+    """ResNet-50 = 1 stem conv + 3+4+6+3 bottlenecks x 3 convs + shortcuts
+    + classifier -> 53 conv kernels + 1 dense."""
+    model = ResNet50(num_classes=1000)
+    x = jax.ShapeDtypeStruct((1, 224, 224, 3), jnp.float32)
+    variables = jax.eval_shape(
+        lambda: model.init(jax.random.key(0), jnp.zeros(x.shape, x.dtype), train=False)
+    )
+    params = variables["params"]
+    conv_kernels = [
+        path
+        for path, leaf in jax.tree_util.tree_leaves_with_path(params)
+        if leaf.ndim == 4
+    ]
+    assert len(conv_kernels) == 53
+    total = sum(l.size for l in jax.tree_util.tree_leaves(params))
+    assert 25_500_000 < total < 25_600_000  # the canonical ~25.5M
+
+
+def test_batch_stats_update_in_train_mode():
+    model = ResNet18(num_classes=10)
+    x = jax.random.normal(jax.random.key(1), (4, 32, 32, 3))
+    variables = model.init(jax.random.key(0), x, train=False)
+    _, updates = model.apply(variables, x, train=True, mutable=["batch_stats"])
+    before = jax.tree_util.tree_leaves(variables["batch_stats"])
+    after = jax.tree_util.tree_leaves(updates["batch_stats"])
+    assert any(not jnp.allclose(b, a) for b, a in zip(before, after))

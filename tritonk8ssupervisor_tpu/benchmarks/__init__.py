@@ -1,0 +1,10 @@
+"""Benchmark workloads.
+
+Two tiers, mirroring the reference's two benchmark ideas:
+- containerbench.py — the reference's own VM-level workloads (1 GiB disk
+  write, md5 over 256 MiB; reference docs/benchmarks.md:8-12), directly
+  comparable against its published numbers.
+- resnet50.py — the TPU flagship (BASELINE.json): ResNet-50 training
+  throughput in images/sec/chip, standalone on a TPU VM or as the K8s Job
+  compiled by config/compile.py.
+"""

@@ -1,0 +1,159 @@
+"""ResNet-50 training-throughput benchmark (images/sec/chip).
+
+The flagship workload prescribed by BASELINE.json — the TPU-native
+re-expression of the reference's external benchmark container
+(reference docs/benchmarks.md:1-4 ran misterbisson/simple-container-
+benchmarks on each VM; here the accelerator is the point). Runs:
+
+- standalone on a TPU VM slice:  python -m tritonk8ssupervisor_tpu.benchmarks.resnet50
+- as the GKE Job compiled by config/compile.py to_benchmark_job (the env
+  vars it injects are consumed by parallel/distributed.py)
+- on CPU for CI smoke (tiny shapes; conftest's 8-device mesh)
+
+Data is synthetic and generated on device: the benchmark measures the
+training computation (MXU utilisation + collectives), not host input
+pipelines — the standard method for accelerator throughput numbers.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+from tritonk8ssupervisor_tpu.models import ResNet18, ResNet50
+from tritonk8ssupervisor_tpu.parallel import (
+    batch_sharding,
+    initialize_from_env,
+    make_mesh,
+)
+from tritonk8ssupervisor_tpu.parallel import train as train_lib
+from tritonk8ssupervisor_tpu.parallel.mesh import DATA_AXIS
+
+MODELS = {"resnet50": ResNet50, "resnet18": ResNet18}
+
+
+def run_benchmark(
+    model_name: str = "resnet50",
+    batch_per_chip: int = 128,
+    image_size: int = 224,
+    num_classes: int = 1000,
+    steps: int = 30,
+    warmup: int = 5,
+    model_parallelism: int = 1,
+    learning_rate: float = 0.1,
+) -> dict:
+    """Train on synthetic data and measure steady-state throughput.
+
+    Returns a metrics dict; bench.py turns it into the driver JSON line.
+    """
+    mesh = make_mesh(model_parallelism=model_parallelism)
+    num_chips = mesh.devices.size
+    data_degree = mesh.shape[DATA_AXIS]
+    global_batch = batch_per_chip * data_degree
+
+    model = MODELS[model_name](num_classes=num_classes)
+    tx = train_lib.default_optimizer(learning_rate=learning_rate)
+    sample = jax.ShapeDtypeStruct(
+        (global_batch, image_size, image_size, 3), jnp.float32
+    )
+    init_start = time.monotonic()
+    state, shardings = train_lib.create_train_state(
+        model, jax.random.key(0), sample, mesh, tx
+    )
+    step = train_lib.make_train_step(model, tx, mesh, shardings)
+
+    # Synthetic batch, born sharded on device (no host->device copies in
+    # the timed loop; HBM is the bottleneck we measure, not PCIe).
+    image_sh = batch_sharding(mesh, ndim=4)
+    label_sh = batch_sharding(mesh, ndim=1)
+    k1, k2 = jax.random.split(jax.random.key(1))
+    images = jax.device_put(
+        jax.random.normal(k1, sample.shape, jnp.float32), image_sh
+    )
+    labels = jax.device_put(
+        jax.random.randint(k2, (global_batch,), 0, num_classes), label_sh
+    )
+
+    # The timing fence everywhere below is a host fetch of the loss: the
+    # last step's loss depends on every prior step's parameters (donated
+    # chaining), and a device->host read cannot complete early —
+    # block_until_ready alone is not a reliable fence on remote-tunneled
+    # backends.
+    state, metrics = step(state, images, labels)  # first step = compile
+    float(metrics["loss"])
+    compile_seconds = time.monotonic() - init_start
+    for _ in range(max(0, warmup - 1)):  # allocator/queue steady state
+        state, metrics = step(state, images, labels)
+    float(metrics["loss"])
+
+    start = time.monotonic()
+    for _ in range(steps):
+        state, metrics = step(state, images, labels)
+    final_loss = float(metrics["loss"])
+    elapsed = time.monotonic() - start
+
+    images_per_sec = global_batch * steps / elapsed
+    return {
+        "model": model_name,
+        "platform": jax.default_backend(),
+        "num_chips": int(num_chips),
+        "data_parallelism": int(data_degree),
+        "model_parallelism": int(model_parallelism),
+        "global_batch": int(global_batch),
+        "image_size": image_size,
+        "steps": steps,
+        "step_ms": elapsed / steps * 1000,
+        "images_per_sec": images_per_sec,
+        "images_per_sec_per_chip": images_per_sec / num_chips,
+        "compile_seconds": compile_seconds,
+        "final_loss": final_loss,
+    }
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--model", choices=sorted(MODELS), default="resnet50")
+    parser.add_argument("--batch-per-chip", type=int, default=128)
+    parser.add_argument("--image-size", type=int, default=224)
+    parser.add_argument("--num-classes", type=int, default=1000)
+    parser.add_argument("--steps", type=int, default=30)
+    parser.add_argument("--warmup", type=int, default=5)
+    parser.add_argument("--model-parallelism", type=int, default=1)
+    parser.add_argument("--json", action="store_true", help="machine-readable output")
+    return parser
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    # multi-host rendezvous when the Job/ansible env provides coordinates
+    # (the node-join analogue, SURVEY.md §2.5)
+    initialize_from_env()
+    result = run_benchmark(
+        model_name=args.model,
+        batch_per_chip=args.batch_per_chip,
+        image_size=args.image_size,
+        num_classes=args.num_classes,
+        steps=args.steps,
+        warmup=args.warmup,
+        model_parallelism=args.model_parallelism,
+    )
+    if args.json:
+        print(json.dumps(result, sort_keys=True))
+    else:
+        print(
+            f"{result['model']} on {result['num_chips']} {result['platform']} "
+            f"chip(s): {result['images_per_sec']:.1f} img/s total, "
+            f"{result['images_per_sec_per_chip']:.1f} img/s/chip, "
+            f"step {result['step_ms']:.1f} ms "
+            f"(global batch {result['global_batch']}, compile "
+            f"{result['compile_seconds']:.1f}s)"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

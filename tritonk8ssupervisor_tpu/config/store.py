@@ -23,15 +23,22 @@ def save_config_file(config: ClusterConfig, path: Path) -> None:
     path.write_text("\n".join(lines) + "\n")
 
 
-def load_config_file(path: Path) -> ClusterConfig:
+def parse_flat(text: str) -> dict[str, str]:
+    """Parse flat KEY=value lines (comments/blank lines skipped). Shared by
+    the config file and /etc/tpu-cluster.env (parallel/distributed.py) —
+    one definition of the flat-file format."""
     flat: dict[str, str] = {}
-    for raw in path.read_text().splitlines():
+    for raw in text.splitlines():
         line = raw.strip()
         if not line or line.startswith("#") or "=" not in line:
             continue
         key, _, value = line.partition("=")
         flat[key.strip()] = value.strip()
-    return ClusterConfig.from_flat(flat)
+    return flat
+
+
+def load_config_file(path: Path) -> ClusterConfig:
+    return ClusterConfig.from_flat(parse_flat(path.read_text()))
 
 
 def export_to_env(config: ClusterConfig, environ: dict | None = None) -> dict:

@@ -1,0 +1,12 @@
+"""Model zoo for the benchmark workloads.
+
+The reference's "workloads" were stateless web apps and generic container
+benchmarks (reference docs/detailed.md:255-371, docs/benchmarks.md:1-12).
+The TPU-native framework's flagship workload — per BASELINE.json — is
+ResNet-50 in JAX, exercised by benchmarks/resnet50.py both standalone on a
+TPU VM slice and as a K8s Job (config/compile.py to_benchmark_job).
+"""
+
+from tritonk8ssupervisor_tpu.models.resnet import ResNet, ResNet18, ResNet50
+
+__all__ = ["ResNet", "ResNet18", "ResNet50"]

@@ -1,0 +1,124 @@
+"""ResNet in flax.linen, laid out for the TPU MXU.
+
+TPU-first design choices (not tunables — load-bearing for throughput):
+- NHWC layout and 3x3/1x1 convs with static shapes: XLA tiles these onto
+  the 128x128 MXU directly.
+- bfloat16 compute / float32 parameters and batch-norm statistics: the MXU
+  natively multiplies bf16 with f32 accumulation, so bf16 halves HBM
+  traffic at no accuracy loss for ResNet-scale training.
+- No Python control flow that depends on data; the whole forward is one
+  traced graph, so `jit` compiles it once per shape.
+
+The reference framework had no model code at all (SURVEY.md §2.5); this is
+the flagship benchmark workload prescribed by BASELINE.json (ResNet-50
+images/sec/chip on the provisioned slice).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class BottleneckBlock(nn.Module):
+    """1x1 -> 3x3 -> 1x1 bottleneck with projection shortcut (ResNet-50/101/152)."""
+
+    filters: int
+    conv: ModuleDef
+    norm: ModuleDef
+    strides: tuple[int, int] = (1, 1)
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1))(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3), self.strides)(y)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters * 4, (1, 1))(y)
+        # zero-init the last norm's scale: residual branches start as
+        # identity, the standard trick for stable large-batch training
+        y = self.norm(scale_init=nn.initializers.zeros_init())(y)
+        if residual.shape != y.shape:
+            residual = self.conv(
+                self.filters * 4, (1, 1), self.strides, name="shortcut"
+            )(x)
+            residual = self.norm(name="shortcut_norm")(residual)
+        return nn.relu(residual + y)
+
+
+class BasicBlock(nn.Module):
+    """3x3 -> 3x3 block (ResNet-18/34) — the cheap variant for CPU tests."""
+
+    filters: int
+    conv: ModuleDef
+    norm: ModuleDef
+    strides: tuple[int, int] = (1, 1)
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (3, 3), self.strides)(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3))(y)
+        y = self.norm(scale_init=nn.initializers.zeros_init())(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters, (1, 1), self.strides, name="shortcut")(x)
+            residual = self.norm(name="shortcut_norm")(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    """Configurable ResNet; `ResNet50()` is the benchmark flagship."""
+
+    stage_sizes: Sequence[int]
+    block_cls: ModuleDef
+    num_classes: int = 1000
+    num_filters: int = 64
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(
+            nn.Conv, use_bias=False, dtype=self.dtype, param_dtype=jnp.float32
+        )
+        norm = partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+        )
+        x = x.astype(self.dtype)
+        x = conv(self.num_filters, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
+                 name="stem_conv")(x)
+        x = norm(name="stem_norm")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        for stage, size in enumerate(self.stage_sizes):
+            for block in range(size):
+                strides = (2, 2) if stage > 0 and block == 0 else (1, 1)
+                x = self.block_cls(
+                    filters=self.num_filters * 2**stage,
+                    strides=strides,
+                    conv=conv,
+                    norm=norm,
+                )(x)
+        x = jnp.mean(x, axis=(1, 2))
+        # logits in f32: the loss softmax needs the dynamic range
+        x = nn.Dense(self.num_classes, dtype=jnp.float32,
+                     param_dtype=jnp.float32, name="classifier")(x)
+        return x
+
+
+ResNet18 = partial(ResNet, stage_sizes=(2, 2, 2, 2), block_cls=BasicBlock)
+ResNet50 = partial(ResNet, stage_sizes=(3, 4, 6, 3), block_cls=BottleneckBlock)

@@ -1,0 +1,14 @@
+"""TPU kernels (pallas) and their pure-XLA reference implementations.
+
+XLA already fuses the overwhelming majority of ResNet's elementwise work
+into its convolutions; pallas is reserved for the ops where manual fusion
+still pays — the softmax-cross-entropy loss head is the canonical one
+(one VMEM-resident pass instead of materialising softmax to HBM).
+"""
+
+from tritonk8ssupervisor_tpu.ops.cross_entropy import (
+    cross_entropy_loss,
+    cross_entropy_loss_reference,
+)
+
+__all__ = ["cross_entropy_loss", "cross_entropy_loss_reference"]

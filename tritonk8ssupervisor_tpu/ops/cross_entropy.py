@@ -1,0 +1,101 @@
+"""Fused softmax-cross-entropy in pallas.
+
+The loss head is the one ResNet op XLA leaves memory-bound: a naive
+`log_softmax(logits)[labels]` materialises the (batch, classes) softmax to
+HBM before the gather. The pallas kernel keeps each batch-block's logits in
+VMEM and emits only the per-example loss — one HBM read of the logits, one
+tiny write.
+
+Forward: pallas (TPU) with an interpret-mode path for CPU tests.
+Backward: pure XLA (`softmax - onehot`) via custom_vjp — the backward is a
+single fused elementwise expression XLA already handles optimally, so a
+hand kernel would add nothing.
+
+The reference framework had no compute kernels of any kind (SURVEY.md §2:
+"no Python/C++/Rust/CUDA anywhere"); this op serves the flagship benchmark
+workload (benchmarks/resnet50.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_LANE = 128      # TPU lane width: last-dim tiles are multiples of 128
+_BLOCK_B = 256   # batch rows per kernel invocation (fits VMEM at 1000 classes)
+
+
+def cross_entropy_loss_reference(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Pure-XLA per-example loss; ground truth for the kernel tests."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    return -jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
+
+
+def _ce_kernel(logits_ref, labels_ref, out_ref, *, num_classes: int):
+    logits = logits_ref[...].astype(jnp.float32)  # (block_b, padded_c)
+    labels = labels_ref[...]                      # (block_b, 1) int32
+    col = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
+    valid = col < num_classes
+    masked = jnp.where(valid, logits, -jnp.inf)
+    row_max = jnp.max(masked, axis=-1, keepdims=True)
+    shifted = masked - row_max
+    # exp(-inf) = 0 handles the padding lanes
+    lse = jnp.log(jnp.sum(jnp.exp(shifted), axis=-1, keepdims=True))
+    picked = jnp.sum(jnp.where(col == labels, shifted, 0.0), axis=-1, keepdims=True)
+    out_ref[...] = lse - picked
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def cross_entropy_loss(
+    logits: jax.Array, labels: jax.Array, interpret: bool = False
+) -> jax.Array:
+    """Per-example softmax cross-entropy, fused on TPU.
+
+    Args:
+      logits: (batch, classes) float array (any float dtype; f32 math inside).
+      labels: (batch,) int class ids.
+      interpret: run the pallas kernel in interpreter mode (CPU tests).
+
+    Returns (batch,) float32 losses.
+    """
+    return _forward(logits, labels, interpret)
+
+
+def _forward(logits, labels, interpret):
+    batch, num_classes = logits.shape
+    padded_c = -(-num_classes // _LANE) * _LANE
+    block_b = min(_BLOCK_B, batch)
+    if batch % block_b:  # uneven batch: let XLA handle it, not worth a kernel
+        return cross_entropy_loss_reference(logits, labels)
+    if padded_c != num_classes:
+        logits = jnp.pad(logits, ((0, 0), (0, padded_c - num_classes)))
+    out = pl.pallas_call(
+        functools.partial(_ce_kernel, num_classes=num_classes),
+        grid=(batch // block_b,),
+        in_specs=[
+            pl.BlockSpec((block_b, padded_c), lambda i: (i, 0)),
+            pl.BlockSpec((block_b, 1), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((block_b, 1), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((batch, 1), jnp.float32),
+        interpret=interpret,
+    )(logits, labels.astype(jnp.int32)[:, None])
+    return out[:, 0]
+
+
+def _forward_fwd(logits, labels, interpret):
+    return _forward(logits, labels, interpret), (logits, labels)
+
+
+def _forward_bwd(interpret, residuals, g):
+    logits, labels = residuals
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    onehot = jax.nn.one_hot(labels, logits.shape[-1], dtype=jnp.float32)
+    dlogits = (probs - onehot) * g[:, None]
+    return dlogits.astype(logits.dtype), None
+
+
+cross_entropy_loss.defvjp(_forward_fwd, _forward_bwd)

@@ -1,0 +1,26 @@
+"""SPMD parallelism over jax.sharding meshes.
+
+The reference's only "distributed" layer was orchestration: N VMs over SSH
+joined to one control plane over HTTP (SURVEY.md §2.5). The TPU-native
+data plane is ICI within a slice and DCN across hosts, both owned by
+XLA/libtpu and driven here through `jax.sharding.Mesh` + `jit` sharding
+annotations — the framework picks shardings; XLA inserts the collectives.
+"""
+
+from tritonk8ssupervisor_tpu.parallel.mesh import (
+    batch_sharding,
+    make_mesh,
+    param_shardings,
+)
+from tritonk8ssupervisor_tpu.parallel.distributed import (
+    cluster_env,
+    initialize_from_env,
+)
+
+__all__ = [
+    "make_mesh",
+    "batch_sharding",
+    "param_shardings",
+    "cluster_env",
+    "initialize_from_env",
+]

@@ -1,0 +1,87 @@
+"""Multi-host cluster formation.
+
+The TPU analogue of the reference's node-join: where rancher/agent phoned
+home to the master with a registration URL (reference
+rancherhost/tasks/main.yml:19-34), JAX processes rendezvous at a
+coordinator address. The address/process-count/process-id arrive via:
+
+- /etc/tpu-cluster.env, written per-host by the tpuhost ansible role
+  (ansible/roles/tpuhost/tasks/main.yml) on provisioned TPU VM slices, or
+- container env vars injected by the benchmark Job manifest
+  (config/compile.py to_benchmark_job) on GKE — completion index becomes
+  the process id.
+
+After jax.distributed.initialize, jax.devices() spans every chip of the
+slice and the same mesh/collectives code runs unchanged — ICI within a
+host group, DCN between hosts, all owned by XLA.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from pathlib import Path
+
+import jax
+
+ENV_FILE = Path("/etc/tpu-cluster.env")
+
+COORDINATOR_VAR = "JAX_COORDINATOR_ADDRESS"
+NUM_PROCESSES_VAR = "JAX_NUM_PROCESSES"
+PROCESS_ID_VAR = "JAX_PROCESS_ID"
+
+
+@dataclasses.dataclass(frozen=True)
+class ClusterEnv:
+    coordinator_address: str
+    num_processes: int
+    process_id: int
+
+    @property
+    def is_multi_host(self) -> bool:
+        return self.num_processes > 1
+
+
+def cluster_env(
+    environ: dict | None = None, env_file: Path = ENV_FILE
+) -> ClusterEnv | None:
+    """Resolve cluster coordinates: the host env file (TPU VM + ansible) is
+    the base, overlaid per-key by the process env (GKE Job / operator
+    override) — so overriding just the coordinator address still inherits
+    process counts from the file. None means single-process."""
+    from tritonk8ssupervisor_tpu.config.store import parse_flat
+
+    environ = dict(os.environ) if environ is None else dict(environ)
+    if env_file.exists():
+        environ = {**parse_flat(env_file.read_text()), **environ}
+    if COORDINATOR_VAR not in environ:
+        return None
+    try:
+        return ClusterEnv(
+            coordinator_address=environ[COORDINATOR_VAR],
+            num_processes=int(environ[NUM_PROCESSES_VAR]),
+            process_id=int(environ[PROCESS_ID_VAR]),
+        )
+    except KeyError as e:
+        raise RuntimeError(
+            f"incomplete cluster environment: {e.args[0]} is unset but "
+            f"{COORDINATOR_VAR} is present"
+        ) from None
+
+
+def initialize_from_env(
+    environ: dict | None = None, env_file: Path = ENV_FILE
+) -> ClusterEnv | None:
+    """jax.distributed.initialize from the discovered coordinates.
+
+    Safe no-op for single-process runs (the common dev path and the
+    single-host benchmark)."""
+    env = cluster_env(environ, env_file)
+    if env is None or not env.is_multi_host:
+        return env
+    jax.distributed.initialize(
+        coordinator_address=env.coordinator_address,
+        num_processes=env.num_processes,
+        process_id=env.process_id,
+    )
+    return env

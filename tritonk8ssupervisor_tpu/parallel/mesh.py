@@ -1,0 +1,83 @@
+"""Device mesh construction and sharding rules.
+
+Axes:
+  "data"  — batch parallelism; gradients are psum-reduced across it by XLA
+            (the only strategy the benchmark *requires* per SURVEY.md §2.5).
+  "model" — tensor parallelism for wide parameters (classifier head, wide
+            convs); kept in the mesh so larger models slot in without
+            re-plumbing (SURVEY.md §2.5: "written so other strategies can
+            slot in").
+
+On a real slice the mesh axes ride ICI (device order from
+jax.devices() preserves torus locality); across hosts XLA routes the same
+collectives over DCN after jax.distributed.initialize (distributed.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def make_mesh(
+    devices: Sequence[Any] | None = None,
+    model_parallelism: int = 1,
+) -> Mesh:
+    """A (data, model) mesh over `devices` (default: all global devices).
+
+    model_parallelism must divide the device count; the rest is data.
+    """
+    devices = list(devices) if devices is not None else list(jax.devices())
+    n = len(devices)
+    if model_parallelism < 1 or n % model_parallelism:
+        raise ValueError(
+            f"model_parallelism={model_parallelism} does not divide "
+            f"device count {n}"
+        )
+    grid = np.asarray(devices).reshape(n // model_parallelism, model_parallelism)
+    return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
+
+
+def batch_sharding(mesh: Mesh, ndim: int = 4) -> NamedSharding:
+    """Shard the leading (batch) dim over "data"; replicate the rest."""
+    return NamedSharding(mesh, P(DATA_AXIS, *([None] * (ndim - 1))))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def param_shardings(
+    params: Any,
+    mesh: Mesh,
+    min_shard_size: int = 2**16,
+) -> Any:
+    """Sharding tree for a parameter pytree.
+
+    Rule: shard the last (output-feature) axis of any array over "model"
+    when it divides evenly and the array is big enough to be worth the
+    collective; replicate everything else. With model_parallelism == 1
+    this degrades to pure replication — classic data parallelism, where
+    XLA turns the `jit` gradient sum into a psum over "data".
+    """
+    model_size = mesh.shape[MODEL_AXIS]
+
+    def rule(x):
+        if (
+            model_size > 1
+            and hasattr(x, "ndim")
+            and x.ndim >= 2
+            and x.shape[-1] % model_size == 0
+            and x.size >= min_shard_size
+        ):
+            spec = [None] * (x.ndim - 1) + [MODEL_AXIS]
+            return NamedSharding(mesh, P(*spec))
+        return NamedSharding(mesh, P())
+
+    return jax.tree_util.tree_map(rule, params)

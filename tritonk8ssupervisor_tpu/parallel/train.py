@@ -1,0 +1,132 @@
+"""The sharded training step — one jitted SPMD program over the mesh.
+
+Everything inside `step` is traced once and compiled by XLA for the whole
+mesh: the batch arrives sharded over "data", parameters live replicated
+(or sharded over "model" per mesh.param_shardings), and the cross-device
+gradient reduction is *not written here* — XLA inserts the psum over ICI
+when it sees replicated params consumed by a sharded batch. That inversion
+(annotate shardings, let the compiler place collectives) is the core of the
+TPU design, replacing the reference's orchestration-level distribution
+(SURVEY.md §2.5: no data-plane library existed to port).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tritonk8ssupervisor_tpu.ops.cross_entropy import (
+    cross_entropy_loss,
+    cross_entropy_loss_reference,
+)
+from tritonk8ssupervisor_tpu.parallel import mesh as mesh_lib
+
+
+@flax.struct.dataclass
+class TrainState:
+    step: jax.Array
+    params: Any
+    batch_stats: Any
+    opt_state: Any
+
+
+def default_optimizer(
+    learning_rate: float = 0.1, momentum: float = 0.9
+) -> optax.GradientTransformation:
+    """SGD+momentum, the standard ResNet-50 benchmark recipe."""
+    return optax.sgd(learning_rate, momentum=momentum, nesterov=True)
+
+
+def create_train_state(
+    model,
+    rng: jax.Array,
+    sample_input: jax.ShapeDtypeStruct,
+    mesh,
+    tx: optax.GradientTransformation,
+):
+    """Initialise a TrainState *born sharded*: shapes come from eval_shape,
+    shardings from the mesh rules, and the actual init runs under jit with
+    those out_shardings — no host-side giant pytree, no device-0 staging.
+
+    Returns (state, state_shardings).
+    """
+
+    def init_fn(rng):
+        x = jnp.zeros(sample_input.shape, sample_input.dtype)
+        variables = model.init(rng, x, train=False)
+        params = variables["params"]
+        batch_stats = variables.get("batch_stats", {})
+        return TrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            batch_stats=batch_stats,
+            opt_state=tx.init(params),
+        )
+
+    shapes = jax.eval_shape(init_fn, rng)
+    shardings = mesh_lib.param_shardings(shapes, mesh)
+    state = jax.jit(init_fn, out_shardings=shardings)(rng)
+    return state, shardings
+
+
+def make_train_step(
+    model,
+    tx: optax.GradientTransformation,
+    mesh,
+    state_shardings,
+    loss_fn: Callable | None = None,
+):
+    """Build the jitted train step: (state, images, labels) -> (state, metrics).
+
+    images/labels arrive sharded over "data"; state stays in its shardings
+    (donated, so parameters update in place in HBM).
+    """
+    if loss_fn is None:
+        # pallas fused loss on TPU; pure-XLA reference elsewhere
+        loss_fn = (
+            cross_entropy_loss
+            if jax.default_backend() == "tpu"
+            else cross_entropy_loss_reference
+        )
+
+    def compute_loss(params, batch_stats, images, labels):
+        logits, updates = model.apply(
+            {"params": params, "batch_stats": batch_stats},
+            images,
+            train=True,
+            mutable=["batch_stats"],
+        )
+        loss = jnp.mean(loss_fn(logits, labels))
+        return loss, (updates["batch_stats"], logits)
+
+    def step(state: TrainState, images, labels):
+        grad_fn = jax.value_and_grad(compute_loss, has_aux=True)
+        (loss, (new_stats, logits)), grads = grad_fn(
+            state.params, state.batch_stats, images, labels
+        )
+        updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        accuracy = jnp.mean(jnp.argmax(logits, axis=-1) == labels)
+        new_state = TrainState(
+            step=state.step + 1,
+            params=new_params,
+            batch_stats=new_stats,
+            opt_state=new_opt_state,
+        )
+        return new_state, {"loss": loss, "accuracy": accuracy}
+
+    data = mesh_lib.DATA_AXIS
+    image_sh = NamedSharding(mesh, P(data, None, None, None))
+    label_sh = NamedSharding(mesh, P(data))
+    metric_sh = NamedSharding(mesh, P())
+    return jax.jit(
+        step,
+        in_shardings=(state_shardings, image_sh, label_sh),
+        out_shardings=(state_shardings, {"loss": metric_sh, "accuracy": metric_sh}),
+        donate_argnums=(0,),
+    )
