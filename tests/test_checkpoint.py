@@ -1,0 +1,81 @@
+"""Checkpoint/resume of sharded TrainState (SURVEY.md §5) on the 8-device
+CPU mesh: save, restore into abstract shardings, verify values + layouts +
+step survive."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tritonk8ssupervisor_tpu.models import ResNet18
+from tritonk8ssupervisor_tpu.parallel import make_mesh
+from tritonk8ssupervisor_tpu.parallel import train as train_lib
+from tritonk8ssupervisor_tpu.parallel.checkpoint import TrainCheckpointer, abstract_like
+
+
+def make_state(mesh, model_parallelism=1):
+    model = ResNet18(num_classes=64, num_filters=16)
+    tx = train_lib.default_optimizer()
+    sample = jax.ShapeDtypeStruct((8, 32, 32, 3), jnp.float32)
+    state, shardings = train_lib.create_train_state(
+        model, jax.random.key(0), sample, mesh, tx
+    )
+    step = train_lib.make_train_step(model, tx, mesh, shardings)
+    images = jax.random.normal(jax.random.key(1), (8, 32, 32, 3))
+    labels = jax.random.randint(jax.random.key(2), (8,), 0, 64)
+    return state, shardings, step, images, labels
+
+
+def test_save_restore_round_trip(tmp_path):
+    mesh = make_mesh()
+    state, shardings, step, images, labels = make_state(mesh)
+    state, _ = step(state, images, labels)
+    state, _ = step(state, images, labels)
+
+    ckpt = TrainCheckpointer(tmp_path / "ckpt")
+    ckpt.save(int(state.step), state, wait=True)
+    assert ckpt.latest_step() == 2
+
+    restored = ckpt.restore(abstract_like(state, shardings))
+    ckpt.close()
+    assert int(restored.step) == 2
+    for want, got in zip(
+        jax.tree_util.tree_leaves(state), jax.tree_util.tree_leaves(restored)
+    ):
+        np.testing.assert_array_equal(np.asarray(want), np.asarray(got))
+    # restored arrays carry the mesh shardings (no host-gathered residue)
+    for want, got in zip(
+        jax.tree_util.tree_leaves(state.params),
+        jax.tree_util.tree_leaves(restored.params),
+    ):
+        assert got.sharding == want.sharding
+
+    # resumed training continues from the checkpointed step
+    resumed, _ = step(restored, images, labels)
+    assert int(resumed.step) == 3
+
+
+def test_restore_without_checkpoint_raises(tmp_path):
+    mesh = make_mesh()
+    state, shardings, *_ = make_state(mesh)
+    ckpt = TrainCheckpointer(tmp_path / "empty")
+    assert ckpt.latest_step() is None
+    try:
+        ckpt.restore(abstract_like(state, shardings))
+        raised = False
+    except FileNotFoundError:
+        raised = True
+    finally:
+        ckpt.close()
+    assert raised
+
+
+def test_max_to_keep_prunes_old_steps(tmp_path):
+    mesh = make_mesh()
+    state, shardings, step, images, labels = make_state(mesh)
+    ckpt = TrainCheckpointer(tmp_path / "ckpt", max_to_keep=2)
+    for _ in range(4):
+        state, _ = step(state, images, labels)
+        ckpt.save(int(state.step), state, wait=True)
+    assert ckpt.latest_step() == 4
+    assert sorted(ckpt._manager.all_steps()) == [3, 4]
+    ckpt.close()

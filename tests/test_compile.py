@@ -86,6 +86,27 @@ def test_single_host_job():
     assert container["resources"]["limits"]["google.com/tpu"] == "4"
 
 
+def test_probe_job_structure():
+    job = cc.to_probe_job(cfg())
+    spec = job["spec"]
+    assert spec["completions"] == 2 and spec["parallelism"] == 2
+    [container] = spec["template"]["spec"]["containers"]
+    assert container["resources"]["limits"]["google.com/tpu"] == "8"
+    # the probe self-installs the pinned jax then runs the shared
+    # acceptance command for this host's chips
+    cmd = container["command"][-1]
+    assert "pip install" in cmd and "jax[tpu]==" in cmd
+    assert "jax.local_device_count()" in cmd and "== 8" in cmd
+
+
+def test_probe_job_covers_all_slices():
+    """completions == total hosts: each pod eats one host's chips, so
+    resource accounting forces one probe onto every host of every slice."""
+    job = cc.to_probe_job(cfg(num_slices=3))
+    assert job["spec"]["completions"] == 6
+    assert job["spec"]["parallelism"] == 6
+
+
 def test_write_manifests_multi_slice(tmp_path):
     paths = cc.write_manifests(cfg(num_slices=2), tmp_path)
     names = sorted(p.name for p in paths)

@@ -80,10 +80,15 @@ def fake_world(tmp_path, monkeypatch):
         "kubectl",
         """
         echo "kubectl $*" >> "$CALLS_LOG"
-        echo '{"items": [
-          {"metadata": {"name": "n1"},
-           "status": {"allocatable": {"google.com/tpu": "4"},
-                      "conditions": [{"type": "Ready", "status": "True"}]}}]}'
+        case "$*" in
+          "get job tpu-probe -o json")
+            echo '{"spec": {"completions": 1}, "status": {"conditions": [{"type": "Complete", "status": "True"}]}}' ;;
+          *)
+            echo '{"items": [
+              {"metadata": {"name": "n1"},
+               "status": {"allocatable": {"google.com/tpu": "4"},
+                          "conditions": [{"type": "Ready", "status": "True"}]}}]}' ;;
+        esac
         """,
     )
     return work, calls_log
@@ -159,6 +164,33 @@ def test_clean_without_config_is_noop(fake_world, capsys):
     work, _ = fake_world
     assert main(["-c", "--yes", "--workdir", str(work)]) == 0
     assert "nothing to clean" in capsys.readouterr().out
+
+
+def test_show_config(fake_world, capsys):
+    work, _ = fake_world
+    config_path = saved_config(work)
+    rc = main(["--show-config", "--config", str(config_path), "--workdir", str(work)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "file-proj" in out and "v5litepod-16" in out
+    # nothing provisioned
+    assert not RunPaths(work).hosts_file.exists()
+    # no config anywhere -> helpful failure
+    assert main(["--show-config", "--workdir", str(work)]) == 1
+
+
+def test_probe_flag_runs_probe_job(fake_world, capsys):
+    work, calls_log = fake_world
+    config_path = saved_config(work, MODE="gke", TOPOLOGY="2x2")
+    rc = main(["--yes", "--probe", "--config", str(config_path),
+               "--workdir", str(work)])
+    assert rc == 0, capsys.readouterr().out
+    calls = calls_log.read_text()
+    assert "kubectl apply -f" in calls and "tpu-probe" in calls
+    assert "kubectl get job tpu-probe -o json" in calls
+    # probe manifest lives apart from the benchmark manifests
+    assert (work / "manifests" / "probe" / "tpu-probe.yaml").exists()
+    assert not (RunPaths(work).manifests_dir / "tpu-probe.yaml").exists()
 
 
 def test_explicit_config_overrides_saved(fake_world, capsys):

@@ -1,0 +1,76 @@
+"""Real multi-process cluster formation: two local CPU processes rendezvous
+via initialize_from_env (the exact code path the tpuhost role's
+/etc/tpu-cluster.env and the GKE Job env feed) and exchange data.
+
+This exercises jax.distributed for real — the SURVEY.md §4 suggestion that
+multi-host logic be tested with jax.distributed.initialize across local
+processes.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+WORKER = textwrap.dedent(
+    """
+    import sys
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import multihost_utils
+
+    from tritonk8ssupervisor_tpu.parallel.distributed import initialize_from_env
+
+    env = initialize_from_env()
+    assert env is not None and env.is_multi_host, env
+    assert jax.process_count() == 2, jax.process_count()
+    assert jax.device_count() == 2, jax.device_count()
+
+    # each process contributes its id+1; allgather must see both
+    mine = jnp.array([env.process_id + 1])
+    everyone = multihost_utils.process_allgather(mine)
+    assert everyone.reshape(-1).tolist() == [1, 2], everyone
+    print(f"OK process {env.process_id}", flush=True)
+    """
+)
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_rendezvous(tmp_path):
+    port = free_port()
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        # neutralise the dev image's axon sitecustomize and pin CPU
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("XLA_FLAGS", None)
+        env["JAX_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
+        env["JAX_NUM_PROCESSES"] = "2"
+        env["JAX_PROCESS_ID"] = str(pid)
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, "-c", WORKER],
+                env=env,
+                cwd=REPO,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+        )
+    outputs = []
+    for pid, proc in enumerate(procs):
+        out, _ = proc.communicate(timeout=180)
+        outputs.append(out)
+        assert proc.returncode == 0, f"process {pid} failed:\n{out}"
+    assert "OK process 0" in outputs[0]
+    assert "OK process 1" in outputs[1]

@@ -230,6 +230,63 @@ def test_jax_smoke_command_asserts_device_count():
     assert "jax.local_device_count()" in cmd and "== 8" in cmd
 
 
+def job_json(conditions=None, succeeded=0, completions=2):
+    return json.dumps(
+        {
+            "spec": {"completions": completions},
+            "status": {"conditions": conditions or [], "succeeded": succeeded},
+        }
+    )
+
+
+def test_run_probe_job_apply_poll_delete(tmp_path):
+    config = cfg(mode="gke")
+    run = RecordingRunner()
+    quiet = RecordingRunner(
+        responses={
+            ("kubectl", "get", "job"): job_json(
+                [{"type": "Complete", "status": "True"}]
+            )
+        }
+    )
+    readiness.run_probe_job(config, tmp_path, run=run, run_quiet=quiet)
+    cmds = run.commands()
+    assert cmds[0].startswith("kubectl apply -f")
+    assert cmds[1].startswith("kubectl delete -f")
+    assert "kubectl get job tpu-probe -o json" in quiet.commands()
+    assert (tmp_path / "tpu-probe.yaml").exists()
+
+
+def test_run_probe_job_fast_fails_on_failed_condition(tmp_path):
+    config = cfg(mode="gke")
+    run = RecordingRunner()
+    quiet = RecordingRunner(
+        responses={
+            ("kubectl", "get", "job"): job_json(
+                [{"type": "Failed", "status": "True", "message": "BackoffLimitExceeded"}]
+            )
+        }
+    )
+    with pytest.raises(readiness.NotReadyError, match="BackoffLimitExceeded"):
+        readiness.run_probe_job(
+            config, tmp_path, run=run, run_quiet=quiet, sleep=lambda s: None
+        )
+    assert any("delete" in c for c in run.commands())  # cleaned up anyway
+
+
+def test_run_probe_job_timeout(tmp_path):
+    config = cfg(mode="gke")
+    run = RecordingRunner()
+    quiet = RecordingRunner(
+        responses={("kubectl", "get", "job"): job_json(succeeded=1)}
+    )
+    with pytest.raises(readiness.NotReadyError, match="1/2 probe pods"):
+        readiness.run_probe_job(
+            config, tmp_path, run=run, run_quiet=quiet,
+            timeout_seconds=0.0, sleep=lambda s: None,
+        )
+
+
 # --------------------------------------------------------------- teardown
 
 

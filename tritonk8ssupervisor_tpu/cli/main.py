@@ -64,6 +64,24 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument(
         "--readiness-timeout", type=float, default=900.0, metavar="SECONDS"
     )
+    parser.add_argument(
+        "--probe",
+        action="store_true",
+        help="gke mode: after readiness, run the TPU probe Job "
+        "(workload-level JAX device acceptance test)",
+    )
+    parser.add_argument(
+        "--probe-image",
+        default=None,
+        metavar="IMAGE",
+        help="container image for the probe Job (default: plain python; "
+        "the probe self-installs pinned jax[tpu])",
+    )
+    parser.add_argument(
+        "--show-config",
+        action="store_true",
+        help="print the resolved configuration and exit (no provisioning)",
+    )
     return parser
 
 
@@ -74,6 +92,8 @@ def main(argv: list[str] | None = None, prompter: Prompter | None = None) -> int
     try:
         if args.clean:
             return clean(args, paths, prompter)
+        if args.show_config:
+            return show_config(args, paths, prompter)
         return provision(args, paths, prompter)
     except (
         ConfigError,
@@ -93,6 +113,19 @@ def main(argv: list[str] | None = None, prompter: Prompter | None = None) -> int
     except BrokenPipeError:
         # stdout consumer (e.g. `| head`) went away; not an error of ours
         return 0
+
+
+def show_config(args, paths: state.RunPaths, prompter: Prompter) -> int:
+    """The debugVars analogue (reference setup.sh:522-531) — but wired up."""
+    source = args.config or paths.config_file
+    if not source.exists():
+        prompter.say(f"No configuration found at {source}.")
+        return 1
+    config = store.load_config_file(source)
+    prompter.say(f"Configuration from {source}:")
+    for label, value in wizard.config_rows(config):
+        prompter.say(f"  {label:<24} {value}")
+    return 0
 
 
 def clean(args, paths: state.RunPaths, prompter: Prompter) -> int:
@@ -164,6 +197,15 @@ def provision(args, paths: state.RunPaths, prompter: Prompter) -> int:
 
     with timer.phase("compile-manifests"):
         manifest_paths = compiler.write_manifests(config, paths.manifests_dir)
+
+    if args.probe and config.mode == "gke":
+        with timer.phase("probe-job"):
+            readiness.run_probe_job(
+                config,
+                paths.probe_dir,
+                timeout_seconds=args.readiness_timeout,
+                image=args.probe_image,
+            )
 
     banner(config, hosts, manifest_paths, prompter)
     timer.report()

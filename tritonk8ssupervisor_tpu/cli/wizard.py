@@ -144,12 +144,9 @@ def run_wizard(
     return config
 
 
-def verify_config(config: ClusterConfig, prompter: Prompter) -> bool:
-    """Print the full summary and gate on confirmation — verifyConfig
-    (setup.sh:452-483), including its reachability warning (setup.sh:468)."""
-    prompter.say("")
-    prompter.say("Verify the configuration:")
-    prompter.say("---------------------------------------------------------")
+def config_rows(config: ClusterConfig) -> list[tuple[str, str]]:
+    """The summary rows shared by the verify gate and --show-config (the
+    debugVars dump analogue, setup.sh:522-531)."""
     rows = [
         ("GCP project", config.project),
         ("Zone", config.zone),
@@ -169,7 +166,16 @@ def verify_config(config: ClusterConfig, prompter: Prompter) -> bool:
     ]
     if config.mode == "gke":
         rows.append(("GKE machine type", config.gke_machine_type))
-    for label, value in rows:
+    return rows
+
+
+def verify_config(config: ClusterConfig, prompter: Prompter) -> bool:
+    """Print the full summary and gate on confirmation — verifyConfig
+    (setup.sh:452-483), including its reachability warning (setup.sh:468)."""
+    prompter.say("")
+    prompter.say("Verify the configuration:")
+    prompter.say("---------------------------------------------------------")
+    for label, value in config_rows(config):
         prompter.say(f"  {label:<24} {value}")
     prompter.say("---------------------------------------------------------")
     prompter.say(

@@ -233,6 +233,75 @@ def to_benchmark_job(
     }
 
 
+# Pinned to the same version the tpuhost role installs
+# (ansible/roles/tpuhost/defaults/main.yml).
+PROBE_JAX_PIN = "jax[tpu]==0.4.38"
+PROBE_LIBTPU_INDEX = "https://storage.googleapis.com/jax-releases/libtpu_releases.html"
+
+
+def to_probe_job(
+    config: ClusterConfig,
+    *,
+    name: str = "tpu-probe",
+    image: str = BENCH_IMAGE_DEFAULT,
+) -> dict:
+    """A short acceptance-test Job: one pod per TPU host — across ALL
+    slices — running the JAX device-count smoke test (jax_smoke_command).
+    "Chips allocatable" at the node level still doesn't prove a workload
+    can enumerate them; this is the deterministic replacement for the
+    reference's dashboard-probe workaround (reference setup.sh:59-85) at
+    the workload level. Driven by provision/readiness.py run_probe_job.
+
+    Coverage: each pod requests every chip of one host, so with
+    completions == total hosts, resource accounting forces exactly one pod
+    onto every TPU host — no per-slice pinning needed. The default image
+    is a plain python base; the probe self-installs the pinned jax[tpu]
+    (same pin as the tpuhost role) so it works without a custom image.
+    """
+    spec = config.spec
+    topo = config.parsed_topology
+    total_hosts = config.num_slices * config.hosts_per_slice
+    chips_on_host = spec.chips_on_host(topo)
+    probe_cmd = (
+        f"pip install --quiet '{PROBE_JAX_PIN}' -f {PROBE_LIBTPU_INDEX} && "
+        + jax_smoke_command(chips_on_host)
+    )
+    return {
+        "apiVersion": "batch/v1",
+        "kind": "Job",
+        "metadata": {"name": name, "labels": {"app": name}},
+        "spec": {
+            "completions": total_hosts,
+            "parallelism": total_hosts,
+            "completionMode": "Indexed",
+            "backoffLimit": 2,
+            "ttlSecondsAfterFinished": 600,
+            "template": {
+                "spec": {
+                    "restartPolicy": "Never",
+                    "nodeSelector": {
+                        "cloud.google.com/gke-tpu-accelerator": _gke_accelerator_label(
+                            config.generation
+                        ),
+                        "cloud.google.com/gke-tpu-topology": str(topo),
+                    },
+                    "containers": [
+                        {
+                            "name": "probe",
+                            "image": image,
+                            "command": ["bash", "-c", probe_cmd],
+                            "resources": {
+                                "requests": {"google.com/tpu": str(chips_on_host)},
+                                "limits": {"google.com/tpu": str(chips_on_host)},
+                            },
+                        }
+                    ],
+                }
+            },
+        },
+    }
+
+
 def to_headless_service(name: str = "resnet50-bench") -> dict:
     """Headless Service for pod-to-pod coordinator discovery (SURVEY.md §7
     'hard parts': coordinator discovery inside K8s)."""

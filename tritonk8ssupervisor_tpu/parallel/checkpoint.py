@@ -1,0 +1,68 @@
+"""Checkpoint/resume for training state (orbax).
+
+SURVEY.md §5 "Checkpoint/resume": the reference's only resume story was
+orchestration-level — files as phase contract (reference setup.sh:199-208,
+139-143) — because its workloads were stateless. The training workload is
+stateful, so the framework adds the data-plane half: sharded TrainState
+save/restore via orbax, preserving each array's NamedSharding on restore
+(arrays come back on the same mesh layout without a host gather).
+
+Same crash-resume contract as the provisioning pipeline: the checkpoint
+directory's latest step is the phase boundary; re-running the benchmark
+with --checkpoint-dir resumes there.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Any
+
+import jax
+import orbax.checkpoint as ocp
+
+
+class TrainCheckpointer:
+    """Thin wrapper over ocp.CheckpointManager for TrainState pytrees."""
+
+    def __init__(self, directory: Path | str, max_to_keep: int = 3):
+        self._manager = ocp.CheckpointManager(
+            Path(directory).absolute(),
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True
+            ),
+        )
+
+    def latest_step(self) -> int | None:
+        return self._manager.latest_step()
+
+    def save(self, step: int, state: Any, wait: bool = False) -> None:
+        self._manager.save(step, args=ocp.args.StandardSave(state))
+        if wait:
+            self._manager.wait_until_finished()
+
+    def restore(self, abstract_state: Any, step: int | None = None) -> Any:
+        """Restore into the given abstract pytree (jax.ShapeDtypeStructs
+        carrying shardings — build with `abstract_like`)."""
+        step = self._manager.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError("no checkpoint to restore")
+        return self._manager.restore(
+            step, args=ocp.args.StandardRestore(abstract_state)
+        )
+
+    def close(self) -> None:
+        self._manager.wait_until_finished()
+        self._manager.close()
+
+
+def abstract_like(state: Any, shardings: Any) -> Any:
+    """Abstract target for restore: shapes/dtypes of `state`, laid out per
+    `shardings` — restored arrays are born sharded on the mesh."""
+    shapes = jax.eval_shape(lambda: state)
+    return jax.tree_util.tree_map(
+        lambda shape, sharding: jax.ShapeDtypeStruct(
+            shape.shape, shape.dtype, sharding=sharding
+        ),
+        shapes,
+        shardings,
+    )

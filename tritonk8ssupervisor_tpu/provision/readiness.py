@@ -42,6 +42,8 @@ def poll(
     while True:
         try:
             why_not = probe()
+        except NotReadyError:
+            raise  # a probe's definitive verdict (e.g. Job Failed) — no retry
         except Exception as e:  # noqa: BLE001 - transient infra errors
             why_not = f"probe error: {e}"
         if not why_not:
@@ -125,3 +127,69 @@ def tpu_vm_probe(
 # One definition of the per-host acceptance test, shared with the tpuhost
 # ansible role via to_ansible_vars (config/compile.py).
 from tritonk8ssupervisor_tpu.config.compile import jax_smoke_command  # noqa: E402,F401
+
+
+class ProbeFailed(NotReadyError):
+    """The probe Job reached the Failed condition."""
+
+
+def _probe_job_status(raw: str) -> str:
+    """Map `kubectl get job -o json` output to ""/why-not; raises
+    ProbeFailed on the Failed condition (kubectl wait can't fast-fail:
+    waiting on condition=complete never fires for a failed Job)."""
+    job = json.loads(raw)
+    status = job.get("status", {})
+    for cond in status.get("conditions", []):
+        if cond.get("type") == "Failed" and cond.get("status") == "True":
+            raise ProbeFailed(
+                f"probe job failed: {cond.get('message', 'see kubectl logs job/tpu-probe')}"
+            )
+        if cond.get("type") == "Complete" and cond.get("status") == "True":
+            return ""
+    want = job.get("spec", {}).get("completions", 1)
+    return f"{status.get('succeeded', 0)}/{want} probe pods succeeded"
+
+
+def run_probe_job(
+    config: ClusterConfig,
+    probe_dir,
+    run: run_mod.RunFn = run_mod.run_streaming,
+    run_quiet: run_mod.RunFn = run_mod.run_capture,
+    timeout_seconds: float = 600,
+    image: str | None = None,
+    sleep=time.sleep,
+) -> None:
+    """Apply the TPU probe Job (config/compile.py to_probe_job), poll until
+    Complete (fast-failing on Failed), clean it up. Raises NotReadyError —
+    the workload-level acceptance test behind the node-level probes.
+
+    `probe_dir` must NOT be the benchmark manifests directory: the README
+    tells users to `kubectl apply -f manifests/generated/` wholesale, and
+    the probe must not ride along and contend for the TPU hosts.
+    """
+    import yaml
+
+    from tritonk8ssupervisor_tpu.config import compile as compiler
+    from pathlib import Path
+
+    probe_dir = Path(probe_dir)
+    probe_dir.mkdir(parents=True, exist_ok=True)
+    manifest = probe_dir / "tpu-probe.yaml"
+    job_kwargs = {"image": image} if image else {}
+    manifest.write_text(
+        yaml.safe_dump(compiler.to_probe_job(config, **job_kwargs), sort_keys=False)
+    )
+    run(["kubectl", "apply", "-f", str(manifest)])
+    try:
+        poll(
+            lambda: _probe_job_status(
+                run_quiet(["kubectl", "get", "job", "tpu-probe", "-o", "json"])
+            ),
+            timeout=timeout_seconds,
+            sleep=sleep,
+        )
+    finally:
+        try:
+            run(["kubectl", "delete", "-f", str(manifest), "--ignore-not-found"])
+        except run_mod.CommandError:
+            pass

@@ -64,6 +64,12 @@ class RunPaths:
         return self.root / "manifests" / "generated"
 
     @property
+    def probe_dir(self) -> Path:
+        # separate from manifests_dir: users `kubectl apply -f` the whole
+        # generated dir, and the probe Job must not ride along
+        return self.root / "manifests" / "probe"
+
+    @property
     def runlog(self) -> Path:
         return self.root / "runlog.jsonl"
 
