@@ -142,6 +142,12 @@ def test_generated_vars_cover_role_consumption():
     assert per_cluster <= generated, sorted(per_cluster - generated)
 
 
+def test_jax_pin_single_source():
+    """The probe Job and the tpuhost role must install the same jax."""
+    defaults = load_yaml("ansible/roles/tpuhost/defaults/main.yml")
+    assert defaults["jax_version"] == cc.JAX_VERSION_PIN
+
+
 def test_ansible_cfg_contract():
     text = (REPO / "ansible" / "ansible.cfg").read_text()
     assert "host_key_checking = False" in text

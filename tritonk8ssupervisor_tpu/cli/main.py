@@ -169,11 +169,17 @@ def provision(args, paths: state.RunPaths, prompter: Prompter) -> int:
     else:
         config = wizard.run_wizard(prompter, env=env)
 
-    # Fail the SSH-key precondition BEFORE any resources are created — the
-    # reference validated its key up front too (setup.sh:231-237).
+    # Fail preconditions BEFORE any resources are created — the reference
+    # validated its key up front too (setup.sh:231-237).
     ssh_key: Path | str = ""
     if config.mode == "tpu-vm":
         ssh_key = discovery.find_ssh_key()
+        if args.probe:
+            raise ConfigError(
+                "--probe runs a Kubernetes Job and requires mode=gke; "
+                "tpu-vm slices get the same acceptance test from the "
+                "tpuhost ansible role"
+            )
 
     if not args.yes and not wizard.verify_config(config, prompter):
         prompter.say("Aborted; nothing was provisioned.")

@@ -233,9 +233,11 @@ def to_benchmark_job(
     }
 
 
-# Pinned to the same version the tpuhost role installs
-# (ansible/roles/tpuhost/defaults/main.yml).
-PROBE_JAX_PIN = "jax[tpu]==0.4.38"
+# THE host jax pin. The tpuhost role defaults
+# (ansible/roles/tpuhost/defaults/main.yml jax_version) must match;
+# tests/test_infra.py enforces the equality since YAML can't import this.
+JAX_VERSION_PIN = "0.4.38"
+PROBE_JAX_PIN = f"jax[tpu]=={JAX_VERSION_PIN}"
 PROBE_LIBTPU_INDEX = "https://storage.googleapis.com/jax-releases/libtpu_releases.html"
 
 

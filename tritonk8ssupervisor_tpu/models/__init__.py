@@ -8,5 +8,6 @@ TPU VM slice and as a K8s Job (config/compile.py to_benchmark_job).
 """
 
 from tritonk8ssupervisor_tpu.models.resnet import ResNet, ResNet18, ResNet50
+from tritonk8ssupervisor_tpu.models.transformer import TransformerLM
 
-__all__ = ["ResNet", "ResNet18", "ResNet50"]
+__all__ = ["ResNet", "ResNet18", "ResNet50", "TransformerLM"]

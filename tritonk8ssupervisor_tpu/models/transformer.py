@@ -1,0 +1,101 @@
+"""Decoder-only Transformer LM with pluggable attention.
+
+The second model family (the flagship benchmark is ResNet-50 — BASELINE.json);
+this one exists to exercise the long-context path: pass a ring-attention
+closure (ops/ring_attention.py) as `attention_fn` and the sequence axis
+shards across the mesh — per-device activation memory scales as O(S/n)
+while the math stays exact.
+
+TPU layout notes: embeddings and MLP widths stay multiples of 128 (lane
+width) so XLA tiles them onto the MXU; compute in bf16, params in f32,
+logits in f32 for the softmax (same recipe as models/resnet.py).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from tritonk8ssupervisor_tpu.ops.ring_attention import attention_reference
+
+# attention_fn signature: (q, k, v, causal) -> out, all (B, S, H, D)
+AttentionFn = Callable[..., Any]
+
+
+def dense_attention(q, k, v, causal: bool = True):
+    return attention_reference(q, k, v, causal=causal)
+
+
+class Block(nn.Module):
+    num_heads: int
+    attention_fn: AttentionFn
+    mlp_ratio: int
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x):
+        b, s, e = x.shape
+        head_dim = e // self.num_heads
+        dense = partial(nn.Dense, dtype=self.dtype, param_dtype=jnp.float32)
+
+        y = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32)(x)
+        qkv = dense(3 * e, name="qkv")(y)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, s, self.num_heads, head_dim)
+        k = k.reshape(b, s, self.num_heads, head_dim)
+        v = v.reshape(b, s, self.num_heads, head_dim)
+        attn = self.attention_fn(q, k, v, causal=True)
+        x = x + dense(e, name="proj")(attn.reshape(b, s, e))
+
+        y = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32)(x)
+        y = dense(self.mlp_ratio * e, name="mlp_up")(y)
+        y = nn.gelu(y)
+        x = x + dense(e, name="mlp_down")(y)
+        return x
+
+
+class TransformerLM(nn.Module):
+    """Causal LM: tokens (batch, seq) int32 -> logits (batch, seq, vocab)."""
+
+    vocab_size: int = 32000
+    num_layers: int = 4
+    num_heads: int = 8
+    embed_dim: int = 512
+    mlp_ratio: int = 4
+    max_seq_len: int = 2048
+    attention_fn: AttentionFn = dense_attention
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = True):
+        b, s = tokens.shape
+        tok = nn.Embed(
+            self.vocab_size,
+            self.embed_dim,
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+            name="tok_embed",
+        )(tokens)
+        pos = self.param(
+            "pos_embed",
+            nn.initializers.normal(0.02),
+            (self.max_seq_len, self.embed_dim),
+            jnp.float32,
+        )
+        x = tok + pos[:s].astype(self.dtype)
+        for _ in range(self.num_layers):
+            x = Block(
+                num_heads=self.num_heads,
+                attention_fn=self.attention_fn,
+                mlp_ratio=self.mlp_ratio,
+                dtype=self.dtype,
+            )(x)
+        x = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32)(x)
+        logits = nn.Dense(
+            self.vocab_size, dtype=jnp.float32, param_dtype=jnp.float32,
+            name="lm_head",
+        )(x)
+        return logits

@@ -1,0 +1,149 @@
+"""Ring attention: exact attention over a sequence sharded across devices.
+
+Long-context sequence parallelism, TPU-native: the sequence axis is
+sharded over a mesh axis; each device holds one block of Q/K/V. K/V blocks
+rotate around the ring with `jax.lax.ppermute` (nearest-neighbour ICI
+traffic only — no all-gather, so per-device memory stays O(S/n)), while
+each device folds the visiting block into a numerically-stable online
+softmax (flash-attention-style running max/sum). After n hops every query
+block has attended to every key block exactly once; results are exact, not
+approximate.
+
+Communication pattern: n-1 ppermute hops of the (B, S/n, H, D) K/V blocks
+— the canonical ring schedule that keeps collectives on ICI
+(SURVEY.md §2.5: the framework's data plane is XLA collectives over
+ICI/DCN, not a hand-written transport).
+
+The reference framework had no attention (or any ML) code; this op exists
+so long-context models slot into the same mesh machinery as the flagship
+benchmark (SURVEY.md §5 "the benchmark layer should not preclude
+multi-slice / long-sequence workloads").
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:  # jax >= 0.6 exports shard_map at the top level
+    from jax import shard_map
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
+
+_NEG_INF = -1e30  # large-finite instead of -inf: keeps exp() and grads clean
+
+
+def _mark_varying(x, axis_name: str):
+    """Mark a fresh per-device array as device-varying for shard_map's
+    axis-typing (newer jax). Older jax (e.g. the 0.4.x pinned on TPU
+    hosts) has no such typing — identity there."""
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, (axis_name,), to="varying")
+    if hasattr(jax.lax, "pvary"):
+        return jax.lax.pvary(x, (axis_name,))
+    return x
+
+
+def attention_reference(q, k, v, causal: bool = False):
+    """Dense single-device attention — ground truth for the ring tests.
+
+    Shapes: q/k/v (batch, seq, heads, head_dim) -> (batch, seq, heads, head_dim).
+    """
+    b, s, h, d = q.shape
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(d).astype(q.dtype)
+    if causal:
+        qpos = jnp.arange(s)[:, None]
+        kpos = jnp.arange(s)[None, :]
+        scores = jnp.where(qpos >= kpos, scores, _NEG_INF)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+
+
+def _ring_shard(q, k, v, *, axis_name: str, causal: bool):
+    """Per-device body under shard_map: q/k/v are this device's sequence
+    block (batch, block, heads, head_dim)."""
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, blk, h, d = q.shape
+    scale = 1.0 / jnp.sqrt(d).astype(jnp.float32)
+
+    # online softmax state (f32 accumulation regardless of input dtype);
+    # marked device-varying so the scan carry type matches the
+    # q/k/v-derived outputs under shard_map's axis typing
+    m = jnp.full((b, h, blk), _NEG_INF, jnp.float32)       # running max
+    l = jnp.zeros((b, h, blk), jnp.float32)                # running sum
+    acc = jnp.zeros((b, blk, h, d), jnp.float32)           # running output
+    m, l, acc = (_mark_varying(x, axis_name) for x in (m, l, acc))
+
+    qpos = idx * blk + jnp.arange(blk)
+
+    def fold(stats, k, v, src):
+        """Fold one visiting K/V block into the online softmax."""
+        m, l, acc = stats
+        scores = (
+            jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+        )
+        if causal:
+            kpos = src * blk + jnp.arange(blk)
+            mask = qpos[:, None] >= kpos[None, :]
+            scores = jnp.where(mask[None, None], scores, _NEG_INF)
+        m_new = jnp.maximum(m, scores.max(axis=-1))
+        correction = jnp.exp(m - m_new)
+        p = jnp.exp(scores - m_new[..., None])
+        l = l * correction + p.sum(axis=-1)
+        acc = acc * correction.transpose(0, 2, 1)[..., None] + jnp.einsum(
+            "bhqk,bkhd->bqhd", p, v.astype(jnp.float32)
+        )
+        return m_new, l, acc
+
+    # hop 0: this device's own block — no communication
+    stats = fold((m, l, acc), k, v, idx)
+
+    def hop_body(carry, hop):
+        stats, k, v = carry
+        # rotate K/V to the next device (nearest-neighbour ICI), then fold;
+        # rotating first keeps the total at n-1 ppermute rounds
+        perm = [(j, (j - 1) % n) for j in range(n)]
+        k = jax.lax.ppermute(k, axis_name, perm)
+        v = jax.lax.ppermute(v, axis_name, perm)
+        stats = fold(stats, k, v, (idx + hop) % n)
+        return (stats, k, v), None
+
+    # n is static at trace time (mesh size); scan keeps the graph compact
+    (stats, k, v), _ = jax.lax.scan(
+        hop_body, (stats, k, v), jnp.arange(1, n)
+    )
+    m, l, acc = stats
+    out = acc / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention(
+    q,
+    k,
+    v,
+    mesh: Mesh,
+    axis_name: str,
+    causal: bool = False,
+):
+    """Exact attention with the sequence dim sharded over `axis_name`.
+
+    q/k/v: (batch, seq, heads, head_dim), seq divisible by the axis size.
+    Returns the same shape, sharded identically.
+    """
+    seq_spec = P(None, axis_name, None, None)
+    fn = shard_map(
+        functools.partial(_ring_shard, axis_name=axis_name, causal=causal),
+        mesh=mesh,
+        in_specs=(seq_spec, seq_spec, seq_spec),
+        out_specs=seq_spec,
+    )
+    return fn(q, k, v)
+
+
+def sequence_sharding(mesh: Mesh, axis_name: str) -> NamedSharding:
+    """Sharding for (batch, seq, ...) activations with seq over the ring axis."""
+    return NamedSharding(mesh, P(None, axis_name, None, None))

@@ -130,3 +130,55 @@ def make_train_step(
         out_shardings=(state_shardings, {"loss": metric_sh, "accuracy": metric_sh}),
         donate_argnums=(0,),
     )
+
+
+def make_lm_train_step(
+    model,
+    tx: optax.GradientTransformation,
+    mesh,
+    state_shardings,
+    seq_axis: str | None = None,
+    loss_fn: Callable | None = None,
+):
+    """Causal-LM train step: (state, tokens) -> (state, metrics).
+
+    tokens (batch, seq) arrive batch-sharded over "data" and — when
+    `seq_axis` names the ring-attention mesh axis — sequence-sharded over
+    it; the next-token shift's one-position halo exchange is XLA's to
+    insert, like every other collective here.
+    """
+    if loss_fn is None:
+        loss_fn = (
+            cross_entropy_loss
+            if jax.default_backend() == "tpu"
+            else cross_entropy_loss_reference
+        )
+
+    def compute_loss(params, tokens):
+        logits = model.apply({"params": params}, tokens, train=True)
+        targets = tokens[:, 1:].reshape(-1)
+        flat = logits[:, :-1].reshape(-1, logits.shape[-1])
+        loss = jnp.mean(loss_fn(flat, targets))
+        return loss, flat.argmax(axis=-1) == targets
+
+    def step(state: TrainState, tokens):
+        grad_fn = jax.value_and_grad(compute_loss, has_aux=True)
+        (loss, correct), grads = grad_fn(state.params, tokens)
+        updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        new_state = TrainState(
+            step=state.step + 1,
+            params=new_params,
+            batch_stats=state.batch_stats,
+            opt_state=new_opt_state,
+        )
+        return new_state, {"loss": loss, "accuracy": jnp.mean(correct)}
+
+    token_sh = NamedSharding(mesh, P(mesh_lib.DATA_AXIS, seq_axis))
+    metric_sh = NamedSharding(mesh, P())
+    return jax.jit(
+        step,
+        in_shardings=(state_shardings, token_sh),
+        out_shardings=(state_shardings, {"loss": metric_sh, "accuracy": metric_sh}),
+        donate_argnums=(0,),
+    )
