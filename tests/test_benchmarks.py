@@ -39,6 +39,24 @@ def test_cpu_benchmark_hashes_exact_byte_count():
     assert odd["md5"] == hashlib.md5(data).hexdigest()
 
 
+def test_lm_benchmark_sequence_parallel_smoke():
+    """Tiny LM benchmark end-to-end on the CPU mesh with the ring path
+    (sequence_parallelism=4) — the long-context configuration."""
+    from tritonk8ssupervisor_tpu.benchmarks import lm
+
+    result = lm.run_benchmark(
+        vocab_size=256, num_layers=1, num_heads=2, embed_dim=32,
+        seq_len=32, batch_per_data_shard=2, steps=2, warmup=1,
+        sequence_parallelism=4,
+    )
+    assert result["num_chips"] == 8
+    assert result["sequence_parallelism"] == 4
+    assert result["tokens_per_sec"] > 0
+    import numpy as np
+
+    assert np.isfinite(result["final_loss"])
+
+
 def test_containerbench_cli_json(tmp_path):
     proc = subprocess.run(
         [sys.executable, "-m", "tritonk8ssupervisor_tpu.benchmarks.containerbench",

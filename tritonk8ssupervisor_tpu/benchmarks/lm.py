@@ -1,0 +1,165 @@
+"""Transformer-LM training throughput benchmark (tokens/sec/chip).
+
+The long-context companion to the ResNet-50 flagship (benchmarks/resnet50.py):
+a causal LM trained on synthetic tokens, optionally with the sequence axis
+sharded across the mesh via ring attention (ops/ring_attention.py) — the
+configuration that matters once sequences no longer fit one device's HBM.
+
+Same measurement discipline as the flagship: synthetic on-device data,
+donated-state step chaining, host-fetch timing fence.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tritonk8ssupervisor_tpu.models import TransformerLM
+from tritonk8ssupervisor_tpu.ops.ring_attention import ring_attention
+from tritonk8ssupervisor_tpu.parallel import initialize_from_env, make_mesh
+from tritonk8ssupervisor_tpu.parallel import train as train_lib
+from tritonk8ssupervisor_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+
+def run_benchmark(
+    vocab_size: int = 32768,
+    num_layers: int = 12,
+    num_heads: int = 12,
+    embed_dim: int = 768,
+    seq_len: int = 1024,
+    batch_per_data_shard: int = 8,
+    steps: int = 20,
+    warmup: int = 3,
+    sequence_parallelism: int = 1,
+    learning_rate: float = 3e-2,
+) -> dict:
+    """Train a causal LM on synthetic tokens; returns a metrics dict.
+
+    sequence_parallelism > 1 puts the sequence axis on the "model" mesh
+    axis and switches attention to the ring implementation.
+    """
+    if seq_len % max(sequence_parallelism, 1):
+        raise ValueError(
+            f"--seq-len {seq_len} must be divisible by "
+            f"--sequence-parallelism {sequence_parallelism} "
+            "(the sequence axis shards evenly across the ring)"
+        )
+    mesh = make_mesh(model_parallelism=sequence_parallelism)
+    num_chips = mesh.devices.size
+    global_batch = batch_per_data_shard * mesh.shape[DATA_AXIS]
+
+    if sequence_parallelism > 1:
+        def attention_fn(q, k, v, causal=True):
+            return ring_attention(
+                q, k, v, mesh=mesh, axis_name=MODEL_AXIS, causal=causal
+            )
+    else:
+        from tritonk8ssupervisor_tpu.models.transformer import dense_attention
+
+        attention_fn = dense_attention
+
+    model = TransformerLM(
+        vocab_size=vocab_size,
+        num_layers=num_layers,
+        num_heads=num_heads,
+        embed_dim=embed_dim,
+        max_seq_len=seq_len,
+        attention_fn=attention_fn,
+    )
+    tx = train_lib.default_optimizer(learning_rate=learning_rate)
+    sample = jax.ShapeDtypeStruct((global_batch, seq_len), jnp.int32)
+    init_start = time.monotonic()
+    state, shardings = train_lib.create_train_state(
+        model, jax.random.key(0), sample, mesh, tx
+    )
+    seq_axis = MODEL_AXIS if sequence_parallelism > 1 else None
+    step = train_lib.make_lm_train_step(
+        model, tx, mesh, shardings, seq_axis=seq_axis
+    )
+    tokens = jax.device_put(
+        jax.random.randint(jax.random.key(1), sample.shape, 0, vocab_size),
+        NamedSharding(mesh, P(DATA_AXIS, seq_axis)),
+    )
+
+    state, metrics = step(state, tokens)  # first step = compile
+    float(metrics["loss"])
+    compile_seconds = time.monotonic() - init_start
+    for _ in range(max(0, warmup - 1)):
+        state, metrics = step(state, tokens)
+    float(metrics["loss"])
+
+    start = time.monotonic()
+    for _ in range(steps):
+        state, metrics = step(state, tokens)
+    final_loss = float(metrics["loss"])
+    elapsed = time.monotonic() - start
+
+    tokens_per_sec = global_batch * seq_len * steps / elapsed
+    return {
+        "model": "transformer_lm",
+        "platform": jax.default_backend(),
+        "num_chips": int(num_chips),
+        "sequence_parallelism": int(sequence_parallelism),
+        "global_batch": int(global_batch),
+        "seq_len": seq_len,
+        "num_layers": num_layers,
+        "embed_dim": embed_dim,
+        "steps": steps,
+        "step_ms": elapsed / steps * 1000,
+        "tokens_per_sec": tokens_per_sec,
+        "tokens_per_sec_per_chip": tokens_per_sec / num_chips,
+        "compile_seconds": compile_seconds,
+        "final_loss": final_loss,
+    }
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--vocab-size", type=int, default=32768)
+    parser.add_argument("--num-layers", type=int, default=12)
+    parser.add_argument("--num-heads", type=int, default=12)
+    parser.add_argument("--embed-dim", type=int, default=768)
+    parser.add_argument("--seq-len", type=int, default=1024)
+    parser.add_argument("--batch-per-data-shard", type=int, default=8)
+    parser.add_argument("--steps", type=int, default=20)
+    parser.add_argument("--warmup", type=int, default=3)
+    parser.add_argument("--sequence-parallelism", type=int, default=1)
+    parser.add_argument("--json", action="store_true")
+    return parser
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    initialize_from_env()
+    result = run_benchmark(
+        vocab_size=args.vocab_size,
+        num_layers=args.num_layers,
+        num_heads=args.num_heads,
+        embed_dim=args.embed_dim,
+        seq_len=args.seq_len,
+        batch_per_data_shard=args.batch_per_data_shard,
+        steps=args.steps,
+        warmup=args.warmup,
+        sequence_parallelism=args.sequence_parallelism,
+    )
+    if args.json:
+        print(json.dumps(result, sort_keys=True))
+    else:
+        print(
+            f"{result['model']} on {result['num_chips']} {result['platform']} "
+            f"chip(s), seq {result['seq_len']} "
+            f"(sp={result['sequence_parallelism']}): "
+            f"{result['tokens_per_sec']:.0f} tok/s total, "
+            f"{result['tokens_per_sec_per_chip']:.0f} tok/s/chip, "
+            f"step {result['step_ms']:.1f} ms"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -170,16 +170,16 @@ def provision(args, paths: state.RunPaths, prompter: Prompter) -> int:
         config = wizard.run_wizard(prompter, env=env)
 
     # Fail preconditions BEFORE any resources are created — the reference
-    # validated its key up front too (setup.sh:231-237).
+    # validated its key up front too (setup.sh:231-237). Cheapest first.
     ssh_key: Path | str = ""
     if config.mode == "tpu-vm":
-        ssh_key = discovery.find_ssh_key()
         if args.probe:
             raise ConfigError(
                 "--probe runs a Kubernetes Job and requires mode=gke; "
                 "tpu-vm slices get the same acceptance test from the "
                 "tpuhost ansible role"
             )
+        ssh_key = discovery.find_ssh_key()
 
     if not args.yes and not wizard.verify_config(config, prompter):
         prompter.say("Aborted; nothing was provisioned.")

@@ -68,22 +68,28 @@ def _forward(logits, labels, interpret):
     batch, num_classes = logits.shape
     padded_c = -(-num_classes // _LANE) * _LANE
     block_b = min(_BLOCK_B, batch)
-    if batch % block_b:  # uneven batch: let XLA handle it, not worth a kernel
-        return cross_entropy_loss_reference(logits, labels)
+    # Pad uneven batches up to a block multiple with dummy rows (sliced off
+    # after) rather than falling back to XLA: LM losses flatten
+    # batch*(seq-1) rows, which almost never lands on a block boundary,
+    # and the fused kernel matters most there (huge vocab).
+    batch_pad = -batch % block_b
+    if batch_pad:
+        logits = jnp.pad(logits, ((0, batch_pad), (0, 0)))
+        labels = jnp.pad(labels, ((0, batch_pad),))
     if padded_c != num_classes:
         logits = jnp.pad(logits, ((0, 0), (0, padded_c - num_classes)))
     out = pl.pallas_call(
         functools.partial(_ce_kernel, num_classes=num_classes),
-        grid=(batch // block_b,),
+        grid=((batch + batch_pad) // block_b,),
         in_specs=[
             pl.BlockSpec((block_b, padded_c), lambda i: (i, 0)),
             pl.BlockSpec((block_b, 1), lambda i: (i, 0)),
         ],
         out_specs=pl.BlockSpec((block_b, 1), lambda i: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct((batch, 1), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((batch + batch_pad, 1), jnp.float32),
         interpret=interpret,
     )(logits, labels.astype(jnp.int32)[:, None])
-    return out[:, 0]
+    return out[:batch, 0]
 
 
 def _forward_fwd(logits, labels, interpret):

@@ -35,6 +35,16 @@ class TrainState:
     opt_state: Any
 
 
+def _default_loss_fn() -> Callable:
+    """One policy for both step factories: pallas fused loss on TPU,
+    pure-XLA reference elsewhere."""
+    return (
+        cross_entropy_loss
+        if jax.default_backend() == "tpu"
+        else cross_entropy_loss_reference
+    )
+
+
 def default_optimizer(
     learning_rate: float = 0.1, momentum: float = 0.9
 ) -> optax.GradientTransformation:
@@ -87,12 +97,7 @@ def make_train_step(
     (donated, so parameters update in place in HBM).
     """
     if loss_fn is None:
-        # pallas fused loss on TPU; pure-XLA reference elsewhere
-        loss_fn = (
-            cross_entropy_loss
-            if jax.default_backend() == "tpu"
-            else cross_entropy_loss_reference
-        )
+        loss_fn = _default_loss_fn()
 
     def compute_loss(params, batch_stats, images, labels):
         logits, updates = model.apply(
@@ -148,11 +153,7 @@ def make_lm_train_step(
     insert, like every other collective here.
     """
     if loss_fn is None:
-        loss_fn = (
-            cross_entropy_loss
-            if jax.default_backend() == "tpu"
-            else cross_entropy_loss_reference
-        )
+        loss_fn = _default_loss_fn()
 
     def compute_loss(params, tokens):
         logits = model.apply({"params": params}, tokens, train=True)
