@@ -1,0 +1,27 @@
+# Benchmark-workload image for GKE TPU Jobs.
+#
+# Optional: the generated Job (config/compile.py to_benchmark_job) is
+# self-sufficient by default — it pip-installs the framework from a
+# ConfigMap-mounted source archive at pod start, the same pattern as the
+# probe Job. Building this image instead moves that install to build time:
+#
+#   docker build -t REGION-docker.pkg.dev/PROJECT/REPO/tk8s-bench:latest .
+#   docker push   REGION-docker.pkg.dev/PROJECT/REPO/tk8s-bench:latest
+#   ./setup.sh --bench-image REGION-docker.pkg.dev/PROJECT/REPO/tk8s-bench:latest
+#   (or: BENCH_IMAGE=...  ./setup.sh — the flag's environment default)
+#
+# The reference's workloads ran from public images (reference
+# docs/benchmarks.md:1-4, docs/detailed.md:289-331); a TPU benchmark has no
+# public image carrying this framework, hence this Dockerfile.
+FROM python:3.11-slim
+
+WORKDIR /opt/tk8s-src
+COPY pyproject.toml README.md ./
+COPY tritonk8ssupervisor_tpu ./tritonk8ssupervisor_tpu
+
+# jax[tpu]==<pin> resolves libtpu from the Google releases index; the pin
+# here rides the `tpu` extra so it stays equal to JAX_VERSION_PIN.
+RUN pip install --no-cache-dir ".[tpu]" \
+    -f https://storage.googleapis.com/jax-releases/libtpu_releases.html
+
+CMD ["python", "-m", "tritonk8ssupervisor_tpu.benchmarks.resnet50", "--json"]

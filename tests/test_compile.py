@@ -110,7 +110,12 @@ def test_probe_job_covers_all_slices():
 def test_write_manifests_multi_slice(tmp_path):
     paths = cc.write_manifests(cfg(num_slices=2), tmp_path)
     names = sorted(p.name for p in paths)
-    assert names == ["bench-job-0.yaml", "bench-job-1.yaml", "bench-service.yaml"]
+    assert names == [
+        "bench-job-0.yaml",
+        "bench-job-1.yaml",
+        "bench-service.yaml",
+        "package-configmap.yaml",
+    ]
     job0 = yaml.safe_load((tmp_path / "bench-job-0.yaml").read_text())
     assert job0["metadata"]["name"] == "resnet50-bench-0"
     svc = yaml.safe_load((tmp_path / "bench-service.yaml").read_text())

@@ -13,6 +13,7 @@ setup.sh:9-12, 484-521).
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 from pathlib import Path
 
@@ -76,6 +77,15 @@ def build_parser() -> argparse.ArgumentParser:
         metavar="IMAGE",
         help="container image for the probe Job (default: plain python; "
         "the probe self-installs pinned jax[tpu])",
+    )
+    parser.add_argument(
+        "--bench-image",
+        default=os.environ.get("BENCH_IMAGE") or None,
+        metavar="IMAGE",
+        help="container image for the generated benchmark Job (default: "
+        "plain python + self-install of the framework from a ConfigMap; "
+        "build a custom image with the repo Dockerfile). Also read from "
+        "the BENCH_IMAGE environment variable.",
     )
     parser.add_argument(
         "--show-config",
@@ -202,7 +212,10 @@ def provision(args, paths: state.RunPaths, prompter: Prompter) -> int:
             wait_ready(config, args.readiness_timeout)
 
     with timer.phase("compile-manifests"):
-        manifest_paths = compiler.write_manifests(config, paths.manifests_dir)
+        job_kwargs = {"image": args.bench_image} if args.bench_image else {}
+        manifest_paths = compiler.write_manifests(
+            config, paths.manifests_dir, **job_kwargs
+        )
 
     if args.probe and config.mode == "gke":
         with timer.phase("probe-job"):

@@ -11,14 +11,21 @@ requests (the benchmark-workload analogue of docs/benchmarks.md).
 
 from __future__ import annotations
 
+import base64
 import json
 from pathlib import Path
 
 import yaml
 
+from tritonk8ssupervisor_tpu import packaging
 from tritonk8ssupervisor_tpu.config.schema import ClusterConfig
 
 BENCH_IMAGE_DEFAULT = "python:3.11-slim"
+# ConfigMap carrying the framework source archive (packaging.py); mounted
+# into the benchmark Job so the default plain-python image can self-install
+# the package — no registry required (the probe Job's pattern, extended).
+PACKAGE_CONFIGMAP_NAME = "tk8s-pkg"
+PACKAGE_MOUNT_PATH = "/opt/tk8s-pkg"
 
 
 # ---------------------------------------------------------------- terraform
@@ -150,9 +157,44 @@ def write_ansible_configs(
     (vars_dir / "all.yml").write_text(
         yaml.safe_dump(to_ansible_vars(config, coordinator_ip), sort_keys=True)
     )
+    # Stage the framework source archive for the tpuhost role (files/ is
+    # ansible's copy-module search path): every TPU host gets the package
+    # installed, so the success banner's advertised benchmark command runs
+    # on a fresh VM. Deterministic bytes -> ansible reports changed=false
+    # on converge re-runs.
+    packaging.build_source_archive(
+        ansible_dir / "roles" / "tpuhost" / "files" / packaging.ARCHIVE_NAME
+    )
 
 
 # -------------------------------------------------------------- k8s manifests
+
+
+def bench_command(module: str = "tritonk8ssupervisor_tpu.benchmarks.resnet50",
+                  extra_args: tuple[str, ...] = ("--json",)) -> str:
+    """Self-installing benchmark command for the default (plain python)
+    image: install the ConfigMap-mounted source archive + the pinned
+    jax[tpu], then run the module. This is what makes the generated Job
+    runnable as published — the reference's workloads ran straight from
+    public images (docs/benchmarks.md:1-4); ours ships its own source."""
+    return (
+        f"pip install --quiet {PACKAGE_MOUNT_PATH}/{packaging.ARCHIVE_NAME} "
+        f"'{PROBE_JAX_PIN}' -f {PROBE_LIBTPU_INDEX} && "
+        f"python -m {module} {' '.join(extra_args)}".rstrip()
+    )
+
+
+def to_package_configmap(root: Path | None = None) -> dict:
+    """The framework source archive as a ConfigMap (binaryData), mounted by
+    the benchmark Job. The archive is deterministic (packaging.py) so this
+    manifest is stable across re-runs."""
+    blob = packaging.build_archive_bytes(root)
+    return {
+        "apiVersion": "v1",
+        "kind": "ConfigMap",
+        "metadata": {"name": PACKAGE_CONFIGMAP_NAME},
+        "binaryData": {packaging.ARCHIVE_NAME: base64.b64encode(blob).decode()},
+    }
 
 
 def to_benchmark_job(
@@ -176,11 +218,20 @@ def to_benchmark_job(
     hosts = config.hosts_per_slice
     chips_on_host = spec.chips_on_host(topo)
     svc = f"{name}-svc"
+    # Default path: plain python image + self-install from the package
+    # ConfigMap (bench_command). A custom image is assumed to carry the
+    # framework already (Dockerfile at the repo root builds one).
+    self_install = command is None and image == BENCH_IMAGE_DEFAULT
+    if command is None:
+        command = (
+            ["bash", "-c", bench_command()]
+            if self_install
+            else ["python", "-m", "tritonk8ssupervisor_tpu.benchmarks.resnet50", "--json"]
+        )
     container = {
         "name": "bench",
         "image": image,
-        "command": command
-        or ["python", "-m", "tritonk8ssupervisor_tpu.benchmarks.resnet50"],
+        "command": command,
         "resources": {
             "requests": {"google.com/tpu": str(chips_on_host)},
             "limits": {"google.com/tpu": str(chips_on_host)},
@@ -203,6 +254,17 @@ def to_benchmark_job(
         ],
         "ports": [{"containerPort": 8476}],
     }
+    pod_spec_extra = {}
+    if self_install:
+        container["volumeMounts"] = [
+            {"name": "tk8s-pkg", "mountPath": PACKAGE_MOUNT_PATH, "readOnly": True}
+        ]
+        pod_spec_extra["volumes"] = [
+            {
+                "name": "tk8s-pkg",
+                "configMap": {"name": PACKAGE_CONFIGMAP_NAME},
+            }
+        ]
     return {
         "apiVersion": "batch/v1",
         "kind": "Job",
@@ -227,6 +289,7 @@ def to_benchmark_job(
                         "cloud.google.com/gke-tpu-topology": str(topo),
                     },
                     "containers": [container],
+                    **pod_spec_extra,
                 },
             },
         },
@@ -331,6 +394,10 @@ def _gke_accelerator_label(generation: str) -> str:
 def write_manifests(config: ClusterConfig, manifests_dir: Path, **job_kwargs) -> list[Path]:
     manifests_dir.mkdir(parents=True, exist_ok=True)
     paths = []
+    # package ConfigMap first: the Job's self-install mount depends on it
+    pkg = manifests_dir / "package-configmap.yaml"
+    pkg.write_text(yaml.safe_dump(to_package_configmap(), sort_keys=False))
+    paths.append(pkg)
     svc = manifests_dir / "bench-service.yaml"
     svc.write_text(yaml.safe_dump(to_headless_service(), sort_keys=False))
     paths.append(svc)

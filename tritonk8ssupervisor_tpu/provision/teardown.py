@@ -86,6 +86,7 @@ def _remove_generated_state(config: ClusterConfig, paths: RunPaths) -> None:
     paths.hosts_file.unlink(missing_ok=True)
     paths.inventory.unlink(missing_ok=True)
     (paths.ansible_dir / "group_vars" / "all.yml").unlink(missing_ok=True)
+    shutil.rmtree(paths.ansible_dir / "roles" / "tpuhost" / "files", ignore_errors=True)
     shutil.rmtree(paths.manifests_dir, ignore_errors=True)
     shutil.rmtree(paths.probe_dir, ignore_errors=True)
     paths.config_file.unlink(missing_ok=True)
