@@ -31,6 +31,12 @@ resource "google_container_cluster" "cluster" {
   name     = var.cluster_name
   location = var.zone
 
+  # Provider >= 5.0 defaults deletion_protection to true, which makes
+  # `./setup.sh -c` (terraform destroy, the cleanRunner analogue,
+  # reference setup.sh:498-503) error out on a live cluster. This tool
+  # owns the cluster lifecycle end to end, so destroy must work.
+  deletion_protection = false
+
   # The default pool only hosts system pods (the master's "everything else"
   # role in the reference); TPU pools are added per slice below.
   initial_node_count       = 1

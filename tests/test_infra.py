@@ -80,7 +80,7 @@ def test_playbook_targets_generated_inventory_groups():
     plays = load_yaml("ansible/clusterUp.yml")
     targets = [p["hosts"] for p in plays]
     assert targets == ["TPUHOST", "LOCAL"]
-    inventory = cc.to_inventory(cfg(), ["10.0.0.1"])
+    inventory = cc.to_inventory(cfg(), [["10.0.0.1"]])
     for group in targets:
         assert f"[{group}]" in inventory or group == "LOCAL" and "localhost" in inventory
     roles = [role for p in plays for role in p["roles"]]
@@ -158,7 +158,7 @@ def test_ansible_cfg_contract():
                     reason="ansible not installed")
 def test_playbook_syntax_check(tmp_path):
     inv = tmp_path / "hosts"
-    inv.write_text(cc.to_inventory(cfg(), ["10.0.0.1"]))
+    inv.write_text(cc.to_inventory(cfg(), [["10.0.0.1"]]))
     proc = subprocess.run(
         ["ansible-playbook", "-i", str(inv), "--syntax-check", "clusterUp.yml"],
         cwd=REPO / "ansible", capture_output=True, text=True,

@@ -12,6 +12,7 @@ from tritonk8ssupervisor_tpu.config.schema import ClusterConfig
 from tritonk8ssupervisor_tpu.provision import (
     ansible as ansible_mod,
     readiness,
+    runner as run_mod,
     state,
     teardown,
     terraform as terraform_mod,
@@ -83,7 +84,10 @@ def test_terraform_apply_sequences_and_persists_hosts(tmp_path):
     quiet = RecordingRunner(
         responses={
             ("terraform", "output", "-json"): json.dumps(
-                {"host_ips": {"value": [["10.0.0.1", "10.0.0.2"]]}}
+                {
+                    "host_ips": {"value": [["34.1.1.1", "34.1.1.2"]]},
+                    "internal_ips": {"value": [["10.0.0.1", "10.0.0.2"]]},
+                }
             )
         }
     )
@@ -93,9 +97,30 @@ def test_terraform_apply_sequences_and_persists_hosts(tmp_path):
         "terraform apply -auto-approve -input=false -no-color",
     ]
     assert run.calls[0][1] == paths.terraform_module("tpu-vm")
+    # coordinator comes from the VPC-internal output, never external NAT
     assert hosts.coordinator_ip == "10.0.0.1"
+    assert hosts.internal_ips == [["10.0.0.1", "10.0.0.2"]]
     assert paths.tfvars("tpu-vm").exists()
-    assert state.load_hosts(paths).flat_ips == ["10.0.0.1", "10.0.0.2"]
+    assert state.load_hosts(paths).flat_ips == ["34.1.1.1", "34.1.1.2"]
+
+
+def test_terraform_outputs_without_internal_ips_fall_back(tmp_path, capsys):
+    """Older tfstate / stub backends may omit internal_ips; external IPs
+    then serve as coordinator source rather than crashing — loudly, since
+    external-NAT rendezvous usually fails."""
+    quiet = RecordingRunner(
+        responses={
+            ("terraform", "output", "-json"): json.dumps(
+                {"host_ips": {"value": [["34.1.1.1"]]}}
+            )
+        }
+    )
+    hosts = terraform_mod.collect_outputs(
+        cfg(), state.RunPaths(tmp_path), run_quiet=quiet
+    )
+    assert hosts.coordinator_ip == "34.1.1.1"
+    assert hosts.internal_ips == []
+    assert "internal_ips" in capsys.readouterr().err
 
 
 def test_terraform_gke_outputs(tmp_path):
@@ -149,9 +174,18 @@ def test_write_runtime_configs(tmp_path):
     paths = state.RunPaths(tmp_path)
     paths.ansible_dir.mkdir()
     paths.ansible_cfg.write_text("[defaults]\nprivate_key_file =\n")
-    hosts = state.ClusterHosts(host_ips=[["10.0.0.1"]], coordinator_ip="10.0.0.1")
-    ansible_mod.write_runtime_configs(cfg(), hosts, paths, ssh_key="/k")
-    assert "10.0.0.1" in paths.inventory.read_text()
+    hosts = state.ClusterHosts(
+        host_ips=[["34.1.1.1"]],
+        internal_ips=[["10.0.0.1"]],
+        coordinator_ip="10.0.0.1",
+    )
+    ansible_mod.write_runtime_configs(
+        cfg(), hosts, paths, ssh_key="/k", ansible_user="alice"
+    )
+    inventory = paths.inventory.read_text()
+    # external IP addresses the host; internal IP is the coordinator
+    assert "34.1.1.1 slice_index=0 process_id=0 slice_coordinator=10.0.0.1" in inventory
+    assert "ansible_user=alice" in inventory
     assert (paths.ansible_dir / "group_vars" / "all.yml").exists()
     assert "private_key_file = /k" in paths.ansible_cfg.read_text()
 
@@ -207,6 +241,38 @@ def test_tpu_vm_probe_states():
     assert "CREATING" in readiness.tpu_vm_probe(config, ["n-0"], quiet)
     quiet = RecordingRunner(responses={("gcloud",): "READY\n"})
     assert readiness.tpu_vm_probe(config, ["n-0", "n-1"], quiet) == ""
+
+
+def test_ssh_ready_probe_uses_ansible_credentials():
+    quiet = RecordingRunner()
+    why = readiness.ssh_ready_probe(
+        ["10.0.0.1", "10.0.0.2"], ssh_user="alice", ssh_key="/k", run_quiet=quiet
+    )
+    assert why == ""
+    for args, _ in quiet.calls:
+        assert args[0] == "ssh" and args[-1] == "true"
+        assert "BatchMode=yes" in args
+        assert "-i" in args and "/k" in args
+        assert "-l" in args and "alice" in args
+    assert {args[-2] for args, _ in quiet.calls} == {"10.0.0.1", "10.0.0.2"}
+
+
+def test_ssh_ready_probe_reports_unreachable_host():
+    def failing(args, cwd=None, **kwargs):
+        raise run_mod.CommandError(args, 255)
+
+    why = readiness.ssh_ready_probe(["10.0.0.9"], run_quiet=failing)
+    assert "10.0.0.9" in why and "255" in why
+
+
+def test_modes_with_state(tmp_path):
+    paths = state.RunPaths(tmp_path)
+    assert terraform_mod.modes_with_state(paths) == []
+    paths.terraform_module("gke").mkdir(parents=True)
+    paths.tfstate("gke").write_text('{"resources": [{"type": "x"}]}')
+    paths.terraform_module("tpu-vm").mkdir(parents=True)
+    paths.tfstate("tpu-vm").write_text('{"resources": []}')  # empty -> skip
+    assert terraform_mod.modes_with_state(paths) == ["gke"]
 
 
 def test_poll_until_ready_and_timeout():

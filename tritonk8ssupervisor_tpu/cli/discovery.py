@@ -107,6 +107,20 @@ def find_ssh_key(ssh_dir: Path | None = None) -> Path:
     )
 
 
+def ssh_username() -> str:
+    """The SSH login for TPU VMs. GCP maps metadata/OS-Login SSH keys to
+    user accounts and disables direct root login, so the inventory must
+    not say root (the reference's VMs accepted root after the key copy,
+    reference terraform/master/main.tf:13-27 — GCP works differently).
+    `gcloud compute ssh` / `gcloud compute tpus tpu-vm ssh` default to the
+    local OS username; TK8S_SSH_USER overrides for OS-Login setups whose
+    derived username differs."""
+    import getpass
+    import os
+
+    return os.environ.get("TK8S_SSH_USER") or getpass.getuser()
+
+
 def list_tpu_zones(generation: str, run: Runner = _default_runner) -> list[str]:
     """Zones offering `generation`, live when credentials allow, otherwise
     the static catalog — the same live-with-fallback pattern as the

@@ -15,6 +15,7 @@ from __future__ import annotations
 import argparse
 import os
 import sys
+import time
 from pathlib import Path
 
 from tritonk8ssupervisor_tpu.cli import discovery, wizard
@@ -139,10 +140,16 @@ def show_config(args, paths: state.RunPaths, prompter: Prompter) -> int:
 
 
 def clean(args, paths: state.RunPaths, prompter: Prompter) -> int:
-    if not paths.config_file.exists():
-        prompter.say("No config file found — nothing to clean.")
+    if paths.config_file.exists():
+        config = store.load_config_file(paths.config_file)
+    elif terraform_mod.modes_with_state(paths) or paths.hosts_file.exists():
+        # Config gone but terraform state remains (partial manual cleanup):
+        # resources must not leak just because `config` was deleted — the
+        # reference's cleanRunner keyed off state files (setup.sh:484-521).
+        config = None
+    else:
+        prompter.say("No config or terraform state found — nothing to clean.")
         return 0
-    config = store.load_config_file(paths.config_file)
     ok = teardown.clean(config, paths, prompter, assume_yes=args.yes)
     return 0 if ok else 1
 
@@ -182,6 +189,7 @@ def provision(args, paths: state.RunPaths, prompter: Prompter) -> int:
     # Fail preconditions BEFORE any resources are created — the reference
     # validated its key up front too (setup.sh:231-237). Cheapest first.
     ssh_key: Path | str = ""
+    ssh_user = ""
     if config.mode == "tpu-vm":
         if args.probe:
             raise ConfigError(
@@ -190,6 +198,7 @@ def provision(args, paths: state.RunPaths, prompter: Prompter) -> int:
                 "tpuhost ansible role"
             )
         ssh_key = discovery.find_ssh_key()
+        ssh_user = discovery.ssh_username()
 
     if not args.yes and not wizard.verify_config(config, prompter):
         prompter.say("Aborted; nothing was provisioned.")
@@ -203,11 +212,36 @@ def provision(args, paths: state.RunPaths, prompter: Prompter) -> int:
             prompter.say("terraform state present; converging existing deployment")
         hosts = terraform_mod.apply(config, paths)
 
+    # tpu-vm mode: readiness comes BEFORE host configuration — ansible
+    # needs live sshd on every host (TPU state READY + SSH banner; the
+    # deterministic replacement for the reference's sleep-30 bootstrap,
+    # terraform/master/main.tf:22). GKE keeps readiness after: the gkejoin
+    # play itself fetches credentials, and node registration is what the
+    # wait observes.
+    if config.mode == "tpu-vm" and not args.skip_readiness:
+        with timer.phase("readiness-wait"):
+            # one shared budget for both polls — the user's timeout caps
+            # the whole phase, not each poll
+            poll_start = time.monotonic()
+            wait_ready(config, args.readiness_timeout)
+            remaining = max(
+                0.0, args.readiness_timeout - (time.monotonic() - poll_start)
+            )
+            readiness.poll(
+                lambda: readiness.ssh_ready_probe(
+                    hosts.flat_ips, ssh_user=ssh_user, ssh_key=str(ssh_key)
+                ),
+                interval=5.0,
+                timeout=remaining,
+            )
+
     with timer.phase("host-configuration"):
-        ansible_mod.write_runtime_configs(config, hosts, paths, ssh_key=ssh_key)
+        ansible_mod.write_runtime_configs(
+            config, hosts, paths, ssh_key=ssh_key, ansible_user=ssh_user
+        )
         ansible_mod.run_playbook(paths)
 
-    if not args.skip_readiness:
+    if config.mode == "gke" and not args.skip_readiness:
         with timer.phase("readiness-wait"):
             wait_ready(config, args.readiness_timeout)
 

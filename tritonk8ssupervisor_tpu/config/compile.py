@@ -69,31 +69,72 @@ def write_tfvars(config: ClusterConfig, terraform_dir: Path) -> Path:
 # ------------------------------------------------------------------ ansible
 
 
-def to_inventory(config: ClusterConfig, slice_ips: list[list[str]]) -> str:
+def _check_slice_shape(name: str, slice_ips) -> None:
+    """slice_ips must be per-slice lists (terraform output shape); a flat
+    list of strings would silently iterate characters and emit garbage
+    host lines."""
+    if not isinstance(slice_ips, (list, tuple)) or not all(
+        isinstance(s, (list, tuple)) and all(isinstance(ip, str) for ip in s)
+        for s in slice_ips
+    ):
+        raise TypeError(
+            f"{name} must be a list of per-slice IP lists "
+            f"(e.g. [['10.0.0.1', '10.0.0.2']]), got {slice_ips!r}"
+        )
+
+
+def to_inventory(
+    config: ClusterConfig,
+    slice_ips: list[list[str]],
+    internal_ips: list[list[str]] | None = None,
+    ansible_user: str = "",
+) -> str:
     """INI inventory, the analogue of the [MASTER]/[HOST] groups the
     reference built from masters.ip/hosts.ip (setup.sh:123-126).
 
-    `slice_ips` is per-slice (terraform output shape): each host line
-    carries its slice index, its position in the slice, and its slice's
-    coordinator (the slice's first host) as inventory hostvars — each TPU
+    `slice_ips` (external IPs, SSH addressing) is per-slice (terraform
+    output shape): each host line carries its slice index, its position in
+    the slice, and its slice's coordinator as inventory hostvars — each TPU
     slice is an independent JAX cluster, so the coordinator handoff
     (reference rancherhost registrationUrl, rancherhost/tasks/main.yml:19-24)
-    must be per-slice, not global.
+    must be per-slice, not global. The coordinator is the slice's first
+    host's VPC-internal IP when `internal_ips` is provided: worker dials to
+    an external NAT IP are blocked by default firewall rules, and JAX
+    coordinator traffic belongs on the VPC anyway.
+
+    `ansible_user` is the SSH login for TPU VMs (the discovered gcloud
+    username — GCP maps metadata/OS-Login keys to user accounts and
+    disables direct root SSH; the play escalates with become). Empty means
+    omit, letting ansible default to the control machine's user, which is
+    what `gcloud compute ssh` would use.
 
     The [LOCAL] group hosts the gkejoin play, which drives gcloud/kubectl
     from the control machine (the ranchermaster local_action analogue,
     ranchermaster/tasks/main.yml:51-52)."""
+    _check_slice_shape("slice_ips", slice_ips)
+    if internal_ips:
+        _check_slice_shape("internal_ips", internal_ips)
+        if [len(s) for s in internal_ips] != [len(s) for s in slice_ips]:
+            raise ValueError(
+                "internal_ips shape does not match slice_ips: "
+                f"{internal_ips!r} vs {slice_ips!r}"
+            )
     lines = ["[TPUHOST]"]
     for slice_index, ips in enumerate(slice_ips):
+        if not ips:  # slice endpoints not populated (yet) — emit nothing
+            continue
+        coordinator = (
+            internal_ips[slice_index][0] if internal_ips else ips[0]
+        )
         for process_id, ip in enumerate(ips):
             lines.append(
                 f"{ip} slice_index={slice_index} process_id={process_id} "
-                f"slice_coordinator={ips[0]}"
+                f"slice_coordinator={coordinator}"
             )
+    lines += ["", "[TPUHOST:vars]"]
+    if ansible_user:
+        lines.append(f"ansible_user={ansible_user}")
     lines += [
-        "",
-        "[TPUHOST:vars]",
-        "ansible_user=root",
         "ansible_python_interpreter=/usr/bin/python3",
         "",
         "[LOCAL]",
@@ -146,12 +187,18 @@ def write_ansible_configs(
     slice_ips: list[list[str]],
     ansible_dir: Path,
     coordinator_ip: str = "",
+    internal_ips: list[list[str]] | None = None,
+    ansible_user: str = "",
 ) -> None:
     """Generated vars go to group_vars/all.yml so every play sees them (the
     reference funnelled one vars.yml into each play via vars_files,
     clusterUp.yml:12,22)."""
     ansible_dir.mkdir(parents=True, exist_ok=True)
-    (ansible_dir / "hosts").write_text(to_inventory(config, slice_ips))
+    (ansible_dir / "hosts").write_text(
+        to_inventory(
+            config, slice_ips, internal_ips=internal_ips, ansible_user=ansible_user
+        )
+    )
     vars_dir = ansible_dir / "group_vars"
     vars_dir.mkdir(parents=True, exist_ok=True)
     (vars_dir / "all.yml").write_text(
@@ -218,6 +265,13 @@ def to_benchmark_job(
     hosts = config.hosts_per_slice
     chips_on_host = spec.chips_on_host(topo)
     svc = f"{name}-svc"
+    # Indexed-Job pod hostnames are {job_name}-{index}; with num_slices > 1
+    # jobs are named {name}-{slice}, so the coordinator address must derive
+    # from the per-slice job name — each slice forms its own JAX cluster
+    # (the reference joined each node through its own registration URL,
+    # rancherhost/tasks/main.yml:19-24; a shared global coordinator would
+    # be both a dangling DNS name and wrong topology).
+    job_name = f"{name}-{slice_index}" if config.num_slices > 1 else name
     # Default path: plain python image + self-install from the package
     # ConfigMap (bench_command). A custom image is assumed to carry the
     # framework already (Dockerfile at the repo root builds one).
@@ -239,7 +293,7 @@ def to_benchmark_job(
         "env": [
             # jax.distributed.initialize() on GKE reads these (the analogue
             # of the registrationUrl handoff, rancherhost/tasks/main.yml:19-24)
-            {"name": "JAX_COORDINATOR_ADDRESS", "value": f"{name}-0.{svc}:8476"},
+            {"name": "JAX_COORDINATOR_ADDRESS", "value": f"{job_name}-0.{svc}:8476"},
             {"name": "JAX_NUM_PROCESSES", "value": str(hosts)},
             {
                 "name": "JAX_PROCESS_ID",
@@ -269,7 +323,7 @@ def to_benchmark_job(
         "apiVersion": "batch/v1",
         "kind": "Job",
         "metadata": {
-            "name": f"{name}-{slice_index}" if config.num_slices > 1 else name,
+            "name": job_name,
             "labels": {"app": name, "slice": str(slice_index)},
         },
         "spec": {

@@ -42,12 +42,15 @@ def write_runtime_configs(
     hosts: ClusterHosts,
     paths: RunPaths,
     ssh_key: Path | str = "",
+    ansible_user: str = "",
 ) -> None:
     compiler.write_ansible_configs(
         config,
         hosts.host_ips,
         paths.ansible_dir,
         coordinator_ip=hosts.coordinator_ip,
+        internal_ips=hosts.internal_ips,
+        ansible_user=ansible_user,
     )
     if ssh_key and paths.ansible_cfg.exists():
         patch_private_key(paths.ansible_cfg, ssh_key)

@@ -99,6 +99,42 @@ def _node_is_ready(node: dict) -> bool:
 # --------------------------------------------------------------- tpu-vm mode
 
 
+def ssh_ready_probe(
+    ips: list[str],
+    ssh_user: str = "",
+    ssh_key: str = "",
+    run_quiet: run_mod.RunFn = run_mod.run_capture,
+    connect_timeout: int = 5,
+) -> str:
+    """Ready when `ssh <ip> true` succeeds on every host with the exact
+    credentials ansible will use.
+
+    The deterministic replacement for the reference's sleep-30-then-hope
+    bootstrap (reference terraform/master/main.tf:22): ansible must not
+    start until sshd accepts *authenticated* sessions, and "VM state
+    READY" does not imply that (GCP propagates metadata SSH keys after
+    boot). BatchMode fails instead of hanging on a password prompt;
+    known_hosts stays untouched so teardown's scrub list remains accurate.
+    """
+    for ip in ips:
+        args = [
+            "ssh",
+            "-o", "BatchMode=yes",
+            "-o", f"ConnectTimeout={connect_timeout}",
+            "-o", "StrictHostKeyChecking=no",
+            "-o", "UserKnownHostsFile=/dev/null",
+        ]
+        if ssh_key:
+            args += ["-i", str(ssh_key)]
+        if ssh_user:
+            args += ["-l", ssh_user]
+        try:
+            run_quiet(args + [ip, "true"])
+        except run_mod.CommandError as e:
+            return f"host {ip} ssh not ready (rc {e.returncode})"
+    return ""
+
+
 def tpu_vm_probe(
     config: ClusterConfig,
     slice_names: list[str],

@@ -79,10 +79,14 @@ class ClusterHosts:
     """Provisioned endpoints — what terraform's local-exec used to append to
     masters.ip/hosts.ip (terraform/master/main.tf:29-31)."""
 
-    # per-slice list of worker host IPs (tpu-vm mode); flat for gke nodes
+    # per-slice list of worker host external IPs (SSH/inventory addressing)
     host_ips: list  # list[list[str]]
     coordinator_ip: str = ""  # first host of slice 0 (the "master" analogue)
     gke_endpoint: str = ""  # gke mode: cluster control-plane endpoint
+    # per-slice list of worker host VPC-internal IPs: the JAX coordinator
+    # address source — worker->coordinator traffic must ride the VPC, not
+    # external NAT (default firewall rules block inbound NAT dial-in)
+    internal_ips: list = dataclasses.field(default_factory=list)
 
     @property
     def flat_ips(self) -> list[str]:

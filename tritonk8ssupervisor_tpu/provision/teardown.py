@@ -19,13 +19,20 @@ from tritonk8ssupervisor_tpu.provision.state import ClusterHosts, RunPaths
 
 
 def clean(
-    config: ClusterConfig,
+    config: ClusterConfig | None,
     paths: RunPaths,
     prompter: Prompter,
     run: run_mod.RunFn = run_mod.run_streaming,
     assume_yes: bool = False,
 ) -> bool:
-    """Returns True when teardown ran, False when the user aborted."""
+    """Returns True when teardown ran, False when the user aborted.
+
+    `config=None` means the config file is gone but terraform state
+    remains (e.g. a partial manual cleanup): every mode with state is
+    destroyed — the reference keyed teardown off terraform state, never
+    the config (reference setup.sh:484-521), so orphaned resources must
+    stay reachable by `./setup.sh -c`.
+    """
     doomed = _describe_doomed(config, paths)
     prompter.say("The following resources will be DESTROYED:")
     for line in doomed:
@@ -34,19 +41,44 @@ def clean(
         prompter.say("Aborted; nothing was changed.")
         return False
 
-    terraform_mod.destroy(config, paths, run)
+    # Destroy EVERY mode holding terraform state, not just config.mode: a
+    # mode switch via --config leaves the previous mode's tfstate behind,
+    # and the state scrub below would otherwise orphan those resources.
+    doomed_modes = set(terraform_mod.modes_with_state(paths))
+    if config is not None:
+        doomed_modes.add(config.mode)
+    for mode in sorted(doomed_modes):
+        terraform_mod.destroy_mode(mode, paths, run)
+    if not doomed_modes and paths.hosts_file.exists():
+        # No tfstate anywhere but host IPs are on record: nothing was
+        # actually destroyed — say so loudly before the scrub deletes the
+        # last record of possibly-live resources.
+        hosts = ClusterHosts.load(paths.hosts_file)
+        prompter.say(
+            "WARNING: no terraform state found — nothing was destroyed. "
+            "Hosts recorded at: " + ", ".join(hosts.flat_ips) + ". "
+            "If they still exist, delete them manually, e.g. "
+            "`gcloud compute tpus tpu-vm delete <name> --zone <zone>`."
+        )
     _scrub_known_hosts(paths, run)
     _remove_generated_state(config, paths)
     prompter.say("Clean. Re-run ./setup.sh to provision again.")
     return True
 
 
-def _describe_doomed(config: ClusterConfig, paths: RunPaths) -> list[str]:
+def _describe_doomed(config: ClusterConfig | None, paths: RunPaths) -> list[str]:
     """The doomed-VM listing (setup.sh:487-491), from recorded state."""
-    lines = [
-        f"{config.mode} deployment in project {config.project} "
-        f"(zone {config.zone})"
-    ]
+    if config is not None:
+        lines = [
+            f"{config.mode} deployment in project {config.project} "
+            f"(zone {config.zone})"
+        ]
+    else:
+        modes = terraform_mod.modes_with_state(paths) or ["(unknown mode)"]
+        lines = [
+            f"orphaned terraform state: {', '.join(modes)} "
+            "(config file missing; destroying from state)"
+        ]
     if paths.hosts_file.exists():
         hosts = ClusterHosts.load(paths.hosts_file)
         for ip in hosts.flat_ips:
@@ -71,7 +103,7 @@ def _scrub_known_hosts(paths: RunPaths, run: run_mod.RunFn) -> None:
             pass  # absent entries are fine, same as the reference's `|| true`
 
 
-def _remove_generated_state(config: ClusterConfig, paths: RunPaths) -> None:
+def _remove_generated_state(config: ClusterConfig | None, paths: RunPaths) -> None:
     """Delete everything a run generated (setup.sh:509-513)."""
     for mode in ("tpu-vm", "gke"):
         for name in (

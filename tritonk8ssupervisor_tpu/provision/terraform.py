@@ -15,6 +15,7 @@ terraform/hosts.json — the masters.ip/hosts.ip analogue
 from __future__ import annotations
 
 import json
+import sys
 
 from tritonk8ssupervisor_tpu.config import compile as compiler
 from tritonk8ssupervisor_tpu.config.schema import ClusterConfig
@@ -66,7 +67,9 @@ def collect_outputs(
     """Read `terraform output -json` into ClusterHosts.
 
     Expected outputs (declared in terraform/{tpu-vm,gke}/outputs.tf):
-    - tpu-vm: `host_ips` = list (per slice) of lists of worker IPs
+    - tpu-vm: `host_ips` = per-slice lists of external IPs (SSH addressing),
+      `internal_ips` = per-slice lists of VPC IPs (coordinator addresses —
+      worker->coordinator rendezvous rides the VPC, never external NAT)
     - gke:    `endpoint` = control-plane endpoint, `node_pool` = name
     """
     module_dir = paths.terraform_module(config.mode)
@@ -74,8 +77,26 @@ def collect_outputs(
     outputs = {k: v.get("value") for k, v in json.loads(raw or "{}").items()}
     if config.mode == "tpu-vm":
         host_ips = outputs.get("host_ips") or []
-        coordinator = host_ips[0][0] if host_ips and host_ips[0] else ""
-        return ClusterHosts(host_ips=host_ips, coordinator_ip=coordinator)
+        internal_ips = outputs.get("internal_ips") or []
+        coord_source = internal_ips or host_ips
+        if host_ips and not internal_ips:
+            # A stale tfstate (pre-internal_ips) leaves only the external
+            # NAT form, which default firewall rules block for
+            # worker->coordinator dials — make that diagnosable up front.
+            print(
+                "WARNING: terraform output has no internal_ips; falling "
+                "back to external IPs for the JAX coordinator. Multi-host "
+                "rendezvous over external NAT usually fails — re-apply to "
+                "refresh outputs.",
+                file=sys.stderr,
+                flush=True,
+            )
+        coordinator = coord_source[0][0] if coord_source and coord_source[0] else ""
+        return ClusterHosts(
+            host_ips=host_ips,
+            internal_ips=internal_ips,
+            coordinator_ip=coordinator,
+        )
     return ClusterHosts(
         host_ips=[],
         gke_endpoint=outputs.get("endpoint") or "",
@@ -88,10 +109,38 @@ def destroy(
     run: run_mod.RunFn = run_mod.run_streaming,
 ) -> None:
     """`terraform destroy -force` analogue (setup.sh:498-503)."""
-    module_dir = paths.terraform_module(config.mode)
-    if not paths.tfstate(config.mode).exists():
+    destroy_mode(config.mode, paths, run)
+
+
+def destroy_mode(
+    mode: str,
+    paths: RunPaths,
+    run: run_mod.RunFn = run_mod.run_streaming,
+) -> None:
+    """Destroy one module's resources from its tfstate. Keyed off the mode
+    string (not a ClusterConfig) so teardown can work from orphaned
+    terraform state alone — the reference's cleanRunner only needed the
+    state files, never the config (reference setup.sh:484-521)."""
+    if not paths.tfstate(mode).exists():
         return
     run(
         ["terraform", "destroy", "-auto-approve", "-input=false", "-no-color"],
-        cwd=module_dir,
+        cwd=paths.terraform_module(mode),
     )
+
+
+def modes_with_state(paths: RunPaths) -> list[str]:
+    """Modes whose module dir holds a tfstate with resources."""
+    found = []
+    for mode in ("tpu-vm", "gke"):
+        state_file = paths.tfstate(mode)
+        if not state_file.exists():
+            continue
+        try:
+            state = json.loads(state_file.read_text())
+        except (OSError, json.JSONDecodeError):
+            found.append(mode)  # unreadable state still warrants a destroy run
+            continue
+        if state.get("resources"):
+            found.append(mode)
+    return found
