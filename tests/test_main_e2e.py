@@ -204,6 +204,8 @@ def test_clean_destroys_every_mode_with_state(fake_world, capsys):
     assert paths.tfstate("tpu-vm").exists() and paths.tfstate("gke").exists()
     capsys.readouterr()
     assert main(["-c", "--yes", "--workdir", str(work)]) == 0
+    # the confirmation listing names BOTH modes the user is about to lose
+    assert "gke, tpu-vm deployment(s)" in capsys.readouterr().out
     destroys = [
         l for l in calls_log.read_text().splitlines() if l.startswith("terraform destroy")
     ]

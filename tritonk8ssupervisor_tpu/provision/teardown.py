@@ -67,14 +67,18 @@ def clean(
 
 
 def _describe_doomed(config: ClusterConfig | None, paths: RunPaths) -> list[str]:
-    """The doomed-VM listing (setup.sh:487-491), from recorded state."""
+    """The doomed-VM listing (setup.sh:487-491), from recorded state. Must
+    name EVERY mode clean() will destroy — a mode switch leaves the old
+    mode's tfstate behind, and the user confirms what they see here."""
+    stateful_modes = terraform_mod.modes_with_state(paths)
     if config is not None:
+        modes = sorted(set(stateful_modes) | {config.mode})
         lines = [
-            f"{config.mode} deployment in project {config.project} "
+            f"{', '.join(modes)} deployment(s) in project {config.project} "
             f"(zone {config.zone})"
         ]
     else:
-        modes = terraform_mod.modes_with_state(paths) or ["(unknown mode)"]
+        modes = stateful_modes or ["(unknown mode)"]
         lines = [
             f"orphaned terraform state: {', '.join(modes)} "
             "(config file missing; destroying from state)"

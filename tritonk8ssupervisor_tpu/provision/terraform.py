@@ -18,7 +18,7 @@ import json
 import sys
 
 from tritonk8ssupervisor_tpu.config import compile as compiler
-from tritonk8ssupervisor_tpu.config.schema import ClusterConfig
+from tritonk8ssupervisor_tpu.config.schema import ClusterConfig, ConfigError
 from tritonk8ssupervisor_tpu.provision import runner as run_mod
 from tritonk8ssupervisor_tpu.provision.state import ClusterHosts, RunPaths
 
@@ -36,6 +36,38 @@ def already_applied(config: ClusterConfig, paths: RunPaths) -> bool:
     return bool(state.get("resources"))
 
 
+def precheck(config: ClusterConfig, paths: RunPaths) -> None:
+    """Static HCL validation before any cloud call: parsed-AST variable and
+    reference checks plus tfvars coverage (infra/hcl.py) — what `terraform
+    validate`+`plan` would catch, without needing the binary. Skipped
+    silently when lark is unavailable (pip-installed minimal envs)."""
+    try:
+        from tritonk8ssupervisor_tpu.infra import hcl
+    except ImportError:  # pragma: no cover - lark not installed
+        return
+    module_dir = paths.terraform_module(config.mode)
+    if not list(module_dir.glob("*.tf")):
+        return  # test sandboxes run against stub module dirs
+    try:
+        module = hcl.parse_module_dir(module_dir)
+    except Exception as e:  # noqa: BLE001 - grammar gaps must not block apply
+        # The in-repo grammar covers the constructs these modules use, not
+        # all of HCL (heredocs, splats, ...). Valid-but-unparseable HCL is
+        # terraform's to judge — warn and let apply proceed.
+        print(
+            f"WARNING: HCL precheck skipped ({module_dir}): {e}",
+            file=sys.stderr,
+            flush=True,
+        )
+        return
+    problems = hcl.validate_module(module)
+    problems += hcl.check_tfvars(module, compiler.to_tfvars(config))
+    if problems:
+        raise ConfigError(
+            "terraform module precheck failed:\n  " + "\n  ".join(problems)
+        )
+
+
 def apply(
     config: ClusterConfig,
     paths: RunPaths,
@@ -48,6 +80,7 @@ def apply(
     collection replaces the reference's local-exec IP appending.
     """
     module_dir = paths.terraform_module(config.mode)
+    precheck(config, paths)
     compiler.write_tfvars(config, paths.terraform_dir)
     run(["terraform", "init", "-input=false", "-no-color"], cwd=module_dir)
     run(
