@@ -40,11 +40,41 @@ def test_gradient_matches_reference():
     np.testing.assert_allclose(g_kernel.sum(-1), 0.0, atol=1e-6)
 
 
-def test_uneven_batch_falls_back():
-    """Batches that don't tile fall back to the XLA path, same numbers."""
+def test_uneven_batch_is_padded():
+    """Batches that don't tile are padded with dummy rows (sliced off
+    after) — the kernel path still runs, same numbers."""
     k1, k2 = jax.random.split(jax.random.key(3))
     logits = jax.random.normal(k1, (7, 13), jnp.float32)
     labels = jax.random.randint(k2, (7,), 0, 13)
     got = cross_entropy_loss(logits, labels, True)
     want = cross_entropy_loss_reference(logits, labels)
     np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_block_rows_scale_with_class_count():
+    """Round-1 weak item #2: a fixed 256-row block at vocab 32768 is a
+    ~32 MiB f32 block — far over a v5e core's VMEM. Rows must shrink as
+    classes grow, and every block must fit the budget."""
+    from tritonk8ssupervisor_tpu.ops.cross_entropy import (
+        _MIN_BLOCK_B,
+        _VMEM_BLOCK_BYTES,
+        _block_rows,
+    )
+
+    assert _block_rows(1024, 4096) == 256      # small vocab keeps full rows
+    assert _block_rows(32768, 4096) == 32      # LM vocab shrinks the block
+    assert _block_rows(32768, 4096) * 32768 * 4 <= _VMEM_BLOCK_BYTES
+    assert _block_rows(262144, 4096) == _MIN_BLOCK_B  # floor at sublane height
+    assert _block_rows(1024, 3) == 3           # tiny batches never over-block
+
+
+def test_kernel_at_lm_vocab_scale():
+    """The exact configuration the LM benchmark runs: vocab 32768 — the
+    kernel (not a fallback) must produce reference numbers."""
+    k1, k2 = jax.random.split(jax.random.key(4))
+    vocab = 32768
+    logits = jax.random.normal(k1, (64, vocab), jnp.float32) * 3
+    labels = jax.random.randint(k2, (64,), 0, vocab)
+    got = cross_entropy_loss(logits, labels, True)
+    want = cross_entropy_loss_reference(logits, labels)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)

@@ -23,8 +23,14 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from tritonk8ssupervisor_tpu.ops.cross_entropy import (
     cross_entropy_loss,
     cross_entropy_loss_reference,
+    is_pallas_loss,
 )
 from tritonk8ssupervisor_tpu.parallel import mesh as mesh_lib
+
+try:  # jax >= 0.6 exports shard_map at the top level
+    from jax import shard_map
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
 
 
 @flax.struct.dataclass
@@ -42,6 +48,24 @@ def _default_loss_fn() -> Callable:
         cross_entropy_loss
         if jax.default_backend() == "tpu"
         else cross_entropy_loss_reference
+    )
+
+
+def _shard_loss_over_data(loss_fn: Callable, mesh) -> Callable:
+    """Partition a per-example loss over the "data" mesh axis with
+    shard_map. pallas_call has no SPMD partitioning rule, so calling the
+    fused kernel on batch-sharded logits inside jit would either fail to
+    partition or silently all-gather the full (global_batch, classes)
+    logits; shard_map pins the kernel to each device's batch shard —
+    collectives-free, since the loss is pointwise per example."""
+    if mesh.shape[mesh_lib.DATA_AXIS] == 1 or not is_pallas_loss(loss_fn):
+        return loss_fn
+    data = mesh_lib.DATA_AXIS
+    return shard_map(
+        loss_fn,
+        mesh=mesh,
+        in_specs=(P(data, None), P(data)),
+        out_specs=P(data),
     )
 
 
@@ -98,6 +122,7 @@ def make_train_step(
     """
     if loss_fn is None:
         loss_fn = _default_loss_fn()
+    loss_fn = _shard_loss_over_data(loss_fn, mesh)
 
     def compute_loss(params, batch_stats, images, labels):
         logits, updates = model.apply(
@@ -149,22 +174,56 @@ def make_lm_train_step(
 
     tokens (batch, seq) arrive batch-sharded over "data" and — when
     `seq_axis` names the ring-attention mesh axis — sequence-sharded over
-    it; the next-token shift's one-position halo exchange is XLA's to
-    insert, like every other collective here.
+    it. The loss path never materialises an unsharded (batch*seq, vocab)
+    array: the next-token shift is a jnp.roll on the tiny token grid
+    (XLA inserts the one-position halo exchange), and the per-token loss
+    runs on (b, s, v) with its sharding intact — shard_map'd onto each
+    device's block for the pallas kernel, plain XLA otherwise. At LM vocab
+    sizes the logits are the biggest array in the program; gathering them
+    for the loss would dwarf every other collective.
     """
     if loss_fn is None:
         loss_fn = _default_loss_fn()
+    data = mesh_lib.DATA_AXIS
+    shard_the_loss = is_pallas_loss(loss_fn) and (
+        mesh.shape[data] > 1 or (seq_axis and mesh.shape[seq_axis] > 1)
+    )
+
+    def local_token_losses(logits, targets):
+        b, s, v = logits.shape
+        flat = logits.reshape(b * s, v)
+        t = targets.reshape(-1)
+        losses = loss_fn(flat, t)
+        correct = flat.argmax(axis=-1) == t
+        return losses.reshape(b, s), correct.reshape(b, s)
+
+    if shard_the_loss:
+        spec3 = P(data, seq_axis, None)
+        spec2 = P(data, seq_axis)
+        token_losses = shard_map(
+            local_token_losses,
+            mesh=mesh,
+            in_specs=(spec3, spec2),
+            out_specs=(spec2, spec2),
+        )
+    else:
+        token_losses = local_token_losses
 
     def compute_loss(params, tokens):
         logits = model.apply({"params": params}, tokens, train=True)
-        targets = tokens[:, 1:].reshape(-1)
-        flat = logits[:, :-1].reshape(-1, logits.shape[-1])
-        loss = jnp.mean(loss_fn(flat, targets))
-        return loss, flat.argmax(axis=-1) == targets
+        # next-token targets; the wrapped position s-1 is masked out below
+        targets = jnp.roll(tokens, -1, axis=1)
+        losses, correct = token_losses(logits, targets)
+        s = tokens.shape[1]
+        mask = jnp.arange(s) < s - 1
+        denom = tokens.shape[0] * (s - 1)
+        loss = jnp.where(mask[None, :], losses, 0.0).sum() / denom
+        accuracy = jnp.where(mask[None, :], correct, False).sum() / denom
+        return loss, accuracy
 
     def step(state: TrainState, tokens):
         grad_fn = jax.value_and_grad(compute_loss, has_aux=True)
-        (loss, correct), grads = grad_fn(state.params, tokens)
+        (loss, accuracy), grads = grad_fn(state.params, tokens)
         updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         new_state = TrainState(
@@ -173,7 +232,7 @@ def make_lm_train_step(
             batch_stats=state.batch_stats,
             opt_state=new_opt_state,
         )
-        return new_state, {"loss": loss, "accuracy": jnp.mean(correct)}
+        return new_state, {"loss": loss, "accuracy": accuracy}
 
     token_sh = NamedSharding(mesh, P(mesh_lib.DATA_AXIS, seq_axis))
     metric_sh = NamedSharding(mesh, P())
