@@ -229,24 +229,36 @@ def test_tpuhost_when_gates_execute():
         (REPO / "ansible" / "roles" / "tpuhost" / "tasks" / "main.yml").read_text()
     )
     jax_install = next(t for t in tasks if t["name"] == "Install JAX with libtpu")
-    for installed, should_run in [("Version: 0.4.38", False), ("Version: 0.4.30", True), ("", True)]:
+    for installed, should_run in [
+        ("Version: 0.4.38", False),
+        ("Version: 0.4.30", True),
+        ("", True),
+        # full-line anchoring (advisor round-2 low): a prefix-matching
+        # install like 0.4.38.1 must NOT satisfy the 0.4.38 pin
+        ("Version: 0.4.38.1", True),
+    ]:
         got = ac.evaluate_expression(
             jax_install["when"],
-            {"jax_installed": {"stdout": installed}, "jax_version": "0.4.38"},
+            {
+                "jax_installed": {"stdout_lines": installed.splitlines()},
+                "jax_version": "0.4.38",
+            },
         )
         assert got == should_run, installed
     pkg_install = next(t for t in tasks if t["name"] == "Install the framework package")
     scenarios = [
-        (True, "Version: 0.1.0", True),    # archive changed -> reinstall
-        (False, "Version: 0.1.0", False),  # unchanged + version match -> skip
-        (False, "Version: 0.0.9", True),   # version drift -> reinstall
+        (True, "Version: 0.1.0", True),      # archive changed -> reinstall
+        (False, "Version: 0.1.0", False),    # unchanged + version match -> skip
+        (False, "Version: 0.0.9", True),     # version drift -> reinstall
+        (False, "Version: 0.1.0rc1", True),  # stale prerelease: prefix must not match
+        (False, "Version: 0.1.01", True),    # stale 0.1.01: prefix must not match
     ]
     for changed, installed, should_run in scenarios:
         got = ac.evaluate_expression(
             pkg_install["when"],
             {
                 "pkg_copy": {"changed": changed},
-                "pkg_installed": {"stdout": installed},
+                "pkg_installed": {"stdout_lines": installed.splitlines()},
                 "pkg_version": "0.1.0",
             },
         )

@@ -185,3 +185,96 @@ def test_batch_sharding_layout():
     mesh = make_mesh()
     sh = batch_sharding(mesh)
     assert sh.spec == P(DATA_AXIS, None, None, None)
+
+
+# ------------------------------------------------- pallas loss under shard_map
+
+
+def test_train_step_with_pallas_interpret_loss_matches_reference():
+    """The exact kernel+shard_map path the TPU uses (data axis > 1) must
+    trace, run, and match the XLA reference loss. Guards the shard_map
+    check_vma regression: with the default varying-manifest check the jit
+    raises at trace time on any multi-device mesh (advisor round-2 high)."""
+    from tritonk8ssupervisor_tpu.ops.cross_entropy import (
+        cross_entropy_loss_interpret,
+        cross_entropy_loss_reference,
+    )
+
+    mesh = make_mesh()  # data=8
+    model = ResNet18(num_classes=10, num_filters=8)
+    tx = train_lib.default_optimizer(learning_rate=0.05)
+    sample = jax.ShapeDtypeStruct((16, 32, 32, 3), jnp.float32)
+    state, shardings = train_lib.create_train_state(
+        model, jax.random.key(0), sample, mesh, tx
+    )
+    step_kernel = train_lib.make_train_step(
+        model, tx, mesh, shardings, loss_fn=cross_entropy_loss_interpret
+    )
+    step_ref = train_lib.make_train_step(
+        model, tx, mesh, shardings, loss_fn=cross_entropy_loss_reference
+    )
+    k1, k2 = jax.random.split(jax.random.key(1))
+    images = jax.random.normal(k1, (16, 32, 32, 3), jnp.float32)
+    labels = jax.random.randint(k2, (16,), 0, 10)
+    # donated state: give each step its own copy
+    state_copy = jax.tree_util.tree_map(jnp.copy, state)
+    new_k, mk = step_kernel(state, images, labels)
+    new_r, mr = step_ref(state_copy, images, labels)
+    np.testing.assert_allclose(float(mk["loss"]), float(mr["loss"]), rtol=1e-5)
+    # same gradients -> same first-step parameter update
+    for lk, lr in zip(
+        jax.tree_util.tree_leaves(new_k.params),
+        jax.tree_util.tree_leaves(new_r.params),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(lk), np.asarray(lr), rtol=1e-4, atol=1e-5
+        )
+
+
+def test_lm_train_step_with_pallas_interpret_loss_matches_reference():
+    """Seq-sharded LM case (data=2 x model=4): the shard_map'd kernel loss
+    over (data, seq) blocks matches the reference (advisor round-2 medium)."""
+    from tritonk8ssupervisor_tpu.models import TransformerLM
+    from tritonk8ssupervisor_tpu.ops.cross_entropy import (
+        cross_entropy_loss_interpret,
+        cross_entropy_loss_reference,
+    )
+    from tritonk8ssupervisor_tpu.ops.ring_attention import ring_attention
+
+    mesh = make_mesh(model_parallelism=4)
+
+    def ring_fn(q, k, v, causal=True):
+        return ring_attention(q, k, v, mesh=mesh, axis_name=MODEL_AXIS, causal=causal)
+
+    model = TransformerLM(
+        vocab_size=128, num_layers=2, num_heads=4, embed_dim=64,
+        max_seq_len=64, attention_fn=ring_fn,
+    )
+    tx = train_lib.default_optimizer(learning_rate=0.03)
+    sample = jax.ShapeDtypeStruct((4, 32), jnp.int32)
+    state, shardings = train_lib.create_train_state(
+        model, jax.random.key(0), sample, mesh, tx
+    )
+    step_kernel = train_lib.make_lm_train_step(
+        model, tx, mesh, shardings, seq_axis=MODEL_AXIS,
+        loss_fn=cross_entropy_loss_interpret,
+    )
+    step_ref = train_lib.make_lm_train_step(
+        model, tx, mesh, shardings, seq_axis=MODEL_AXIS,
+        loss_fn=cross_entropy_loss_reference,
+    )
+    tokens = jax.random.randint(jax.random.key(1), (4, 32), 0, 128)
+    state_copy = jax.tree_util.tree_map(jnp.copy, state)
+    new_k, mk = step_kernel(state, tokens)
+    new_r, mr = step_ref(state_copy, tokens)
+    np.testing.assert_allclose(float(mk["loss"]), float(mr["loss"]), rtol=1e-5)
+    np.testing.assert_allclose(
+        float(mk["accuracy"]), float(mr["accuracy"]), rtol=1e-6
+    )
+    for lk, lr in zip(
+        jax.tree_util.tree_leaves(new_k.params),
+        jax.tree_util.tree_leaves(new_r.params),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(lk), np.asarray(lr), rtol=1e-4, atol=1e-5
+        )

@@ -117,8 +117,21 @@ def ssh_username() -> str:
     derived username differs."""
     import getpass
     import os
+    import sys
 
-    return os.environ.get("TK8S_SSH_USER") or getpass.getuser()
+    override = os.environ.get("TK8S_SSH_USER")
+    user = override or getpass.getuser()
+    if user == "root" and not override:
+        # getuser() says root when the CLI itself runs as root (containers,
+        # CI) — exactly the login GCP blocks. Don't fail (the play may be
+        # targeting a custom image), but make the fix obvious.
+        print(
+            "warning: derived SSH username is 'root', which GCP TPU VMs "
+            "reject by default; set TK8S_SSH_USER to the OS-Login/metadata "
+            "username the VMs expect",
+            file=sys.stderr,
+        )
+    return user
 
 
 def list_tpu_zones(generation: str, run: Runner = _default_runner) -> list[str]:

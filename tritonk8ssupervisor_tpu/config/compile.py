@@ -304,7 +304,24 @@ def to_benchmark_job(
                 },
             },
             {"name": "TPU_TOPOLOGY", "value": str(topo)},
-            {"name": "TPU_WORKER_HOSTNAMES", "value": svc},
+            # libtpu's multi-host topology discovery wants the full
+            # comma-separated worker list, one entry per pod, resolvable
+            # in-cluster: Indexed-Job pods are {job}-{index} under the
+            # headless Service's subdomain. A bare service name here (the
+            # round-2 bug) is not a list and breaks worker enumeration on
+            # multi-host slices.
+            {
+                "name": "TPU_WORKER_HOSTNAMES",
+                "value": ",".join(f"{job_name}-{i}.{svc}" for i in range(hosts)),
+            },
+            {
+                "name": "TPU_WORKER_ID",
+                "valueFrom": {
+                    "fieldRef": {
+                        "fieldPath": "metadata.annotations['batch.kubernetes.io/job-completion-index']"
+                    }
+                },
+            },
         ],
         "ports": [{"containerPort": 8476}],
     }

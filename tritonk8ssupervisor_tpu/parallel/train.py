@@ -28,9 +28,27 @@ from tritonk8ssupervisor_tpu.ops.cross_entropy import (
 from tritonk8ssupervisor_tpu.parallel import mesh as mesh_lib
 
 try:  # jax >= 0.6 exports shard_map at the top level
-    from jax import shard_map
+    from jax import shard_map as _shard_map
 except ImportError:  # pragma: no cover - older jax
-    from jax.experimental.shard_map import shard_map
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+import inspect
+
+# pallas_call has no replication/VMA rule, so shard_map's default
+# varying-manifest check rejects any body containing the fused loss kernel
+# the moment an axis size exceeds 1 — i.e. on every real multi-device run.
+# The bodies below are per-example pointwise (no cross-device collectives),
+# so disabling the check is sound, not a workaround. kwarg name differs by
+# jax version: check_vma (>=0.6-era) vs check_rep (0.4.x pinned on hosts).
+_UNCHECKED_KWARG = (
+    {"check_vma": False}
+    if "check_vma" in inspect.signature(_shard_map).parameters
+    else {"check_rep": False}
+)
+
+
+def shard_map(*args, **kwargs):
+    return _shard_map(*args, **{**_UNCHECKED_KWARG, **kwargs})
 
 
 @flax.struct.dataclass
