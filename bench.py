@@ -28,12 +28,18 @@ def main() -> int:
     from tritonk8ssupervisor_tpu.benchmarks.resnet50 import run_benchmark
 
     if on_tpu:
+        # 100-step windows: the host-fetch fence that closes a window costs
+        # one host<->device round trip (~77 ms through the axon tunnel);
+        # over 20-step windows that inflated step time by ~3.9 ms/step in
+        # r01/r02. 3 windows give a min/median spread so deltas are
+        # attributable (VERDICT r02 weak #7).
         result = run_benchmark(
             model_name="resnet50",
             batch_per_chip=256,
             image_size=224,
-            steps=20,
+            steps=100,
             warmup=5,
+            windows=3,
         )
     else:
         # CPU smoke: tiny shapes, same code path end to end
@@ -57,6 +63,10 @@ def main() -> int:
         "num_chips": result["num_chips"],
         "global_batch": result["global_batch"],
         "step_ms": round(result["step_ms"], 2),
+        "step_ms_min": round(result["step_ms_min"], 2),
+        "step_ms_windows": result["step_ms_windows"],
+        "mfu": round(result["mfu"], 4) if result["mfu"] is not None else None,
+        "flops_per_image": result["flops_per_image"],
     }
     print(json.dumps(record, sort_keys=True))
     return 0

@@ -20,9 +20,46 @@ from functools import partial
 from typing import Any, Callable, Sequence
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 ModuleDef = Any
+
+
+class Conv1x1(nn.Module):
+    """A 1x1 convolution phrased as a channel contraction (dot_general).
+
+    Mathematically identical to nn.Conv(features, (1, 1), strides) — same
+    parameter name/shape/init, so checkpoints and sharding rules are
+    unaffected — but it compiles to XLA's matmul emitter instead of the
+    convolution emitters. Measured on v5e (jax.profiler trace of the
+    train step): the conv emitters run the *backward* of stage-1 1x1
+    convs through sublane-transpose paths at ~4% MXU / ~5x below HBM
+    roofline (~25 ms of a 104 ms ResNet-50 step); the same contraction as
+    a dot lands on the MXU matmul path. Stride-2 1x1 convs subsample
+    before the contraction (exactly what the strided conv computes).
+    """
+
+    features: int
+    strides: tuple[int, int] = (1, 1)
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        sh, sw = self.strides
+        if (sh, sw) != (1, 1):
+            x = x[:, ::sh, ::sw, :]
+        kernel = self.param(
+            "kernel",
+            nn.initializers.lecun_normal(),
+            (1, 1, x.shape[-1], self.features),
+            self.param_dtype,
+        )
+        w = kernel[0, 0].astype(self.dtype)
+        return jax.lax.dot_general(
+            x.astype(self.dtype), w, (((3,), (0,)), ((), ()))
+        )
 
 
 class BottleneckBlock(nn.Module):
@@ -35,14 +72,16 @@ class BottleneckBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x):
+        # explicit conv names keep the parameter tree identical whether a
+        # conv instantiates nn.Conv or Conv1x1 (flax auto-names per class)
         residual = x
-        y = self.conv(self.filters, (1, 1))(x)
+        y = self.conv(self.filters, (1, 1), name="Conv_0")(x)
         y = self.norm()(y)
         y = nn.relu(y)
-        y = self.conv(self.filters, (3, 3), self.strides)(y)
+        y = self.conv(self.filters, (3, 3), self.strides, name="Conv_1")(y)
         y = self.norm()(y)
         y = nn.relu(y)
-        y = self.conv(self.filters * 4, (1, 1))(y)
+        y = self.conv(self.filters * 4, (1, 1), name="Conv_2")(y)
         # zero-init the last norm's scale: residual branches start as
         # identity, the standard trick for stable large-batch training
         y = self.norm(scale_init=nn.initializers.zeros_init())(y)
@@ -65,15 +104,66 @@ class BasicBlock(nn.Module):
     @nn.compact
     def __call__(self, x):
         residual = x
-        y = self.conv(self.filters, (3, 3), self.strides)(x)
+        y = self.conv(self.filters, (3, 3), self.strides, name="Conv_0")(x)
         y = self.norm()(y)
         y = nn.relu(y)
-        y = self.conv(self.filters, (3, 3))(y)
+        y = self.conv(self.filters, (3, 3), name="Conv_1")(y)
         y = self.norm(scale_init=nn.initializers.zeros_init())(y)
         if residual.shape != y.shape:
             residual = self.conv(self.filters, (1, 1), self.strides, name="shortcut")(x)
             residual = self.norm(name="shortcut_norm")(residual)
         return nn.relu(residual + y)
+
+
+class StemConvS2D(nn.Module):
+    """The ResNet stem (7x7 stride-2 conv, pad 3) computed space-to-depth.
+
+    Mathematically identical to nn.Conv(features, (7, 7), (2, 2),
+    padding=[(3, 3), (3, 3)]) with the same "kernel" parameter
+    (7, 7, in, features): the input is rearranged so each 2x2 spatial
+    patch becomes 4x the channels — (N, H, W, C) -> (N, H/2, W/2, 4C) —
+    and the 7x7 stride-2 kernel becomes a zero-padded 4x4 stride-1 kernel
+    over the patch grid. A 3-channel 7x7 stride-2 conv is the worst case
+    for the MXU's 128-wide input-feature lanes; the s2d form raises the
+    input features 4x and removes the stride. Standard public technique
+    for TPU ResNet input layers.
+    """
+
+    features: int
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        n, h, w, c = x.shape
+        kernel = self.param(
+            "kernel",
+            nn.initializers.lecun_normal(),
+            (7, 7, c, self.features),
+            self.param_dtype,
+        )
+        # taps: out(i) reads x[2i + u - 3], u in [0,7). With u' = u + 1,
+        # u' = 2a + r maps each tap to patch offset a-2 and parity r —
+        # so pad one zero row/col in front and regroup (8,8,c) as
+        # (4,4,4c) with the s2d channel order (r_u, r_v, c).
+        w8 = jnp.pad(kernel, ((1, 0), (1, 0), (0, 0), (0, 0)))
+        w4 = (
+            w8.reshape(4, 2, 4, 2, c, self.features)
+            .transpose(0, 2, 1, 3, 4, 5)
+            .reshape(4, 4, 4 * c, self.features)
+        )
+        x2 = (
+            x.reshape(n, h // 2, 2, w // 2, 2, c)
+            .transpose(0, 1, 3, 2, 4, 5)
+            .reshape(n, h // 2, w // 2, 4 * c)
+        )
+        return jax.lax.conv_general_dilated(
+            x2.astype(self.dtype),
+            w4.astype(self.dtype),
+            window_strides=(1, 1),
+            padding=((2, 1), (2, 1)),  # patch offsets a-2 in [-2, 1]
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
 
 
 class ResNet(nn.Module):
@@ -84,12 +174,33 @@ class ResNet(nn.Module):
     num_classes: int = 1000
     num_filters: int = 64
     dtype: Any = jnp.bfloat16
+    # 1x1 convs as channel matmuls (Conv1x1). Same math, same parameter
+    # tree; measured slightly SLOWER on v5e (107.3 vs 104.4 ms/step,
+    # bs 256) because XLA re-fuses the dots into the same layout-
+    # constrained fusions — kept as an A/B lever, off by default.
+    matmul_1x1: bool = False
+    # Space-to-depth stem (StemConvS2D): same math, same parameter tree.
+    s2d_stem: bool = True
 
     @nn.compact
     def __call__(self, x, train: bool = True):
-        conv = partial(
-            nn.Conv, use_bias=False, dtype=self.dtype, param_dtype=jnp.float32
-        )
+        def conv(features, kernel_size, strides=(1, 1), **kwargs):
+            if self.matmul_1x1 and tuple(kernel_size) == (1, 1):
+                return Conv1x1(
+                    features=features,
+                    strides=tuple(strides),
+                    dtype=self.dtype,
+                    name=kwargs.get("name"),
+                )
+            return nn.Conv(
+                features,
+                kernel_size,
+                strides,
+                use_bias=False,
+                dtype=self.dtype,
+                param_dtype=jnp.float32,
+                **kwargs,
+            )
         norm = partial(
             nn.BatchNorm,
             use_running_average=not train,
@@ -99,8 +210,12 @@ class ResNet(nn.Module):
             param_dtype=jnp.float32,
         )
         x = x.astype(self.dtype)
-        x = conv(self.num_filters, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
-                 name="stem_conv")(x)
+        if self.s2d_stem and x.shape[1] % 2 == 0 and x.shape[2] % 2 == 0:
+            x = StemConvS2D(self.num_filters, dtype=self.dtype,
+                            name="stem_conv")(x)
+        else:
+            x = conv(self.num_filters, (7, 7), (2, 2),
+                     padding=[(3, 3), (3, 3)], name="stem_conv")(x)
         x = norm(name="stem_norm")(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))

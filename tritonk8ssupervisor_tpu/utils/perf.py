@@ -1,0 +1,80 @@
+"""FLOPs accounting, MFU, and profiler capture for the benchmark workloads.
+
+The reference published raw throughput numbers with hardware context but no
+utilisation analysis (reference docs/benchmarks.md:1-50); SURVEY.md §5
+prescribes JAX profiler/xprof hooks in the benchmark Job. This module is
+that hook: FLOPs come from XLA's own cost model on the compiled executable
+(2 FLOPs per multiply-add, the standard convention), peak comes from the
+chip's published bf16 matmul rate, and MFU = executed FLOPs / (time x peak)
+— so "fast" is a measured fraction of the roofline, not an adjective.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator
+
+import jax
+
+# Published dense bf16 peak per chip (FLOP/s, 2 per MAC). Sources: Google
+# Cloud TPU system-architecture docs / the public scaling-book tables.
+# Keys are jax Device.device_kind strings.
+PEAK_BF16_FLOPS = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,  # v5e
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,  # v5p
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,  # v6e / Trillium
+    "TPU v6e": 918e12,
+}
+
+
+def peak_flops_per_chip(device=None) -> float | None:
+    """Dense bf16 peak for this chip, or None when unknown (CPU mesh)."""
+    device = device or jax.devices()[0]
+    kind = getattr(device, "device_kind", "")
+    if kind in PEAK_BF16_FLOPS:
+        return PEAK_BF16_FLOPS[kind]
+    for name, peak in PEAK_BF16_FLOPS.items():  # tolerate suffixed kinds
+        if kind.startswith(name):
+            return peak
+    return None
+
+
+def compiled_flops(compiled) -> float | None:
+    """Whole-program FLOPs per invocation from XLA's cost analysis of a
+    compiled executable (jax.stages.Compiled). None when the backend
+    doesn't expose a cost model."""
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):  # older jax returned [dict]
+            cost = cost[0] if cost else {}
+        flops = float(cost.get("flops", 0.0))
+    except Exception:
+        return None
+    return flops if flops > 0 else None
+
+
+def mfu(flops_per_step: float | None, step_seconds: float, num_chips: int) -> float | None:
+    """Model FLOPs utilisation: executed FLOPs per step over the slice's
+    aggregate peak. None when either side is unknown."""
+    peak = peak_flops_per_chip()
+    if not flops_per_step or not peak or step_seconds <= 0:
+        return None
+    return flops_per_step / (step_seconds * peak * num_chips)
+
+
+@contextlib.contextmanager
+def maybe_trace(profile_dir: str | None) -> Iterator[None]:
+    """Capture a jax.profiler trace (xplane.pb + trace.json.gz, viewable in
+    XProf/TensorBoard or Perfetto) around the wrapped steps when a
+    directory is given; no-op otherwise."""
+    if not profile_dir:
+        yield
+        return
+    jax.profiler.start_trace(profile_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
