@@ -154,7 +154,11 @@ def test_causal_no_longer_pays_the_noncausal_cost():
     """CPU-mesh wall-clock: causal must be measurably cheaper than the
     non-causal ring on a matmul-dominated shape (round-2 VERDICT #2 asked
     for exactly this comparison; before the zigzag schedule the causal
-    path cost the same as non-causal)."""
+    path cost the same as non-causal). The schedule is balanced, so the
+    saving shows whether the virtual devices run serialized (few cores:
+    total work halves) or in parallel (per-device work halves); the
+    FLOP assertion above is the load-proof check, and the samples are
+    interleaved best-of-3 to shrug off CI noise."""
     import time
 
     mesh = make_mesh(model_parallelism=8)

@@ -232,11 +232,12 @@ def _ring_shard_zigzag(q, k, v, *, axis_name: str, axes):
     statsA = _init_stats(b, c, h, d, axes)
     statsB = _init_stats(b, c, h, d, axes)
 
-    # hop 0: the resident pair (s == i) — two diagonals plus B x U in full
+    # hop 0: the resident pair (s == i) — two diagonals plus B x U, which
+    # is fully visible (posB >= n*c > any U position), so no mask pass
     posU, posV = posA, posB
     statsA = _fold(statsA, qA, kU, vU, scale, posA, posU)
     statsB = _fold(statsB, qB, kV, vV, scale, posB, posV)
-    statsB = _fold(statsB, qB, kU, vU, scale, posB, posU)
+    statsB = _fold(statsB, qB, kU, vU, scale)
 
     def select(pred, a, b):
         return jax.tree_util.tree_map(
@@ -251,8 +252,8 @@ def _ring_shard_zigzag(q, k, v, *, axis_name: str, axes):
         )
         src = _mark_varying((idx + hop) % n, axes)
         posU, posV = chunk_pos(src), chunk_pos(2 * n - 1 - src)
-        # always-allowed product
-        statsB = _fold(statsB, qB, kU, vU, scale, posB, posU)
+        # always-allowed, fully-visible product: fold maskless
+        statsB = _fold(statsB, qB, kU, vU, scale)
         # the selected second product: A x U when src < idx, else B x V
         pred = _mark_varying(src < idx, axes)
         folded = _fold(
@@ -277,13 +278,16 @@ def _ring_shard_zigzag(q, k, v, *, axis_name: str, axes):
 # -------------------------------------------------------------------- public
 
 
-def _resolve_batch_axis(mesh: Mesh, axis_name: str, batch_axis, batch: int):
+def _resolve_batch_axis(
+    mesh: Mesh, axis_name: str, batch_axis, batch: int | None
+):
     """Default the batch axis to the mesh's data axis when it exists, is
-    distinct from the ring axis, and divides the batch."""
+    distinct from the ring axis, and divides the batch (a None batch
+    skips the divisibility check — used when the batch isn't known)."""
     if batch_axis != "auto":
         return batch_axis
     if DATA_AXIS in mesh.axis_names and DATA_AXIS != axis_name:
-        if batch % mesh.shape[DATA_AXIS] == 0:
+        if batch is None or batch % mesh.shape[DATA_AXIS] == 0:
             return DATA_AXIS
     return None
 
@@ -341,14 +345,15 @@ def dense_fold_units(n: int) -> int:
 
 
 def sequence_sharding(
-    mesh: Mesh, axis_name: str, batch_axis: str | None = "auto"
+    mesh: Mesh,
+    axis_name: str,
+    batch_axis: str | None = "auto",
+    batch: int | None = None,
 ) -> NamedSharding:
     """Sharding for (batch, seq, ...) activations with seq over the ring
-    axis and batch over the data axis (matching ring_attention's specs)."""
-    if batch_axis == "auto":
-        batch_axis = (
-            DATA_AXIS
-            if DATA_AXIS in mesh.axis_names and DATA_AXIS != axis_name
-            else None
-        )
+    axis and batch over the data axis — one resolver with ring_attention,
+    so the spec matches its shard_map specs. Pass `batch` to get the same
+    replicated-batch fallback ring_attention applies when the data axis
+    doesn't divide it."""
+    batch_axis = _resolve_batch_axis(mesh, axis_name, batch_axis, batch)
     return NamedSharding(mesh, P(batch_axis, axis_name, None, None))
