@@ -105,3 +105,45 @@ def test_list_tpu_zones_falls_back_to_catalog():
     assert discovery.list_tpu_zones("v6e", run) == list(
         catalog.ACCELERATORS["v6e"].zones
     )
+
+
+def test_list_networks_live_and_fallbacks():
+    run = fake_runner(
+        {("compute", "networks", "list"): (0, "default\nprod-vpc\n")}
+    )
+    assert discovery.list_networks("p", run) == ["default", "prod-vpc"]
+    # project flows into the command
+    seen = []
+
+    def spy(args, **kwargs):
+        seen.append(args)
+        return run(args, **kwargs)
+
+    discovery.list_networks("my-proj", spy)
+    assert "--project=my-proj" in seen[0]
+    # failure and empty output fall back to the GCP default network
+    assert discovery.list_networks("p", fake_runner({})) == ["default"]
+    assert (
+        discovery.list_networks("p", fake_runner({("compute", "networks", "list"): (0, "")}))
+        == ["default"]
+    )
+
+    def boom(args, **kwargs):
+        raise OSError("no gcloud")
+
+    assert discovery.list_networks("p", boom) == ["default"]
+
+
+def test_list_subnetworks_scoped_to_network_and_region():
+    seen = []
+
+    def run(args, **kwargs):
+        seen.append(args)
+        return subprocess.CompletedProcess(args, 0, stdout="subnet-a\n", stderr="")
+
+    assert discovery.list_subnetworks("p", "us-west4", "vpc-a", run) == ["subnet-a"]
+    assert "--network=vpc-a" in seen[0]
+    assert "--regions=us-west4" in seen[0]
+    # fallback names the network itself (auto-mode VPC convention)
+    assert discovery.list_subnetworks("p", "r", "vpc-a", fake_runner({})) == ["vpc-a"]
+    assert discovery.list_subnetworks("p", "r", "", fake_runner({})) == ["default"]

@@ -13,13 +13,29 @@ def catalog_zones(gen):
     return list(catalog.ACCELERATORS[gen].zones)
 
 
-def run_scripted(lines, env=None, zone_lister=catalog_zones):
+def fake_networks(project):
+    return ["default", "prod-net"]
+
+
+def fake_subnets(project, region, network):
+    return [network, f"{network}-{region}"]
+
+
+def run_scripted(
+    lines,
+    env=None,
+    zone_lister=catalog_zones,
+    network_lister=fake_networks,
+    subnet_lister=fake_subnets,
+):
     out = io.StringIO()
     prompter = Prompter(io.StringIO("\n".join(lines) + "\n"), out)
     config = wizard.run_wizard(
         prompter,
         env=env or discovery.GcloudEnv(project="test-proj"),
         zone_lister=zone_lister,
+        network_lister=network_lister,
+        subnet_lister=subnet_lister,
     )
     return config, out.getvalue()
 
@@ -60,7 +76,8 @@ def test_custom_selection():
         "2",      # topology -> second v4 topology (2x2x2)
         "3",      # slices
         "1",      # zone menu -> us-central2-b (v4's only zone)
-        "prod-net", "prod-subnet",
+        "2",      # network menu -> prod-net
+        "2",      # subnet menu -> prod-net-us-central2
     ]
     config, _ = run_scripted(lines)
     assert config.project == "other-proj"
@@ -70,6 +87,32 @@ def test_custom_selection():
     assert config.num_slices == 3
     assert config.zone == "us-central2-b"
     assert config.network == "prod-net"
+    assert config.subnetwork == "prod-net-us-central2"
+
+
+def test_network_menu_other_escape_hatch():
+    """Names the live listing can't see (shared VPC) stay reachable."""
+    lines = list(ALL_DEFAULTS)
+    # network menu has [default, prod-net, other]; pick other, then name it
+    lines[10:11] = ["3", "xpn-host-net"]
+    config, _ = run_scripted(lines)
+    assert config.network == "xpn-host-net"
+    # subnets were listed for the custom network
+    assert config.subnetwork == "xpn-host-net"
+
+
+def test_network_menu_uses_live_listing():
+    seen = {}
+
+    def lister(project):
+        seen["project"] = project
+        return ["vpc-a", "vpc-b"]
+
+    lines = list(ALL_DEFAULTS)
+    lines[10:11] = ["2"]
+    config, _ = run_scripted(lines, network_lister=lister)
+    assert config.network == "vpc-b"
+    assert seen["project"] == "test-proj"
 
 
 def test_invalid_names_reprompt():

@@ -37,6 +37,7 @@ def run_benchmark(
     warmup: int = 3,
     sequence_parallelism: int = 1,
     learning_rate: float = 3e-2,
+    checkpoint_dir: str | None = None,
 ) -> dict:
     """Train a causal LM on synthetic tokens; returns a metrics dict.
 
@@ -81,6 +82,15 @@ def run_benchmark(
     step = train_lib.make_lm_train_step(
         model, tx, mesh, shardings, seq_axis=seq_axis
     )
+
+    # Checkpoint/resume (SURVEY.md §5), same contract as the flagship:
+    # resume from the latest step when the directory carries one (local or
+    # gs:// — orbax handles both), save after the measured run.
+    from tritonk8ssupervisor_tpu.parallel import checkpoint as ckpt_lib
+
+    ckpt, state, start_step, restore_seconds = ckpt_lib.maybe_restore(
+        checkpoint_dir, state, shardings
+    )
     tokens = jax.device_put(
         jax.random.randint(jax.random.key(1), sample.shape, 0, vocab_size),
         NamedSharding(mesh, P(DATA_AXIS, seq_axis)),
@@ -88,7 +98,7 @@ def run_benchmark(
 
     state, metrics = step(state, tokens)  # first step = compile
     float(metrics["loss"])
-    compile_seconds = time.monotonic() - init_start
+    compile_seconds = time.monotonic() - init_start - restore_seconds
     for _ in range(max(0, warmup - 1)):
         state, metrics = step(state, tokens)
     float(metrics["loss"])
@@ -99,8 +109,12 @@ def run_benchmark(
     final_loss = float(metrics["loss"])
     elapsed = time.monotonic() - start
 
+    ckpt_lib.save_and_close(ckpt, state)
+
     tokens_per_sec = global_batch * seq_len * steps / elapsed
     return {
+        "start_step": start_step,
+        "final_step": int(state.step),
         "model": "transformer_lm",
         "platform": jax.default_backend(),
         "num_chips": int(num_chips),
@@ -129,6 +143,12 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--steps", type=int, default=20)
     parser.add_argument("--warmup", type=int, default=3)
     parser.add_argument("--sequence-parallelism", type=int, default=1)
+    parser.add_argument(
+        "--checkpoint-dir",
+        default=None,
+        help="save TrainState here after the run; resume from it when "
+        "present (local path or gs:// bucket)",
+    )
     parser.add_argument("--json", action="store_true")
     return parser
 
@@ -146,6 +166,7 @@ def main(argv: list[str] | None = None) -> int:
         steps=args.steps,
         warmup=args.warmup,
         sequence_parallelism=args.sequence_parallelism,
+        checkpoint_dir=args.checkpoint_dir,
     )
     if args.json:
         print(json.dumps(result, sort_keys=True))

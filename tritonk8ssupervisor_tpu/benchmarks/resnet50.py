@@ -98,22 +98,11 @@ def run_benchmark(
 
     # Checkpoint/resume (SURVEY.md §5): resume from the latest step when a
     # checkpoint directory carries one; save after the measured run.
-    ckpt = None
-    start_step = 0
-    restore_seconds = 0.0
-    if checkpoint_dir:
-        from tritonk8ssupervisor_tpu.parallel.checkpoint import (
-            TrainCheckpointer,
-            abstract_like,
-        )
+    from tritonk8ssupervisor_tpu.parallel import checkpoint as ckpt_lib
 
-        restore_start = time.monotonic()
-        ckpt = TrainCheckpointer(checkpoint_dir)
-        if ckpt.latest_step() is not None:
-            state = ckpt.restore(abstract_like(state, shardings))
-            start_step = int(state.step)
-        # keep compile_seconds comparable across fresh and resumed runs
-        restore_seconds = time.monotonic() - restore_start
+    ckpt, state, start_step, restore_seconds = ckpt_lib.maybe_restore(
+        checkpoint_dir, state, shardings
+    )
 
     # Synthetic batch, born sharded on device (no host->device copies in
     # the timed loop; HBM is the bottleneck we measure, not PCIe).
@@ -166,9 +155,7 @@ def run_benchmark(
             state, metrics = compiled(state, images, labels)
             float(metrics["loss"])
 
-    if ckpt is not None:
-        ckpt.save(int(state.step), state, wait=True)
-        ckpt.close()
+    ckpt_lib.save_and_close(ckpt, state)
 
     step_ms_windows = [s / steps * 1000 for s in window_seconds]
     step_ms = statistics.median(step_ms_windows)

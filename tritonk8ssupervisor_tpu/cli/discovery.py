@@ -134,6 +134,54 @@ def ssh_username() -> str:
     return user
 
 
+def list_networks(project: str = "", run: Runner = _default_runner) -> list[str]:
+    """Live VPC network names for the wizard menu — the `triton networks`
+    menu analogue (reference setup.sh:257,309-400, default
+    Joyent-SDC-Public). Any gcloud failure falls back to ["default"],
+    the network every fresh GCP project carries."""
+    cmd = ["gcloud", "compute", "networks", "list", "--format=value(name)"]
+    if project:
+        cmd.append(f"--project={project}")
+    try:
+        proc = run(cmd)
+    except (OSError, subprocess.SubprocessError):
+        return ["default"]
+    if proc.returncode != 0:
+        return ["default"]
+    names = [line.strip() for line in proc.stdout.splitlines() if line.strip()]
+    return names or ["default"]
+
+
+def list_subnetworks(
+    project: str,
+    region: str,
+    network: str,
+    run: Runner = _default_runner,
+) -> list[str]:
+    """Subnet names of `network` in `region` (auto-mode VPCs have one per
+    region named like the network). Fallback mirrors list_networks."""
+    cmd = [
+        "gcloud",
+        "compute",
+        "networks",
+        "subnets",
+        "list",
+        f"--network={network}",
+        f"--regions={region}",
+        "--format=value(name)",
+    ]
+    if project:
+        cmd.append(f"--project={project}")
+    try:
+        proc = run(cmd)
+    except (OSError, subprocess.SubprocessError):
+        return [network or "default"]
+    if proc.returncode != 0:
+        return [network or "default"]
+    names = [line.strip() for line in proc.stdout.splitlines() if line.strip()]
+    return names or [network or "default"]
+
+
 def list_tpu_zones(generation: str, run: Runner = _default_runner) -> list[str]:
     """Zones offering `generation`, live when credentials allow, otherwise
     the static catalog — the same live-with-fallback pattern as the

@@ -89,6 +89,14 @@ def build_parser() -> argparse.ArgumentParser:
         "the BENCH_IMAGE environment variable.",
     )
     parser.add_argument(
+        "--checkpoint-dir",
+        default=os.environ.get("TK8S_CHECKPOINT_DIR") or None,
+        metavar="DIR",
+        help="checkpoint directory for the generated benchmark Job — use a "
+        "gs:// bucket so checkpoints survive pod restarts (each slice "
+        "writes DIR/slice-N). Also read from TK8S_CHECKPOINT_DIR.",
+    )
+    parser.add_argument(
         "--show-config",
         action="store_true",
         help="print the resolved configuration and exit (no provisioning)",
@@ -247,6 +255,8 @@ def provision(args, paths: state.RunPaths, prompter: Prompter) -> int:
 
     with timer.phase("compile-manifests"):
         job_kwargs = {"image": args.bench_image} if args.bench_image else {}
+        if args.checkpoint_dir:
+            job_kwargs["checkpoint_dir"] = args.checkpoint_dir
         manifest_paths = compiler.write_manifests(
             config, paths.manifests_dir, **job_kwargs
         )

@@ -44,10 +44,29 @@ def _int_range_validator(lo: int, hi: int, reason: str = ""):
     return check
 
 
+def _choose_named(
+    prompter: Prompter, title: str, options: list[str], default: str
+) -> str:
+    """Menu over live-discovered names with an escape hatch for names the
+    listing can't see (shared VPCs, cross-project networks) — the
+    reference's network menus offered only the listed choices
+    (setup.sh:309-400); GCP needs the extra door."""
+    other = "other (enter a name)"
+    default_index = options.index(default) if default in options else 0
+    choice = prompter.menu(title, options + [other], default_index)
+    if choice == len(options):
+        return prompter.ask_validated(
+            "Name", default, lambda v: "" if v else "a name is required"
+        )
+    return options[choice]
+
+
 def run_wizard(
     prompter: Prompter,
     env: discovery.GcloudEnv | None = None,
     zone_lister=discovery.list_tpu_zones,
+    network_lister=discovery.list_networks,
+    subnet_lister=discovery.list_subnetworks,
 ) -> ClusterConfig:
     """Collect a full ClusterConfig interactively.
 
@@ -136,9 +155,21 @@ def run_wizard(
     default_zone_idx = zones.index(env.zone) if env.zone in zones else 0
     config.zone = zones[prompter.menu("Zone:", zones, default_zone_idx)]
 
-    # Networking (the reference defaulted to Joyent-SDC-Public, setup.sh:309-400)
-    config.network = prompter.ask("VPC network", config.network)
-    config.subnetwork = prompter.ask("VPC subnetwork", config.subnetwork)
+    # Networking: live menus with defaults, like the reference's `triton
+    # networks` menu defaulting to Joyent-SDC-Public (setup.sh:309-400)
+    config.network = _choose_named(
+        prompter,
+        "VPC network:",
+        network_lister(config.project),
+        config.network,
+    )
+    region = config.zone.rsplit("-", 1)[0]
+    config.subnetwork = _choose_named(
+        prompter,
+        f"VPC subnetwork ({region}):",
+        subnet_lister(config.project, region, config.network),
+        config.subnetwork,
+    )
 
     config.validate()
     return config

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import base64
 import json
+import shlex
 from pathlib import Path
 
 import yaml
@@ -223,11 +224,15 @@ def bench_command(module: str = "tritonk8ssupervisor_tpu.benchmarks.resnet50",
     image: install the ConfigMap-mounted source archive + the pinned
     jax[tpu], then run the module. This is what makes the generated Job
     runnable as published — the reference's workloads ran straight from
-    public images (docs/benchmarks.md:1-4); ours ships its own source."""
+    public images (docs/benchmarks.md:1-4); ours ships its own source.
+
+    extra_args carry user input (e.g. --checkpoint-dir) into a bash -c
+    string, so each is shell-quoted."""
+    args = " ".join(shlex.quote(a) for a in extra_args)
     return (
         f"pip install --quiet {PACKAGE_MOUNT_PATH}/{packaging.ARCHIVE_NAME} "
         f"'{PROBE_JAX_PIN}' -f {PROBE_LIBTPU_INDEX} && "
-        f"python -m {module} {' '.join(extra_args)}".rstrip()
+        f"python -m {module} {args}".rstrip()
     )
 
 
@@ -251,6 +256,7 @@ def to_benchmark_job(
     image: str = BENCH_IMAGE_DEFAULT,
     command: list[str] | None = None,
     slice_index: int = 0,
+    checkpoint_dir: str = "",
 ) -> dict:
     """ResNet-50 benchmark as an Indexed Job spanning every host of a slice.
 
@@ -272,15 +278,34 @@ def to_benchmark_job(
     # rancherhost/tasks/main.yml:19-24; a shared global coordinator would
     # be both a dangling DNS name and wrong topology).
     job_name = f"{name}-{slice_index}" if config.num_slices > 1 else name
+    # Checkpoints need a home that outlives the pod; a gs:// bucket is the
+    # durable choice (orbax writes it natively — the node pool's service
+    # account needs storage read/write scope, see docs/benchmarks.md).
+    # Per-slice subdirectories: each slice is an independent JAX cluster
+    # training its own state, so slices must not clobber one another.
+    if checkpoint_dir and command is not None:
+        raise ValueError(
+            "checkpoint_dir only applies to the generated benchmark "
+            "command; bake the flag into the explicit `command` instead"
+        )
+    bench_args: tuple[str, ...] = ("--json",)
+    if checkpoint_dir:
+        slice_dir = checkpoint_dir.rstrip("/") + f"/slice-{slice_index}"
+        bench_args += ("--checkpoint-dir", slice_dir)
     # Default path: plain python image + self-install from the package
     # ConfigMap (bench_command). A custom image is assumed to carry the
     # framework already (Dockerfile at the repo root builds one).
     self_install = command is None and image == BENCH_IMAGE_DEFAULT
     if command is None:
         command = (
-            ["bash", "-c", bench_command()]
+            ["bash", "-c", bench_command(extra_args=bench_args)]
             if self_install
-            else ["python", "-m", "tritonk8ssupervisor_tpu.benchmarks.resnet50", "--json"]
+            else [
+                "python",
+                "-m",
+                "tritonk8ssupervisor_tpu.benchmarks.resnet50",
+                *bench_args,
+            ]
         )
     container = {
         "name": "bench",
