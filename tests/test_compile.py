@@ -226,3 +226,16 @@ def test_write_manifests_multi_slice(tmp_path):
     assert job0["metadata"]["name"] == "resnet50-bench-0"
     svc = yaml.safe_load((tmp_path / "bench-service.yaml").read_text())
     assert svc["spec"]["clusterIP"] == "None"
+
+
+def test_gcs_checkpoint_job_installs_gcs_backend():
+    """orbax needs an epath GCS backend the plain python pod lacks; a
+    gs:// checkpoint dir must pull gcsfs into the self-install line or
+    the pod crash-loops on the first mkdir."""
+    job = cc.to_benchmark_job(cfg(), checkpoint_dir="gs://bkt/ckpt")
+    script = job["spec"]["template"]["spec"]["containers"][0]["command"][-1]
+    assert "gcsfs" in script.split("&&")[0]
+    # local checkpoint dirs don't need it
+    job = cc.to_benchmark_job(cfg(), checkpoint_dir="/mnt/ckpt")
+    script = job["spec"]["template"]["spec"]["containers"][0]["command"][-1]
+    assert "gcsfs" not in script

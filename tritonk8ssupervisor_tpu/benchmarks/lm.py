@@ -85,12 +85,18 @@ def run_benchmark(
 
     # Checkpoint/resume (SURVEY.md §5), same contract as the flagship:
     # resume from the latest step when the directory carries one (local or
-    # gs:// — orbax handles both), save after the measured run.
-    from tritonk8ssupervisor_tpu.parallel import checkpoint as ckpt_lib
+    # gs:// — orbax handles both), save after the measured run. Lazy
+    # import inside the restore window: orbax's first import costs seconds
+    # and must hit restore_seconds (subtracted), not compile_seconds.
+    ckpt, start_step, restore_seconds = None, 0, 0.0
+    if checkpoint_dir:
+        restore_start = time.monotonic()
+        from tritonk8ssupervisor_tpu.parallel import checkpoint as ckpt_lib
 
-    ckpt, state, start_step, restore_seconds = ckpt_lib.maybe_restore(
-        checkpoint_dir, state, shardings
-    )
+        ckpt, state, start_step, _ = ckpt_lib.maybe_restore(
+            checkpoint_dir, state, shardings
+        )
+        restore_seconds = time.monotonic() - restore_start
     tokens = jax.device_put(
         jax.random.randint(jax.random.key(1), sample.shape, 0, vocab_size),
         NamedSharding(mesh, P(DATA_AXIS, seq_axis)),
@@ -109,7 +115,8 @@ def run_benchmark(
     final_loss = float(metrics["loss"])
     elapsed = time.monotonic() - start
 
-    ckpt_lib.save_and_close(ckpt, state)
+    if ckpt is not None:
+        ckpt_lib.save_and_close(ckpt, state)
 
     tokens_per_sec = global_batch * seq_len * steps / elapsed
     return {

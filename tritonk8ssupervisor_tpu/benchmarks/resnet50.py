@@ -97,12 +97,18 @@ def run_benchmark(
     )
 
     # Checkpoint/resume (SURVEY.md §5): resume from the latest step when a
-    # checkpoint directory carries one; save after the measured run.
-    from tritonk8ssupervisor_tpu.parallel import checkpoint as ckpt_lib
+    # checkpoint directory carries one; save after the measured run. Lazy
+    # import inside the restore window: orbax's first import costs seconds
+    # and must hit restore_seconds (subtracted), not compile_seconds.
+    ckpt, start_step, restore_seconds = None, 0, 0.0
+    if checkpoint_dir:
+        restore_start = time.monotonic()
+        from tritonk8ssupervisor_tpu.parallel import checkpoint as ckpt_lib
 
-    ckpt, state, start_step, restore_seconds = ckpt_lib.maybe_restore(
-        checkpoint_dir, state, shardings
-    )
+        ckpt, state, start_step, _ = ckpt_lib.maybe_restore(
+            checkpoint_dir, state, shardings
+        )
+        restore_seconds = time.monotonic() - restore_start
 
     # Synthetic batch, born sharded on device (no host->device copies in
     # the timed loop; HBM is the bottleneck we measure, not PCIe).
@@ -155,7 +161,8 @@ def run_benchmark(
             state, metrics = compiled(state, images, labels)
             float(metrics["loss"])
 
-    ckpt_lib.save_and_close(ckpt, state)
+    if ckpt is not None:
+        ckpt_lib.save_and_close(ckpt, state)
 
     step_ms_windows = [s / steps * 1000 for s in window_seconds]
     step_ms = statistics.median(step_ms_windows)

@@ -163,11 +163,10 @@ def run_wizard(
         network_lister(config.project),
         config.network,
     )
-    region = config.zone.rsplit("-", 1)[0]
     config.subnetwork = _choose_named(
         prompter,
-        f"VPC subnetwork ({region}):",
-        subnet_lister(config.project, region, config.network),
+        f"VPC subnetwork ({config.region}):",
+        subnet_lister(config.project, config.region, config.network),
         config.subnetwork,
     )
 

@@ -219,7 +219,8 @@ def write_ansible_configs(
 
 
 def bench_command(module: str = "tritonk8ssupervisor_tpu.benchmarks.resnet50",
-                  extra_args: tuple[str, ...] = ("--json",)) -> str:
+                  extra_args: tuple[str, ...] = ("--json",),
+                  extra_packages: tuple[str, ...] = ()) -> str:
     """Self-installing benchmark command for the default (plain python)
     image: install the ConfigMap-mounted source archive + the pinned
     jax[tpu], then run the module. This is what makes the generated Job
@@ -227,11 +228,13 @@ def bench_command(module: str = "tritonk8ssupervisor_tpu.benchmarks.resnet50",
     public images (docs/benchmarks.md:1-4); ours ships its own source.
 
     extra_args carry user input (e.g. --checkpoint-dir) into a bash -c
-    string, so each is shell-quoted."""
+    string, so each is shell-quoted; extra_packages join the pip install
+    (e.g. gcsfs for gs:// checkpoints)."""
     args = " ".join(shlex.quote(a) for a in extra_args)
+    packages = "".join(f" {shlex.quote(p)}" for p in extra_packages)
     return (
         f"pip install --quiet {PACKAGE_MOUNT_PATH}/{packaging.ARCHIVE_NAME} "
-        f"'{PROBE_JAX_PIN}' -f {PROBE_LIBTPU_INDEX} && "
+        f"'{PROBE_JAX_PIN}'{packages} -f {PROBE_LIBTPU_INDEX} && "
         f"python -m {module} {args}".rstrip()
     )
 
@@ -289,16 +292,23 @@ def to_benchmark_job(
             "command; bake the flag into the explicit `command` instead"
         )
     bench_args: tuple[str, ...] = ("--json",)
+    extra_packages: tuple[str, ...] = ()
     if checkpoint_dir:
         slice_dir = checkpoint_dir.rstrip("/") + f"/slice-{slice_index}"
         bench_args += ("--checkpoint-dir", slice_dir)
+        if checkpoint_dir.startswith("gs://"):
+            # orbax's epath needs a GCS backend; plain python pods have
+            # none and would crash-loop on the first mkdir (pyproject
+            # optional-dependency `gcs`)
+            extra_packages = ("gcsfs",)
     # Default path: plain python image + self-install from the package
     # ConfigMap (bench_command). A custom image is assumed to carry the
     # framework already (Dockerfile at the repo root builds one).
     self_install = command is None and image == BENCH_IMAGE_DEFAULT
     if command is None:
         command = (
-            ["bash", "-c", bench_command(extra_args=bench_args)]
+            ["bash", "-c", bench_command(extra_args=bench_args,
+                                         extra_packages=extra_packages)]
             if self_install
             else [
                 "python",
