@@ -46,7 +46,7 @@ def test_lm_benchmark_sequence_parallel_smoke():
 
     result = lm.run_benchmark(
         vocab_size=256, num_layers=1, num_heads=2, embed_dim=32,
-        seq_len=32, batch_per_data_shard=2, steps=2, warmup=1,
+        seq_len=32, batch_per_data_shard=2, steps=2, warmup=1, windows=1,
         sequence_parallelism=4,
     )
     assert result["num_chips"] == 8

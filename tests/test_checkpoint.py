@@ -109,6 +109,7 @@ def test_lm_benchmark_resume_round_trip(tmp_path):
         batch_per_data_shard=2,
         steps=2,
         warmup=1,
+        windows=1,
         sequence_parallelism=4,
         checkpoint_dir=str(tmp_path / "lm-ckpt"),
     )

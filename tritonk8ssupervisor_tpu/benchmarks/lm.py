@@ -19,6 +19,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from tritonk8ssupervisor_tpu.utils import perf
+
 from tritonk8ssupervisor_tpu.models import TransformerLM
 from tritonk8ssupervisor_tpu.ops.ring_attention import ring_attention
 from tritonk8ssupervisor_tpu.parallel import initialize_from_env, make_mesh
@@ -33,11 +35,13 @@ def run_benchmark(
     embed_dim: int = 768,
     seq_len: int = 1024,
     batch_per_data_shard: int = 8,
-    steps: int = 20,
+    steps: int = 50,
     warmup: int = 3,
+    windows: int = 3,
     sequence_parallelism: int = 1,
     learning_rate: float = 3e-2,
     checkpoint_dir: str | None = None,
+    profile_dir: str | None = None,
 ) -> dict:
     """Train a causal LM on synthetic tokens; returns a metrics dict.
 
@@ -102,23 +106,30 @@ def run_benchmark(
         NamedSharding(mesh, P(DATA_AXIS, seq_axis)),
     )
 
-    state, metrics = step(state, tokens)  # first step = compile
-    float(metrics["loss"])
-    compile_seconds = time.monotonic() - init_start - restore_seconds
-    for _ in range(max(0, warmup - 1)):
-        state, metrics = step(state, tokens)
-    float(metrics["loss"])
+    # THE measurement discipline, shared with the flagship
+    # (utils/perf.timed_windows): AOT compile serves both the run and the
+    # FLOPs/MFU figure; >=3 host-fetch-fenced windows make round deltas
+    # attributable.
+    compiled = step.lower(state, tokens).compile()
+    flops_per_step = perf.global_flops(compiled, num_chips)
 
-    start = time.monotonic()
-    for _ in range(steps):
-        state, metrics = step(state, tokens)
-    final_loss = float(metrics["loss"])
-    elapsed = time.monotonic() - start
+    state, timing = perf.timed_windows(
+        lambda s: compiled(s, tokens),
+        state,
+        steps=steps,
+        warmup=warmup,
+        windows=windows,
+        profile_dir=profile_dir,
+    )
+    compile_seconds = (
+        timing.pop("first_fence_seconds") - init_start - restore_seconds
+    )
 
     if ckpt is not None:
         ckpt_lib.save_and_close(ckpt, state)
 
-    tokens_per_sec = global_batch * seq_len * steps / elapsed
+    step_ms = timing["step_ms"]
+    tokens_per_sec = global_batch * seq_len / (step_ms / 1000)
     return {
         "start_step": start_step,
         "final_step": int(state.step),
@@ -130,12 +141,15 @@ def run_benchmark(
         "seq_len": seq_len,
         "num_layers": num_layers,
         "embed_dim": embed_dim,
-        "steps": steps,
-        "step_ms": elapsed / steps * 1000,
+        **timing,
         "tokens_per_sec": tokens_per_sec,
         "tokens_per_sec_per_chip": tokens_per_sec / num_chips,
+        "flops_per_step": flops_per_step,
+        "flops_per_token": (
+            flops_per_step / (global_batch * seq_len) if flops_per_step else None
+        ),
+        "mfu": perf.mfu(flops_per_step, step_ms / 1000, num_chips),
         "compile_seconds": compile_seconds,
-        "final_loss": final_loss,
     }
 
 
@@ -147,9 +161,17 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--embed-dim", type=int, default=768)
     parser.add_argument("--seq-len", type=int, default=1024)
     parser.add_argument("--batch-per-data-shard", type=int, default=8)
-    parser.add_argument("--steps", type=int, default=20)
+    parser.add_argument("--steps", type=int, default=50, help="steps per window "
+                    "(long enough to amortize the window fence round trip)")
     parser.add_argument("--warmup", type=int, default=3)
+    parser.add_argument("--windows", type=int, default=3, help="timed windows")
     parser.add_argument("--sequence-parallelism", type=int, default=1)
+    parser.add_argument(
+        "--profile",
+        default=None,
+        metavar="DIR",
+        help="capture a jax.profiler trace of steady-state steps into DIR",
+    )
     parser.add_argument(
         "--checkpoint-dir",
         default=None,
@@ -172,19 +194,26 @@ def main(argv: list[str] | None = None) -> int:
         batch_per_data_shard=args.batch_per_data_shard,
         steps=args.steps,
         warmup=args.warmup,
+        windows=args.windows,
         sequence_parallelism=args.sequence_parallelism,
         checkpoint_dir=args.checkpoint_dir,
+        profile_dir=args.profile,
     )
     if args.json:
         print(json.dumps(result, sort_keys=True))
     else:
+        mfu_txt = (
+            f", MFU {result['mfu'] * 100:.1f}%" if result["mfu"] is not None else ""
+        )
         print(
             f"{result['model']} on {result['num_chips']} {result['platform']} "
             f"chip(s), seq {result['seq_len']} "
             f"(sp={result['sequence_parallelism']}): "
             f"{result['tokens_per_sec']:.0f} tok/s total, "
             f"{result['tokens_per_sec_per_chip']:.0f} tok/s/chip, "
-            f"step {result['step_ms']:.1f} ms"
+            f"step {result['step_ms']:.1f} ms "
+            f"(min {result['step_ms_min']:.1f} over {result['windows']} windows)"
+            f"{mfu_txt}"
         )
     return 0
 

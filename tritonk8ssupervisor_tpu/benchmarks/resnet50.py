@@ -19,7 +19,6 @@ from __future__ import annotations
 
 import argparse
 import json
-import statistics
 import time
 
 import jax
@@ -126,46 +125,25 @@ def run_benchmark(
     # cost analysis (FLOPs for the MFU figure) — lowering a second time
     # just for the cost model would double the 20-40s compile.
     compiled = step.lower(state, images, labels).compile()
-    # XLA's cost analysis counts a while/scan body once (verified on this
-    # jax pin), so the figure is per-step even when steps_per_call > 1.
-    # It is also per-DEVICE (the SPMD program each chip runs — verified:
-    # an 8-way-sharded matmul reports the per-shard flops), so scale by
-    # device count for the global figure MFU and flops_per_image need.
-    flops_per_step = perf.compiled_flops(compiled)
-    if flops_per_step:
-        flops_per_step *= num_chips
+    flops_per_step = perf.global_flops(compiled, num_chips)
 
-    # The timing fence everywhere below is a host fetch of the loss: the
-    # last step's loss depends on every prior step's parameters (donated
-    # chaining), and a device->host read cannot complete early —
-    # block_until_ready alone is not a reliable fence on remote-tunneled
-    # backends.
-    calls_per_window = steps // steps_per_call
-    state, metrics = compiled(state, images, labels)  # first run
-    float(metrics["loss"])
-    compile_seconds = time.monotonic() - init_start - restore_seconds
-    for _ in range(max(0, warmup - 1)):  # allocator/queue steady state
-        state, metrics = compiled(state, images, labels)
-    float(metrics["loss"])
-
-    window_seconds = []
-    for _ in range(max(1, windows)):
-        start = time.monotonic()
-        for _ in range(calls_per_window):
-            state, metrics = compiled(state, images, labels)
-        final_loss = float(metrics["loss"])  # the fence
-        window_seconds.append(time.monotonic() - start)
-
-    if profile_dir:
-        with perf.maybe_trace(profile_dir):
-            state, metrics = compiled(state, images, labels)
-            float(metrics["loss"])
+    state, timing = perf.timed_windows(
+        lambda s: compiled(s, images, labels),
+        state,
+        steps=steps,
+        warmup=warmup,
+        windows=windows,
+        steps_per_call=steps_per_call,
+        profile_dir=profile_dir,
+    )
+    compile_seconds = (
+        timing.pop("first_fence_seconds") - init_start - restore_seconds
+    )
 
     if ckpt is not None:
         ckpt_lib.save_and_close(ckpt, state)
 
-    step_ms_windows = [s / steps * 1000 for s in window_seconds]
-    step_ms = statistics.median(step_ms_windows)
+    step_ms = timing["step_ms"]
     images_per_sec = global_batch / (step_ms / 1000)
     return {
         "start_step": start_step,
@@ -177,11 +155,7 @@ def run_benchmark(
         "model_parallelism": int(model_parallelism),
         "global_batch": int(global_batch),
         "image_size": image_size,
-        "steps": steps,
-        "windows": len(window_seconds),
-        "step_ms": step_ms,
-        "step_ms_min": min(step_ms_windows),
-        "step_ms_windows": [round(w, 3) for w in step_ms_windows],
+        **timing,
         "images_per_sec": images_per_sec,
         "images_per_sec_per_chip": images_per_sec / num_chips,
         "flops_per_step": flops_per_step,
@@ -190,7 +164,6 @@ def run_benchmark(
         ),
         "mfu": perf.mfu(flops_per_step, step_ms / 1000, num_chips),
         "compile_seconds": compile_seconds,
-        "final_loss": final_loss,
     }
 
 

@@ -12,7 +12,9 @@ chip's published bf16 matmul rate, and MFU = executed FLOPs / (time x peak)
 from __future__ import annotations
 
 import contextlib
-from typing import Iterator
+import statistics
+import time
+from typing import Any, Callable, Iterator
 
 import jax
 
@@ -63,6 +65,74 @@ def mfu(flops_per_step: float | None, step_seconds: float, num_chips: int) -> fl
     if not flops_per_step or not peak or step_seconds <= 0:
         return None
     return flops_per_step / (step_seconds * peak * num_chips)
+
+
+def global_flops(compiled, num_chips: int) -> float | None:
+    """Per-step whole-program FLOPs: XLA's cost analysis reports the
+    per-device SPMD program (and counts a while/scan body once), so scale
+    by device count."""
+    flops = compiled_flops(compiled)
+    return flops * num_chips if flops else None
+
+
+def timed_windows(
+    run_once: Callable[[Any], tuple[Any, dict]],
+    state: Any,
+    *,
+    steps: int,
+    warmup: int,
+    windows: int,
+    steps_per_call: int = 1,
+    profile_dir: str | None = None,
+) -> tuple[Any, dict]:
+    """THE measurement discipline, shared by every benchmark so their
+    numbers stay comparable: warm up, then time `windows` independent
+    windows of `steps` optimizer steps, each closed by a host fetch of
+    the loss — the only reliable fence on remote-tunneled backends, and
+    deliberately once per window, not per step, because the fetch costs a
+    full host<->device round trip (~77 ms through the dev tunnel; fetched
+    per 20 steps it inflated r01/r02 step times by ~3.9 ms).
+
+    run_once: state -> (state, metrics) — one dispatch (which covers
+    `steps_per_call` chained steps). Optionally captures a profiler trace
+    of one steady-state dispatch after the measured windows.
+
+    Returns (state, timing) where timing carries final_loss, step_ms
+    (median), step_ms_min, step_ms_windows, steps, windows, and
+    first_fence_seconds (monotonic time of the first fenced call, for the
+    caller's compile-time accounting).
+    """
+    state, metrics = run_once(state)  # first call: compile or first run
+    float(metrics["loss"])
+    first_fence_seconds = time.monotonic()
+    for _ in range(max(0, warmup - 1)):  # allocator/queue steady state
+        state, metrics = run_once(state)
+    float(metrics["loss"])
+
+    calls_per_window = steps // steps_per_call
+    window_seconds = []
+    for _ in range(max(1, windows)):
+        start = time.monotonic()
+        for _ in range(calls_per_window):
+            state, metrics = run_once(state)
+        final_loss = float(metrics["loss"])  # the fence
+        window_seconds.append(time.monotonic() - start)
+
+    if profile_dir:
+        with maybe_trace(profile_dir):
+            state, metrics = run_once(state)
+            float(metrics["loss"])
+
+    step_ms_windows = [s / steps * 1000 for s in window_seconds]
+    return state, {
+        "final_loss": final_loss,
+        "first_fence_seconds": first_fence_seconds,
+        "steps": steps,
+        "windows": len(window_seconds),
+        "step_ms": statistics.median(step_ms_windows),
+        "step_ms_min": min(step_ms_windows),
+        "step_ms_windows": [round(w, 3) for w in step_ms_windows],
+    }
 
 
 @contextlib.contextmanager
