@@ -39,6 +39,7 @@ def run_benchmark(
     warmup: int = 3,
     windows: int = 3,
     sequence_parallelism: int = 1,
+    attention: str = "dense",
     learning_rate: float = 3e-2,
     checkpoint_dir: str | None = None,
     profile_dir: str | None = None,
@@ -46,7 +47,10 @@ def run_benchmark(
     """Train a causal LM on synthetic tokens; returns a metrics dict.
 
     sequence_parallelism > 1 puts the sequence axis on the "model" mesh
-    axis and switches attention to the ring implementation.
+    axis and switches attention to the ring implementation; otherwise
+    `attention` picks dense XLA attention (default — fastest up to the
+    seq length whose score matrix fits HBM) or the fused pallas kernel
+    ("flash" — enables longer single-chip sequences).
     """
     if seq_len % max(sequence_parallelism, 1):
         raise ValueError(
@@ -58,11 +62,23 @@ def run_benchmark(
     num_chips = mesh.devices.size
     global_batch = batch_per_data_shard * mesh.shape[DATA_AXIS]
 
+    if attention not in ("dense", "flash"):
+        raise ValueError(
+            f"attention={attention!r}: expected 'dense' or 'flash' "
+            "(sequence_parallelism > 1 selects the ring)"
+        )
     if sequence_parallelism > 1:
         def attention_fn(q, k, v, causal=True):
             return ring_attention(
                 q, k, v, mesh=mesh, axis_name=MODEL_AXIS, causal=causal
             )
+    elif attention == "flash":
+        # fused kernel: O(S) HBM instead of the O(S^2) score matrix — the
+        # single-chip long-sequence lever (ops/flash_attention.py has the
+        # measured dense-vs-flash tradeoff)
+        from tritonk8ssupervisor_tpu.ops.flash_attention import flash_attention
+
+        attention_fn = flash_attention
     else:
         from tritonk8ssupervisor_tpu.models.transformer import dense_attention
 
@@ -137,6 +153,7 @@ def run_benchmark(
         "platform": jax.default_backend(),
         "num_chips": int(num_chips),
         "sequence_parallelism": int(sequence_parallelism),
+        "attention": "ring" if sequence_parallelism > 1 else attention,
         "global_batch": int(global_batch),
         "seq_len": seq_len,
         "num_layers": num_layers,
@@ -167,6 +184,14 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--windows", type=int, default=3, help="timed windows")
     parser.add_argument("--sequence-parallelism", type=int, default=1)
     parser.add_argument(
+        "--attention",
+        choices=("dense", "flash"),
+        default="dense",
+        help="single-device attention strategy (ignored when "
+        "--sequence-parallelism > 1 selects the ring): flash trades speed "
+        "for O(seq) memory — seq 8192 runs on one v5e where dense OOMs",
+    )
+    parser.add_argument(
         "--profile",
         default=None,
         metavar="DIR",
@@ -196,24 +221,20 @@ def main(argv: list[str] | None = None) -> int:
         warmup=args.warmup,
         windows=args.windows,
         sequence_parallelism=args.sequence_parallelism,
+        attention=args.attention,
         checkpoint_dir=args.checkpoint_dir,
         profile_dir=args.profile,
     )
     if args.json:
         print(json.dumps(result, sort_keys=True))
     else:
-        mfu_txt = (
-            f", MFU {result['mfu'] * 100:.1f}%" if result["mfu"] is not None else ""
-        )
         print(
             f"{result['model']} on {result['num_chips']} {result['platform']} "
             f"chip(s), seq {result['seq_len']} "
             f"(sp={result['sequence_parallelism']}): "
             f"{result['tokens_per_sec']:.0f} tok/s total, "
             f"{result['tokens_per_sec_per_chip']:.0f} tok/s/chip, "
-            f"step {result['step_ms']:.1f} ms "
-            f"(min {result['step_ms_min']:.1f} over {result['windows']} windows)"
-            f"{mfu_txt}"
+            + perf.timing_summary(result)
         )
     return 0
 

@@ -220,17 +220,12 @@ def main(argv: list[str] | None = None) -> int:
     if args.json:
         print(json.dumps(result, sort_keys=True))
     else:
-        mfu_txt = (
-            f", MFU {result['mfu'] * 100:.1f}%" if result["mfu"] is not None else ""
-        )
         print(
             f"{result['model']} on {result['num_chips']} {result['platform']} "
             f"chip(s): {result['images_per_sec']:.1f} img/s total, "
             f"{result['images_per_sec_per_chip']:.1f} img/s/chip, "
-            f"step {result['step_ms']:.1f} ms "
-            f"(min {result['step_ms_min']:.1f} over {result['windows']} windows)"
-            f"{mfu_txt} "
-            f"(global batch {result['global_batch']}, compile "
+            + perf.timing_summary(result)
+            + f" (global batch {result['global_batch']}, compile "
             f"{result['compile_seconds']:.1f}s)"
         )
     return 0

@@ -10,5 +10,16 @@ from tritonk8ssupervisor_tpu.ops.cross_entropy import (
     cross_entropy_loss,
     cross_entropy_loss_reference,
 )
+from tritonk8ssupervisor_tpu.ops.flash_attention import flash_attention
+from tritonk8ssupervisor_tpu.ops.ring_attention import (
+    attention_reference,
+    ring_attention,
+)
 
-__all__ = ["cross_entropy_loss", "cross_entropy_loss_reference"]
+__all__ = [
+    "attention_reference",
+    "cross_entropy_loss",
+    "cross_entropy_loss_reference",
+    "flash_attention",
+    "ring_attention",
+]

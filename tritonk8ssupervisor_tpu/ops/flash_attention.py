@@ -1,0 +1,50 @@
+"""Fused (flash) attention for single-device long sequences.
+
+The third attention strategy next to dense XLA attention and the ring
+(ops/ring_attention.py): a pallas TPU kernel that never materialises the
+(batch, heads, seq, seq) score matrix in HBM, so the max sequence length
+on ONE chip is set by the O(S) activations, not the O(S^2) scores.
+
+Measured on v5e (12L/768d LM, utils/perf.timed_windows):
+
+  seq 1024 b8:  dense 83.5 ms/step, flash 141.8 ms  -> dense wins
+  seq 4096 b2:  dense 184.7 ms,     flash 365.3 ms  -> dense wins
+  seq 8192 b1:  dense OOMs at compile; flash runs (636.6 ms)
+
+so this is a MEMORY lever, not a speed lever, on this chip generation —
+dense stays the default and flash is opt-in (`--attention flash` in
+benchmarks/lm.py) for sequences whose score matrix no longer fits. For
+long sequences across multiple chips, ring attention (which shards the
+O(S) activations too) remains the strategy of record.
+
+The kernel is jax's own pallas TPU flash attention (a library op, like
+lax.dot_general — not part of this repo's surface to reimplement); this
+module owns the layout adaptation, the scaling contract, and a reference
+fallback so CPU tests exercise the same call sites.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from tritonk8ssupervisor_tpu.ops.ring_attention import attention_reference
+
+
+def flash_attention(q, k, v, causal: bool = True):
+    """Fused attention over (batch, seq, heads, head_dim) inputs.
+
+    TPU: pallas flash kernel (scores stay in VMEM block by block).
+    Elsewhere: the dense reference — same signature, same numerics
+    contract, so models/tests swap strategies without code changes.
+    """
+    if jax.default_backend() != "tpu":
+        return attention_reference(q, k, v, causal=causal)
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        flash_attention as pl_flash,
+    )
+
+    d = q.shape[-1]
+    # model convention (b, s, h, d) -> kernel convention (b, h, s, d)
+    qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+    out = pl_flash(qt, kt, vt, causal=causal, sm_scale=1.0 / (d**0.5))
+    return out.transpose(0, 2, 1, 3)

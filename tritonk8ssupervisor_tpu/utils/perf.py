@@ -135,6 +135,18 @@ def timed_windows(
     }
 
 
+def timing_summary(result: dict) -> str:
+    """The shared human-readable tail of a benchmark report line:
+    'step X ms (min Y over N windows), MFU Z%'."""
+    text = (
+        f"step {result['step_ms']:.1f} ms "
+        f"(min {result['step_ms_min']:.1f} over {result['windows']} windows)"
+    )
+    if result.get("mfu") is not None:
+        text += f", MFU {result['mfu'] * 100:.1f}%"
+    return text
+
+
 @contextlib.contextmanager
 def maybe_trace(profile_dir: str | None) -> Iterator[None]:
     """Capture a jax.profiler trace (xplane.pb + trace.json.gz, viewable in
