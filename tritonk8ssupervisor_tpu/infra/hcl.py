@@ -45,9 +45,10 @@ block: NAME STRING* "{" body "}"
 ?unary_expr: postfix
            | "!" unary_expr -> not_expr
            | "-" unary_expr -> neg_expr
-?postfix: primary (index | getattr)*
+?postfix: primary (index | getattr | splat)*
 index: "[" expr "]"
 getattr: "." NAME
+splat: "[" "*" "]" | "." "*"
 ?primary: STRING          -> string
         | NUMBER          -> number
         | "true"          -> true
@@ -151,8 +152,43 @@ def _unquote(raw: str) -> str:
     return raw[1:-1] if raw.startswith('"') else raw
 
 
+# Heredocs are handled by preprocessing into ordinary quoted strings
+# (json escaping keeps ${...} interpolations visible to the reference
+# scan) rather than by grammar: a lexer terminal would need a
+# backreference on the delimiter, which lark's terminal regexes don't
+# reliably support. <<-EOT (indented) and <<EOT both match; the closing
+# delimiter must stand alone on its line (the lookahead), and an empty
+# body is legal.
+_HEREDOC_RE = re.compile(
+    r"<<-?([A-Za-z_][A-Za-z0-9_]*)\r?\n(.*?)^[ \t]*\1(?=\r?\n|$)",
+    re.DOTALL | re.MULTILINE,
+)
+
+
+def _strip_heredocs(text: str) -> str:
+    import json
+
+    def repl(m):
+        body = re.sub(r"\r?\n$", "", m.group(2))  # delimiter-line newline
+        return json.dumps(body)
+
+    return _HEREDOC_RE.sub(repl, text)
+
+
+def _decode_string(raw: str) -> str:
+    """STRING token text -> its value. Heredoc preprocessing emits
+    json-escaped strings, so decode escapes properly; hand-authored HCL
+    strings that json can't parse keep the old strip-quotes behaviour."""
+    import json
+
+    try:
+        return json.loads(raw)
+    except Exception:  # noqa: BLE001 - non-json escapes: legacy path
+        return _unquote(raw)
+
+
 def parse_hcl(text: str) -> Module:
-    body = _BuildAst().transform(_PARSER.parse(text))
+    body = _BuildAst().transform(_PARSER.parse(_strip_heredocs(text)))
     return Module(blocks=[b for tag, _, b in body if tag == "block"])
 
 
@@ -187,11 +223,18 @@ def expr_references(expr) -> set[tuple[str, ...]]:
     for node in _walk(expr):
         if not hasattr(node, "data"):
             if isinstance(node, Token) and node.type == "STRING":
-                for inner in _INTERP_RE.findall(str(node)):
+                # decode first: heredoc-generated strings carry escaped
+                # quotes inside interpolations (${join("...")}) that the
+                # raw token text would mis-parse
+                for inner in _INTERP_RE.findall(_decode_string(str(node))):
                     try:
                         refs |= expr_references(_EXPR_PARSER.parse(inner))
-                    except Exception as e:  # noqa: BLE001
-                        raise HclError(f"bad interpolation {inner!r}: {e}") from e
+                    except Exception:  # noqa: BLE001
+                        # expression forms outside the grammar: no refs
+                        # extractable — a grammar gap, not a defect, so
+                        # it must not block (same philosophy as the
+                        # precheck's warn-and-proceed)
+                        continue
             continue
         if node.data == "reference":
             refs.add((str(node.children[0]),))
@@ -319,7 +362,7 @@ class _Unresolved:
 def _eval(expr, env: dict) -> Any:
     if isinstance(expr, Token):
         if expr.type == "STRING":
-            raw = _unquote(str(expr))
+            raw = _decode_string(str(expr))
             return _INTERP_RE.sub(
                 lambda m: _to_str(_eval(_EXPR_PARSER.parse(m.group(1)), env)), raw
             )
@@ -341,16 +384,24 @@ def _eval(expr, env: dict) -> Any:
         return _lookup(env, (str(kids[0]),))
     if data == "postfix":
         value = _eval(kids[0], env)
+        splatted = False  # after a[*], getattrs map over elements
         for part in kids[1:]:
             if isinstance(value, _Unresolved):
-                suffix = (
-                    f".{part.children[0]}"
-                    if part.data == "getattr"
-                    else f"[{_to_str(_eval(part.children[0], env))}]"
-                )
+                if part.data == "getattr":
+                    suffix = f".{part.children[0]}"
+                elif part.data == "splat":
+                    suffix = "[*]"
+                else:
+                    suffix = f"[{_to_str(_eval(part.children[0], env))}]"
                 value = _Unresolved(value.path + suffix)
+            elif part.data == "splat":
+                value = (
+                    list(value) if isinstance(value, (list, tuple)) else [value]
+                )
+                splatted = True
             elif part.data == "getattr":
-                value = value[str(part.children[0])]
+                name = str(part.children[0])
+                value = [e[name] for e in value] if splatted else value[name]
             else:
                 value = value[_eval(part.children[0], env)]
         return value
