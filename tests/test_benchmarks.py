@@ -3,6 +3,7 @@ drive in bench.py / the driver; these pin the arithmetic and parity
 workloads)."""
 
 import hashlib
+import os
 import json
 import subprocess
 import sys
@@ -68,3 +69,35 @@ def test_containerbench_cli_json(tmp_path):
     assert proc.returncode == 0, proc.stderr
     records = [json.loads(line) for line in proc.stdout.strip().splitlines()]
     assert [r["workload"] for r in records] == ["disk", "cpu"]
+
+
+def test_bench_py_driver_contract():
+    """bench.py is the driver's measurement entrypoint: exactly ONE JSON
+    line on stdout carrying the four driver-read fields plus the r03
+    context fields (mfu may be null off-TPU). Run as a subprocess on the
+    CPU path so the whole script — imports, fallback branch, JSON
+    assembly — executes as the driver runs it."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # force the CPU fallback branch
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    proc = subprocess.run(
+        [sys.executable, "bench.py"],
+        capture_output=True, text=True, timeout=600,
+        cwd=Path(__file__).resolve().parent.parent,
+        env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    json_lines = [
+        line for line in proc.stdout.splitlines() if line.startswith("{")
+    ]
+    assert len(json_lines) == 1, proc.stdout
+    record = json.loads(json_lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline"):
+        assert key in record, record
+    for key in ("step_ms", "step_ms_min", "step_ms_windows", "mfu",
+                "flops_per_image", "platform", "num_chips"):
+        assert key in record, record
+    assert record["value"] > 0
+    assert record["platform"] == "cpu"
+    assert record["num_chips"] == 8
