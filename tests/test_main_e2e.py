@@ -280,3 +280,26 @@ def test_missing_terraform_binary_is_friendly(fake_world, capsys):
     assert rc == 1
     err = capsys.readouterr().err
     assert "ERROR:" in err and "terraform" in err
+
+
+def test_checkpoint_dir_flows_into_manifests(fake_world, capsys):
+    """--checkpoint-dir (round-2 VERDICT missing #4): the CLI flag must
+    reach the generated Job command as a per-slice gs:// path with the
+    GCS backend added to the self-install line."""
+    import yaml
+
+    work, _ = fake_world
+    config_path = saved_config(
+        work, MODE="gke", TOPOLOGY="2x2", CLUSTER_NAME="stub-cluster"
+    )
+    rc = main([
+        "--yes", "--config", str(config_path), "--workdir", str(work),
+        "--checkpoint-dir", "gs://bkt/ckpt",
+    ])
+    assert rc == 0, capsys.readouterr().out
+    job = yaml.safe_load(
+        (RunPaths(work).manifests_dir / "bench-job-0.yaml").read_text()
+    )
+    script = job["spec"]["template"]["spec"]["containers"][0]["command"][-1]
+    assert "--checkpoint-dir gs://bkt/ckpt/slice-0" in script
+    assert "gcsfs" in script
