@@ -1,15 +1,25 @@
 #!/usr/bin/env python3
 """Driver benchmark entrypoint: ONE JSON line on stdout.
 
-Runs the flagship ResNet-50 training benchmark (BASELINE.json metric:
-images/sec/chip) on whatever accelerator is present — the real TPU chip
-under the driver, the virtual CPU mesh in CI.
+Runs BOTH benchmark families on whatever accelerator is present — the
+real TPU chip under the driver, the virtual CPU mesh in CI:
 
-vs_baseline is measured against the target recorded in BASELINE.md:
-1000 images/sec/chip for ResNet-50 bf16 on a v5e chip (the reference
-repo publishes no accelerator numbers — SURVEY.md §6 — so the target is
-the public ballpark for this chip generation, recorded up front so every
-round is comparable).
+- ResNet-50 training (BASELINE.json metric: images/sec/chip) — the
+  flagship; its metric/value/unit/vs_baseline stay top-level, which is
+  the four-field contract the driver reads.
+- Transformer-LM training (tokens/sec/chip) — the long-context
+  companion; its record rides in the `benchmarks` array of the same
+  line so BENCH_r{N}.json regression-guards both families round over
+  round (r03 verdict weak #3: half the benchmark surface was invisible
+  to the driver).
+
+vs_baseline semantics: ResNet is measured against the up-front target
+recorded in BASELINE.md (1000 images/sec/chip for bf16 on a v5e — the
+reference repo publishes no accelerator numbers, SURVEY.md §6). The LM
+family had no up-front target; its vs_baseline is measured against the
+first driver-tracked number (r03: 98,327 tok/s/chip on the same chip),
+so it is a round-over-round regression guard rather than a beat-the-
+target score.
 """
 
 from __future__ import annotations
@@ -19,12 +29,24 @@ import sys
 
 # images/sec/chip target for ResNet-50 bf16 on TPU v5e (see BASELINE.md)
 TPU_BASELINE_IMG_S_CHIP = 1000.0
+# tokens/sec/chip for the 12L/768d seq-1024 LM, as first measured on the
+# v5e in r03 (docs/benchmarks.md) — the regression-guard baseline
+TPU_BASELINE_TOK_S_CHIP = 98327.0
 
 
-def main() -> int:
-    import jax
+def _common_fields(result: dict) -> dict:
+    return {
+        "platform": result["platform"],
+        "num_chips": result["num_chips"],
+        "global_batch": result["global_batch"],
+        "step_ms": round(result["step_ms"], 2),
+        "step_ms_min": round(result["step_ms_min"], 2),
+        "step_ms_windows": result["step_ms_windows"],
+        "mfu": round(result["mfu"], 4) if result["mfu"] is not None else None,
+    }
 
-    on_tpu = jax.default_backend() not in ("cpu",)
+
+def resnet_record(on_tpu: bool) -> dict:
     from tritonk8ssupervisor_tpu.benchmarks.resnet50 import run_benchmark
 
     if on_tpu:
@@ -51,22 +73,85 @@ def main() -> int:
             steps=3,
             warmup=1,
         )
-
     value = result["images_per_sec_per_chip"]
-    record = {
+    return {
         "metric": f"{result['model']}_images_per_sec_per_chip",
         "value": round(value, 2),
         "unit": "images/sec/chip",
         "vs_baseline": round(value / TPU_BASELINE_IMG_S_CHIP, 4),
-        # context fields (driver reads the four above; humans read these)
-        "platform": result["platform"],
-        "num_chips": result["num_chips"],
-        "global_batch": result["global_batch"],
-        "step_ms": round(result["step_ms"], 2),
-        "step_ms_min": round(result["step_ms_min"], 2),
-        "step_ms_windows": result["step_ms_windows"],
-        "mfu": round(result["mfu"], 4) if result["mfu"] is not None else None,
+        **_common_fields(result),
         "flops_per_image": result["flops_per_image"],
+    }
+
+
+def lm_record(on_tpu: bool) -> dict:
+    from tritonk8ssupervisor_tpu.benchmarks.lm import run_benchmark
+
+    # The CPU smoke runs a 2L/64d toy, not the 12L/768d configuration the
+    # 98,327 tok/s baseline was measured on — name it apart so a guard
+    # keyed on metric never compares the two series (the ResNet family
+    # disambiguates the same way via its model name).
+    name = "transformer_lm" if on_tpu else "transformer_lm_smoke"
+    if on_tpu:
+        # r03 configuration (docs/benchmarks.md): GPT-2-small-class dense
+        # attention, the configuration the baseline number was measured on
+        result = run_benchmark(
+            seq_len=1024,
+            batch_per_data_shard=8,
+            steps=50,
+            warmup=3,
+            windows=3,
+        )
+    else:
+        # CPU smoke: tiny shapes, dense attention, same code path
+        result = run_benchmark(
+            vocab_size=256,
+            num_layers=2,
+            num_heads=2,
+            embed_dim=64,
+            seq_len=64,
+            batch_per_data_shard=1,
+            steps=2,
+            warmup=1,
+            windows=1,
+        )
+    value = result["tokens_per_sec_per_chip"]
+    return {
+        "metric": f"{name}_tokens_per_sec_per_chip",
+        "value": round(value, 2),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(value / TPU_BASELINE_TOK_S_CHIP, 4),
+        **_common_fields(result),
+        "seq_len": result["seq_len"],
+        "attention": result["attention"],
+        "flops_per_token": result["flops_per_token"],
+    }
+
+
+def main() -> int:
+    import jax
+
+    on_tpu = jax.default_backend() not in ("cpu",)
+    resnet = resnet_record(on_tpu)
+    families = [resnet]
+    # An LM-only failure must not discard the already-measured flagship
+    # record — the driver's four-field contract rides on ResNet.
+    try:
+        families.append(lm_record(on_tpu))
+    except Exception as exc:  # noqa: BLE001 - report, don't lose the flagship
+        print(f"lm benchmark failed ({exc!r}); emitting flagship only",
+              file=sys.stderr)
+        # machine-readable absence: a guard must be able to tell "LM
+        # failed this round" from "LM never ran" (e.g. r01-r03 records)
+        families.append({
+            "metric": "transformer_lm_tokens_per_sec_per_chip",
+            "error": repr(exc),
+        })
+    record = {
+        # the four driver-read fields (flagship family)
+        **resnet,
+        # both families, machine-readable, for round-over-round guarding
+        "benchmarks": families,
     }
     print(json.dumps(record, sort_keys=True))
     return 0

@@ -101,3 +101,14 @@ def test_bench_py_driver_contract():
     assert record["value"] > 0
     assert record["platform"] == "cpu"
     assert record["num_chips"] == 8
+    # both benchmark families ride the same line (r03 verdict weak #3):
+    # flagship ResNet stays top-level; the LM record joins it in the array
+    families = record["benchmarks"]
+    assert [b["metric"] for b in families] == [
+        record["metric"],
+        "transformer_lm_smoke_tokens_per_sec_per_chip",
+    ]
+    for b in families:
+        for key in ("metric", "value", "unit", "vs_baseline", "step_ms"):
+            assert key in b, b
+        assert b["value"] > 0
