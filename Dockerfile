@@ -20,8 +20,13 @@ COPY pyproject.toml README.md ./
 COPY tritonk8ssupervisor_tpu ./tritonk8ssupervisor_tpu
 
 # jax[tpu]==<pin> resolves libtpu from the Google releases index; the pin
-# here rides the `tpu` extra so it stays equal to JAX_VERSION_PIN.
-RUN pip install --no-cache-dir ".[tpu]" \
+# here rides the `tpu` extra so it stays equal to JAX_VERSION_PIN. The
+# `gcs` extra ships the etils/epath GCS backend: a Job built from this
+# image receives the same `--checkpoint-dir gs://...` flag as the
+# self-install path, so gs:// support must be baked in (the self-install
+# path appends gcsfs at pod start; an image without it crash-loops on
+# the first checkpoint write).
+RUN pip install --no-cache-dir ".[tpu,gcs]" \
     -f https://storage.googleapis.com/jax-releases/libtpu_releases.html
 
 CMD ["python", "-m", "tritonk8ssupervisor_tpu.benchmarks.resnet50", "--json"]

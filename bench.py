@@ -90,7 +90,8 @@ def lm_record(on_tpu: bool) -> dict:
     # The CPU smoke runs a 2L/64d toy, not the 12L/768d configuration the
     # 98,327 tok/s baseline was measured on — name it apart so a guard
     # keyed on metric never compares the two series (the ResNet family
-    # disambiguates the same way via its model name).
+    # disambiguates the same way via its model name). Keep in sync with
+    # main()'s lm_name for the failure-stub record.
     name = "transformer_lm" if on_tpu else "transformer_lm_smoke"
     if on_tpu:
         # r03 configuration (docs/benchmarks.md): GPT-2-small-class dense
@@ -136,15 +137,17 @@ def main() -> int:
     families = [resnet]
     # An LM-only failure must not discard the already-measured flagship
     # record — the driver's four-field contract rides on ResNet.
+    lm_name = "transformer_lm" if on_tpu else "transformer_lm_smoke"
     try:
         families.append(lm_record(on_tpu))
     except Exception as exc:  # noqa: BLE001 - report, don't lose the flagship
         print(f"lm benchmark failed ({exc!r}); emitting flagship only",
               file=sys.stderr)
-        # machine-readable absence: a guard must be able to tell "LM
-        # failed this round" from "LM never ran" (e.g. r01-r03 records)
+        # machine-readable absence under the SAME series name the
+        # success path would use: a guard must be able to tell "failed
+        # this round" from "never ran" (e.g. r01-r03 records)
         families.append({
-            "metric": "transformer_lm_tokens_per_sec_per_chip",
+            "metric": f"{lm_name}_tokens_per_sec_per_chip",
             "error": repr(exc),
         })
     record = {

@@ -350,3 +350,40 @@ def test_splat_renders_in_plans():
         mod, {"objs": [{"id": "a"}, {"id": "b"}]}
     )
     assert plan["x.y"]["ids"] == ["a", "b"]
+
+
+def test_full_splat_maps_following_index_per_element():
+    """HCL2 full-splat semantics (r03 advisor): var.xs[*][0] projects the
+    index over elements — [e[0] for e in xs] — not legacy .*-style
+    index-into-the-projection."""
+    mod = hcl.parse_hcl(
+        'variable "xs" { default = [] }\n'
+        'resource "x" "y" { firsts = var.xs[*][0] }\n'
+    )
+    plan = hcl.render_plan(mod, {"xs": [["a1", "a2"], ["b1", "b2"]]})
+    assert plan["x.y"]["firsts"] == ["a1", "b1"]
+    # and chains keep mapping: [*].id[0] == [e["id"][0] for e in xs]
+    mod = hcl.parse_hcl(
+        'variable "xs" { default = [] }\n'
+        'resource "x" "y" { v = var.xs[*].ids[1] }\n'
+    )
+    plan = hcl.render_plan(
+        mod, {"xs": [{"ids": ["a1", "a2"]}, {"ids": ["b1", "b2"]}]}
+    )
+    assert plan["x.y"]["v"] == ["a2", "b2"]
+
+
+def test_unparseable_interpolation_warns_not_silent():
+    """Grammar gaps in interpolations must surface a warning (r03
+    advisor): references inside them escape the dangling-ref check, and
+    an operator should know the precheck's blind spot exists."""
+    import warnings as _warnings
+
+    mod = hcl.parse_hcl(
+        'resource "x" "y" {\n  s = "${%%not-grammar%%}"\n}\n'
+    )
+    with _warnings.catch_warnings(record=True) as caught:
+        _warnings.simplefilter("always")
+        hcl.validate_module(mod)
+    assert any("outside the expression grammar" in str(w.message)
+               for w in caught)

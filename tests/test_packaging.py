@@ -193,5 +193,8 @@ def test_write_manifests_includes_configmap(tmp_path):
 
 def test_dockerfile_installs_tpu_extra():
     text = (REPO / "Dockerfile").read_text()
-    assert '".[tpu]"' in text
+    # gcs rides along (r03 advisor): the Job passes the same
+    # --checkpoint-dir gs://... to custom images as to self-install
+    # pods, so the image must carry the GCS backend too
+    assert '".[tpu,gcs]"' in text
     assert "libtpu_releases.html" in text

@@ -1,0 +1,125 @@
+"""Roofline trace attribution (utils/roofline.py) against a synthetic
+jax.profiler trace with hand-computable numbers."""
+
+from __future__ import annotations
+
+import gzip
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+from tritonk8ssupervisor_tpu.utils import roofline
+
+
+def write_trace(tmp_path: Path) -> Path:
+    """Two device ops + one host event (ignored) in the jax.profiler
+    trace.json.gz shape: 'XLA Ops' thread carries per-op device duration,
+    bytes_accessed, model_flops."""
+    run = tmp_path / "plugins" / "profile" / "run1"
+    run.mkdir(parents=True)
+
+    def op(name, dur_ms, nbytes, flops, cat):
+        return {
+            "ph": "X", "pid": 1, "tid": 2, "name": name,
+            "ts": 0, "dur": dur_ms * 1e3,
+            "args": {
+                "device_duration_ps": str(int(dur_ms * 1e9)),
+                "bytes_accessed": str(nbytes),
+                "model_flops": str(flops),
+                "hlo_category": cat,
+            },
+        }
+
+    trace = {
+        "traceEvents": [
+            {"ph": "M", "pid": 1, "name": "process_name",
+             "args": {"name": "/device:TPU:0"}},
+            {"ph": "M", "pid": 1, "tid": 2, "name": "thread_name",
+             "args": {"name": "XLA Ops"}},
+            {"ph": "M", "pid": 9, "tid": 9, "name": "thread_name",
+             "args": {"name": "python"}},
+            # 1 ms moving 0.819 GB = exactly peak BW on the fake chip below
+            op("conv.1", 1.0, 819_000_000, 100e9, "convolution fusion"),
+            # 1 ms moving half of peak and negligible FLOPs: claw-back op
+            op("slowpoke", 1.0, 409_500_000, 1e9, "loop fusion"),
+            # same op name again: occurrences merge
+            op("slowpoke", 1.0, 409_500_000, 1e9, "loop fusion"),
+            # host event on another thread must be ignored
+            {"ph": "X", "pid": 9, "tid": 9, "name": "hostwork",
+             "ts": 0, "dur": 5e3, "args": {}},
+        ]
+    }
+    path = run / "host.trace.json.gz"
+    with gzip.open(path, "wt") as f:
+        json.dump(trace, f)
+    return tmp_path
+
+
+def test_analyze_totals_and_roofline(tmp_path):
+    profile_dir = write_trace(tmp_path)
+    report = roofline.analyze(
+        str(profile_dir),
+        peak_bytes_per_sec=819e9,
+        peak_flops_per_sec=197e12,
+    )
+    assert abs(report.total_ms - 3.0) < 1e-9
+    assert abs(report.total_bytes - 1_638_000_000) < 1
+    # HBM roofline: 1.638 GB / 819 GB/s = 2.0 ms
+    assert abs(report.hbm_bound_ms - 2.0) < 1e-9
+    assert abs(report.achieved_bytes_per_sec - 546e9) < 1e9
+    assert abs(report.hbm_efficiency - 546 / 819) < 1e-3
+    # merged occurrences
+    slow = next(op for op in report.ops if op.name == "slowpoke")
+    assert slow.occurrences == 2
+    assert abs(slow.duration_ms - 2.0) < 1e-9
+    assert abs(slow.gbytes_per_sec - 409.5) < 0.1
+    by_cat = report.by_category_ms
+    assert abs(by_cat["loop fusion"] - 2.0) < 1e-9
+
+
+def test_clawback_selects_sub_roofline_ops(tmp_path):
+    profile_dir = write_trace(tmp_path)
+    report = roofline.analyze(
+        str(profile_dir),
+        peak_bytes_per_sec=819e9,
+        peak_flops_per_sec=197e12,
+    )
+    claw = report.clawback(min_ms=0.5)
+    # conv.1 is AT the bandwidth roofline -> excluded; slowpoke at 50% -> in
+    assert [op.name for op in claw] == ["slowpoke"]
+
+
+def test_dispatches_divides_everything(tmp_path):
+    profile_dir = write_trace(tmp_path)
+    report = roofline.analyze(
+        str(profile_dir), dispatches=2, peak_bytes_per_sec=819e9,
+        peak_flops_per_sec=197e12,
+    )
+    assert abs(report.total_ms - 1.5) < 1e-9
+    assert abs(report.hbm_bound_ms - 1.0) < 1e-9
+
+
+def test_cli_json(tmp_path):
+    profile_dir = write_trace(tmp_path)
+    proc = subprocess.run(
+        [sys.executable, "-m", "tritonk8ssupervisor_tpu.utils.roofline",
+         str(profile_dir), "--json", "--peak-gbs", "819",
+         "--peak-tflops", "197"],
+        capture_output=True, text=True, timeout=120,
+        cwd=Path(__file__).resolve().parent.parent,
+    )
+    assert proc.returncode == 0, proc.stderr
+    record = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert abs(record["total_ms"] - 3.0) < 1e-9
+    assert abs(record["hbm_bound_ms"] - 2.0) < 1e-9
+    assert record["clawback_ms"] > 0
+
+
+def test_missing_trace_raises(tmp_path):
+    try:
+        roofline.find_trace_file(str(tmp_path))
+    except FileNotFoundError as e:
+        assert "trace.json.gz" in str(e)
+    else:
+        raise AssertionError("expected FileNotFoundError")

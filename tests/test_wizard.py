@@ -101,6 +101,34 @@ def test_network_menu_other_escape_hatch():
     assert config.subnetwork == "xpn-host-net"
 
 
+def test_unlisted_default_preserved_by_plain_enter():
+    """r03 advisor: a configured network the live listing can't see
+    (shared VPC) must survive Enter-through — it joins the menu as its
+    own default-selected entry."""
+    def prompter_for(lines):
+        return Prompter(io.StringIO("\n".join(lines) + "\n"), io.StringIO())
+
+    name = wizard._choose_named(
+        prompter_for([""]),  # plain Enter keeps the configured name
+        "VPC network", ["default", "prod-net"], "xpn-host-net",
+    )
+    assert name == "xpn-host-net"
+    # the listed options stay selectable by number
+    assert wizard._choose_named(
+        prompter_for(["2"]),
+        "VPC network", ["default", "prod-net"], "xpn-host-net",
+    ) == "prod-net"
+    # empty default still lands on the first listed option
+    assert wizard._choose_named(
+        prompter_for([""]), "VPC network", ["default"], ""
+    ) == "default"
+    # the schema's own "default" guess is weak: unlisted, it falls to
+    # the first live option instead of pinning a nonexistent name
+    assert wizard._choose_named(
+        prompter_for([""]), "VPC network", ["vpc-a", "vpc-b"], "default"
+    ) == "vpc-a"
+
+
 def test_network_menu_uses_live_listing():
     seen = {}
 

@@ -52,9 +52,27 @@ def _choose_named(
     reference's network menus offered only the listed choices
     (setup.sh:309-400); GCP needs the extra door."""
     other = "other (enter a name)"
-    default_index = options.index(default) if default in options else 0
-    choice = prompter.menu(title, options + [other], default_index)
-    if choice == len(options):
+    # A configured name the live listing can't see (shared VPC,
+    # cross-project) must not silently fall to the first listed option:
+    # it joins the menu as its own default-selected entry, so plain
+    # Enter preserves the existing config value. The literal "default"
+    # is the tool's own schema guess (GCP's auto-network name), not a
+    # user choice — unlisted it means "no such network here", so it
+    # falls to the first live option as before.
+    entries = list(options)
+    configured = None
+    if default in options:
+        default_index = options.index(default)
+    elif default and default != "default":
+        configured = len(entries)
+        entries.append(f"{default} (configured; not in live listing)")
+        default_index = configured
+    else:
+        default_index = 0
+    choice = prompter.menu(title, entries + [other], default_index)
+    if configured is not None and choice == configured:
+        return default
+    if choice == len(entries):
         return prompter.ask_validated(
             "Name", default, lambda v: "" if v else "a name is required"
         )

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import dataclasses
 import re
+import warnings
 from pathlib import Path
 from typing import Any
 
@@ -232,8 +233,18 @@ def expr_references(expr) -> set[tuple[str, ...]]:
                     except Exception:  # noqa: BLE001
                         # expression forms outside the grammar: no refs
                         # extractable — a grammar gap, not a defect, so
-                        # it must not block (same philosophy as the
-                        # precheck's warn-and-proceed)
+                        # it must not block. But dangling references
+                        # inside this interpolation now escape the
+                        # precheck, so make the gap visible instead of
+                        # silent (the precheck's warn-and-proceed
+                        # philosophy).
+                        warnings.warn(
+                            "hcl precheck: interpolation "
+                            f"${{{inner}}} is outside the expression "
+                            "grammar; references inside it are not "
+                            "checked",
+                            stacklevel=2,
+                        )
                         continue
             continue
         if node.data == "reference":
@@ -403,7 +414,10 @@ def _eval(expr, env: dict) -> Any:
                 name = str(part.children[0])
                 value = [e[name] for e in value] if splatted else value[name]
             else:
-                value = value[_eval(part.children[0], env)]
+                # HCL2 full splat: every later index maps per element
+                # (var.xs[*][0] is [e[0] for e in xs], not xs[0])
+                idx = _eval(part.children[0], env)
+                value = [e[idx] for e in value] if splatted else value[idx]
         return value
     if data == "funccall":
         fname = str(kids[0])

@@ -32,16 +32,29 @@ PEAK_BF16_FLOPS = {
 }
 
 
-def peak_flops_per_chip(device=None) -> float | None:
-    """Dense bf16 peak for this chip, or None when unknown (CPU mesh)."""
-    device = device or jax.devices()[0]
+def peak_for_device(table: dict[str, float], device=None) -> float | None:
+    """Look a chip peak up by jax Device.device_kind in `table` (exact
+    match, then prefix match to tolerate suffixed kinds); None when the
+    kind is unknown or no device is reachable (CPU mesh, host-only
+    analysis). Shared by the FLOPs table here and the HBM table in
+    utils/roofline.py so kind-matching can't diverge between them."""
+    if device is None:
+        try:
+            device = jax.devices()[0]
+        except Exception:
+            return None
     kind = getattr(device, "device_kind", "")
-    if kind in PEAK_BF16_FLOPS:
-        return PEAK_BF16_FLOPS[kind]
-    for name, peak in PEAK_BF16_FLOPS.items():  # tolerate suffixed kinds
+    if kind in table:
+        return table[kind]
+    for name, peak in table.items():
         if kind.startswith(name):
             return peak
     return None
+
+
+def peak_flops_per_chip(device=None) -> float | None:
+    """Dense bf16 peak for this chip, or None when unknown (CPU mesh)."""
+    return peak_for_device(PEAK_BF16_FLOPS, device)
 
 
 def compiled_flops(compiled) -> float | None:

@@ -1,0 +1,342 @@
+"""Roofline attribution of a jax.profiler trace: where the step time goes
+and how close each op runs to the chip's HBM/MXU ceilings.
+
+The reference framework published throughput with no utilisation analysis
+(reference docs/benchmarks.md:19-50 is a raw numbers table); SURVEY.md §5
+prescribes profiling hooks. utils/perf.py captures the trace; this module
+turns it into the evidence that decides optimisation work — per-op achieved
+bytes/s and FLOP/s against the chip peaks, so "this op is slow" becomes
+"this op is at 66% of HBM peak and is the claw-back target" or "the program
+averages 98% of HBM peak and further speedup must REDUCE bytes, not
+reschedule them" (the r04 ResNet-50 finding that redirected the perf work
+from wgrad-kernel scheduling to fusion-boundary traffic).
+
+Input: the profile directory written by `--profile DIR` (benchmarks/
+resnet50.py, benchmarks/lm.py) — jax.profiler emits
+`plugins/profile/<run>/<host>.trace.json.gz` with one complete-event (ph
+"X") per XLA op on the device "XLA Ops" track, carrying XLA's own
+`bytes_accessed` (fusion-boundary HBM traffic), `model_flops`, and
+`device_duration_ps` per event.
+
+CLI: python -m tritonk8ssupervisor_tpu.utils.roofline DIR [--steps N]
+(--steps divides by the number of profiled dispatches when the capture
+wrapped more than one).
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import glob
+import gzip
+import json
+import os
+from dataclasses import dataclass, field
+
+from tritonk8ssupervisor_tpu.utils import perf
+
+# Published HBM bandwidth per chip (bytes/s). Same sourcing as
+# perf.PEAK_BF16_FLOPS: Google Cloud TPU system-architecture docs / the
+# public scaling-book tables. Keys are jax Device.device_kind strings.
+PEAK_HBM_BYTES = {
+    "TPU v4": 1228e9,
+    "TPU v5 lite": 819e9,  # v5e
+    "TPU v5e": 819e9,
+    "TPU v5": 2765e9,  # v5p
+    "TPU v5p": 2765e9,
+    "TPU v6 lite": 1640e9,  # v6e / Trillium
+    "TPU v6e": 1640e9,
+}
+
+
+def peak_hbm_bytes_per_sec(device=None) -> float | None:
+    """HBM peak for this chip, or None when unknown (CPU mesh)."""
+    return perf.peak_for_device(PEAK_HBM_BYTES, device)
+
+
+@dataclass
+class OpStat:
+    """One device op occurrence aggregated across the capture."""
+
+    name: str
+    category: str
+    duration_ms: float
+    bytes_accessed: float
+    flops: float
+    occurrences: int = 1
+
+    @property
+    def gbytes_per_sec(self) -> float:
+        if self.duration_ms <= 0:
+            return 0.0
+        return self.bytes_accessed / (self.duration_ms / 1e3) / 1e9
+
+    @property
+    def tflops_per_sec(self) -> float:
+        if self.duration_ms <= 0:
+            return 0.0
+        return self.flops / (self.duration_ms / 1e3) / 1e12
+
+
+@dataclass
+class RooflineReport:
+    """Whole-capture summary + per-op stats, peaks attached when known."""
+
+    total_ms: float
+    total_bytes: float
+    total_flops: float
+    ops: list[OpStat]
+    by_category_ms: dict[str, float]
+    peak_bytes_per_sec: float | None = None
+    peak_flops_per_sec: float | None = None
+    dispatches: int = 1
+
+    @property
+    def achieved_bytes_per_sec(self) -> float:
+        if self.total_ms <= 0:
+            return 0.0
+        return self.total_bytes / (self.total_ms / 1e3)
+
+    @property
+    def hbm_bound_ms(self) -> float | None:
+        """Lower bound on device time if every byte moved at HBM peak —
+        the program's bandwidth roofline at its CURRENT fusion
+        boundaries. Time below this requires accessing fewer bytes."""
+        if not self.peak_bytes_per_sec:
+            return None
+        return self.total_bytes / self.peak_bytes_per_sec * 1e3
+
+    @property
+    def mxu_bound_ms(self) -> float | None:
+        if not self.peak_flops_per_sec:
+            return None
+        return self.total_flops / self.peak_flops_per_sec * 1e3
+
+    @property
+    def hbm_efficiency(self) -> float | None:
+        """achieved/peak average bandwidth — ~1.0 means the schedule is
+        saturated and only byte reduction can speed the program up."""
+        if not self.peak_bytes_per_sec:
+            return None
+        return self.achieved_bytes_per_sec / self.peak_bytes_per_sec
+
+    def clawback(
+        self,
+        min_ms: float = 0.08,
+        bw_fraction: float = 0.8,
+        mxu_fraction: float = 0.3,
+    ) -> list[OpStat]:
+        """Ops meaningfully below BOTH ceilings: the (bounded) pool of
+        time recoverable by better scheduling/kernels alone."""
+        if not (self.peak_bytes_per_sec and self.peak_flops_per_sec):
+            return []
+        bw_cut = self.peak_bytes_per_sec * bw_fraction / 1e9
+        mxu_cut = self.peak_flops_per_sec * mxu_fraction / 1e12
+        return [
+            op
+            for op in self.ops
+            if op.duration_ms >= min_ms
+            and op.gbytes_per_sec < bw_cut
+            and op.tflops_per_sec < mxu_cut
+        ]
+
+
+def find_trace_file(profile_dir: str) -> str:
+    """Locate the trace.json.gz under a --profile directory (or accept a
+    direct path to one)."""
+    if os.path.isfile(profile_dir):
+        return profile_dir
+    pattern = os.path.join(
+        profile_dir, "plugins", "profile", "*", "*.trace.json.gz"
+    )
+    matches = sorted(glob.glob(pattern)) or sorted(
+        glob.glob(os.path.join(profile_dir, "*.trace.json.gz"))
+    )
+    if not matches:
+        raise FileNotFoundError(
+            f"no *.trace.json.gz under {profile_dir!r} — pass the directory "
+            "given to --profile (or the trace file itself)"
+        )
+    return matches[-1]  # latest run
+
+
+def load_device_ops(trace_path: str) -> list[dict]:
+    """The raw 'XLA Ops' complete events (one per device op occurrence)."""
+    opener = gzip.open if trace_path.endswith(".gz") else open
+    with opener(trace_path, "rt") as f:
+        trace = json.load(f)
+    events = trace.get("traceEvents", [])
+    thread_names: dict[tuple, str] = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "thread_name":
+            thread_names[(e.get("pid"), e.get("tid"))] = e["args"]["name"]
+    return [
+        e
+        for e in events
+        if e.get("ph") == "X"
+        and thread_names.get((e.get("pid"), e.get("tid"))) == "XLA Ops"
+    ]
+
+
+def analyze(
+    profile_dir: str,
+    dispatches: int = 1,
+    peak_bytes_per_sec: float | None = None,
+    peak_flops_per_sec: float | None = None,
+) -> RooflineReport:
+    """Aggregate the capture into a RooflineReport. `dispatches` divides
+    everything when the capture wrapped more than one step dispatch, so
+    the report reads per-step."""
+    events = load_device_ops(find_trace_file(profile_dir))
+    merged: dict[str, OpStat] = {}
+    by_cat: dict[str, float] = collections.defaultdict(float)
+    total_ms = total_bytes = total_flops = 0.0
+    for e in events:
+        args = e.get("args", {})
+        # device_duration_ps is the device-clock truth; the event 'dur'
+        # (us) is the displayed approximation
+        dur_ms = float(args.get("device_duration_ps", e.get("dur", 0) * 1e6))
+        dur_ms /= 1e9 * dispatches
+        nbytes = float(args.get("bytes_accessed", 0)) / dispatches
+        flops = float(args.get("model_flops", 0)) / dispatches
+        cat = args.get("hlo_category", "?")
+        total_ms += dur_ms
+        total_bytes += nbytes
+        total_flops += flops
+        by_cat[cat] += dur_ms
+        stat = merged.get(e["name"])
+        if stat is None:
+            merged[e["name"]] = OpStat(e["name"], cat, dur_ms, nbytes, flops)
+        else:
+            stat.duration_ms += dur_ms
+            stat.bytes_accessed += nbytes
+            stat.flops += flops
+            stat.occurrences += 1
+    if dispatches > 1:
+        # everything in the report reads per dispatch, including how
+        # many times each op ran
+        for stat in merged.values():
+            stat.occurrences = max(1, round(stat.occurrences / dispatches))
+    if peak_bytes_per_sec is None:
+        peak_bytes_per_sec = peak_hbm_bytes_per_sec()
+    if peak_flops_per_sec is None:
+        peak_flops_per_sec = perf.peak_flops_per_chip()
+    ops = sorted(merged.values(), key=lambda s: -s.duration_ms)
+    return RooflineReport(
+        total_ms=total_ms,
+        total_bytes=total_bytes,
+        total_flops=total_flops,
+        ops=ops,
+        by_category_ms=dict(by_cat),
+        peak_bytes_per_sec=peak_bytes_per_sec,
+        peak_flops_per_sec=peak_flops_per_sec,
+        dispatches=dispatches,
+    )
+
+
+def format_report(report: RooflineReport, top: int = 20) -> str:
+    lines = []
+    lines.append(
+        f"device time {report.total_ms:.2f} ms | traffic "
+        f"{report.total_bytes / 1e9:.2f} GB | compute "
+        f"{report.total_flops / 1e12:.3f} TFLOP"
+        + (f" | per dispatch (/{report.dispatches})" if report.dispatches > 1 else "")
+    )
+    if report.peak_bytes_per_sec:
+        lines.append(
+            f"HBM roofline  {report.hbm_bound_ms:.2f} ms at "
+            f"{report.peak_bytes_per_sec / 1e9:.0f} GB/s peak | achieved "
+            f"{report.achieved_bytes_per_sec / 1e9:.0f} GB/s "
+            f"({report.hbm_efficiency * 100:.0f}% of peak)"
+        )
+    if report.peak_flops_per_sec:
+        lines.append(
+            f"MXU roofline  {report.mxu_bound_ms:.2f} ms at "
+            f"{report.peak_flops_per_sec / 1e12:.0f} TFLOP/s peak"
+        )
+    lines.append("by category (ms):")
+    for cat, ms in sorted(report.by_category_ms.items(), key=lambda kv: -kv[1]):
+        if ms >= 0.01:
+            lines.append(f"  {ms:8.3f}  {cat}")
+    lines.append(
+        f"top {top} ops:  ms        x     GB/s   TFLOP/s  category"
+    )
+    for op in report.ops[:top]:
+        lines.append(
+            f"  {op.duration_ms:8.3f} {op.occurrences:4d} "
+            f"{op.gbytes_per_sec:8.0f} {op.tflops_per_sec:9.2f}  "
+            f"{op.category:<20} {op.name[:48]}"
+        )
+    claw = report.clawback()
+    if claw:
+        recoverable = sum(op.duration_ms for op in claw)
+        lines.append(
+            f"claw-back (sub-roofline ops >=0.08 ms): {recoverable:.2f} ms "
+            "recoverable by scheduling/kernels alone"
+        )
+        for op in claw[:10]:
+            lines.append(
+                f"  {op.duration_ms:8.3f}  {op.gbytes_per_sec:6.0f} GB/s "
+                f"{op.tflops_per_sec:7.2f} TF/s  {op.name[:52]}"
+            )
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("profile_dir", help="directory given to --profile")
+    parser.add_argument(
+        "--dispatches",
+        type=int,
+        default=1,
+        help="step dispatches inside the capture (divides all numbers)",
+    )
+    parser.add_argument("--top", type=int, default=20)
+    parser.add_argument(
+        "--peak-gbs",
+        type=float,
+        default=None,
+        help="HBM peak GB/s override (default: this host's chip kind)",
+    )
+    parser.add_argument(
+        "--peak-tflops",
+        type=float,
+        default=None,
+        help="bf16 peak TFLOP/s override (default: this host's chip kind)",
+    )
+    parser.add_argument("--json", action="store_true")
+    args = parser.parse_args(argv)
+    report = analyze(
+        args.profile_dir,
+        dispatches=args.dispatches,
+        peak_bytes_per_sec=args.peak_gbs * 1e9 if args.peak_gbs else None,
+        peak_flops_per_sec=(
+            args.peak_tflops * 1e12 if args.peak_tflops else None
+        ),
+    )
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "total_ms": report.total_ms,
+                    "total_gbytes": report.total_bytes / 1e9,
+                    "total_tflops": report.total_flops / 1e12,
+                    "achieved_gbytes_per_sec": report.achieved_bytes_per_sec / 1e9,
+                    "hbm_bound_ms": report.hbm_bound_ms,
+                    "mxu_bound_ms": report.mxu_bound_ms,
+                    "hbm_efficiency": report.hbm_efficiency,
+                    "by_category_ms": report.by_category_ms,
+                    "clawback_ms": sum(
+                        op.duration_ms for op in report.clawback()
+                    ),
+                },
+                sort_keys=True,
+            )
+        )
+    else:
+        print(format_report(report, top=args.top))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
