@@ -136,6 +136,7 @@ def run_benchmark(
         warmup=warmup,
         windows=windows,
         profile_dir=profile_dir,
+        on_window=ckpt_lib.window_save_hook(ckpt) if checkpoint_dir else None,
     )
     compile_seconds = (
         timing.pop("first_fence_seconds") - init_start - restore_seconds

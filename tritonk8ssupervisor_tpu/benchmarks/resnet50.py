@@ -49,6 +49,7 @@ def run_benchmark(
     steps_per_call: int = 0,
     model_parallelism: int = 1,
     learning_rate: float = 0.1,
+    fused_1x1_bwd: bool = False,
     checkpoint_dir: str | None = None,
     profile_dir: str | None = None,
 ) -> dict:
@@ -80,7 +81,9 @@ def run_benchmark(
             f"({steps_per_call})"
         )
 
-    model = MODELS[model_name](num_classes=num_classes)
+    model = MODELS[model_name](
+        num_classes=num_classes, fused_1x1_bwd=fused_1x1_bwd
+    )
     tx = train_lib.default_optimizer(learning_rate=learning_rate)
     # bf16 input halves the first conv's HBM read (the model computes in
     # bf16 regardless); measured +4% throughput (106 vs 110 ms/step) on v5e
@@ -135,6 +138,7 @@ def run_benchmark(
         windows=windows,
         steps_per_call=steps_per_call,
         profile_dir=profile_dir,
+        on_window=ckpt_lib.window_save_hook(ckpt) if checkpoint_dir else None,
     )
     compile_seconds = (
         timing.pop("first_fence_seconds") - init_start - restore_seconds
@@ -185,6 +189,13 @@ def build_parser() -> argparse.ArgumentParser:
     )
     parser.add_argument("--model-parallelism", type=int, default=1)
     parser.add_argument(
+        "--fused-1x1-bwd",
+        action="store_true",
+        help="fused pallas backward for stride-1 1x1 convs "
+        "(ops/conv_backward.py) — A/B lever for the bandwidth-bound "
+        "backward stages",
+    )
+    parser.add_argument(
         "--profile",
         default=None,
         metavar="DIR",
@@ -214,6 +225,7 @@ def main(argv: list[str] | None = None) -> int:
         windows=args.windows,
         steps_per_call=args.steps_per_call,
         model_parallelism=args.model_parallelism,
+        fused_1x1_bwd=args.fused_1x1_bwd,
         checkpoint_dir=args.checkpoint_dir,
         profile_dir=args.profile,
     )

@@ -382,7 +382,15 @@ def to_benchmark_job(
             "completions": hosts,
             "parallelism": hosts,
             "completionMode": "Indexed",
-            "backoffLimit": 0,
+            # Failure recovery (SURVEY.md §5; the reference's node-join
+            # converged on re-run, rancherhost/tasks/main.yml:2-9): one
+            # lost pod kills the slice's whole JAX cluster — every
+            # sibling crashes on the broken collective — so a single
+            # recovery costs ~`hosts` pod failures. With a checkpoint
+            # dir, budget 3 gang restarts (each retry self-resumes from
+            # the latest per-window save); without one a retry would
+            # replay the whole run from step 0, so keep fail-fast.
+            "backoffLimit": 3 * hosts if checkpoint_dir else 0,
             "template": {
                 "metadata": {"labels": {"app": name}},
                 "spec": {

@@ -1,0 +1,190 @@
+"""Fused backward for stride-1 1x1 convolutions: one pass over dY.
+
+Why this kernel exists (r04 roofline, utils/roofline.py on the ResNet-50
+trace): the train step moves 78.5 GB/step at 98% of the v5e's 819 GB/s
+HBM peak — the backward convolutions are *bandwidth*-saturated, so the
+only way to make them faster is to access fewer bytes. XLA schedules the
+two halves of a conv backward as separate fusions:
+
+    dgrad reads dY, W     -> writes dX        (dY read #1)
+    wgrad reads X, dY     -> writes dW        (dY read #2)
+
+For a 1x1 stride-1 conv both halves are matmuls over the same flattened
+(B*H*W, C) operands, so a single pallas kernel can stream each dY tile
+into VMEM once and feed both MXU contractions from it:
+
+    dX tile = dY_tile @ W^T          (MXU, bf16 in / f32 acc)
+    dW     += X_tile^T @ dY_tile     (MXU, f32 accumulator in VMEM)
+
+eliminating one full read of dY per conv. In ResNet-50 stage 1 the
+256-channel dY arrays are 411 MB each — at the HBM roofline that read is
+~0.5 ms per conv, several ms across the early stages.
+
+The forward stays `lax.conv_general_dilated` (identical to nn.Conv, so
+XLA's forward BN/relu fusion behavior is untouched); only the backward
+is replaced, via custom_vjp. models/resnet.py exposes this as the
+`fused_1x1_bwd` A/B flag.
+
+MEASURED OUTCOME (v5e, bs 256, r04 — the reason the flag defaults off):
+the program got 61% slower, 159.8 vs 99.1 ms/step, traffic UP from 78.5
+to 107.3 GB/step. The custom call's row-major operand layout
+constraints relayout every neighbouring batch-in-sublanes array
+("data formatting" 0.44 -> 44.3 ms in the roofline report) and the
+BN-stat reductions that rode XLA's conv fusions become separate full
+passes (loop fusions 13.6 -> 47.0 ms). The ~5 GB the kernel saves costs
+~34 GB of re-materialisation. Full analysis: docs/benchmarks.md
+"The 99 ms wall, proven"; reproduce with --fused-1x1-bwd --profile DIR
++ utils/roofline.py. A future attempt must carry the whole backward
+block (conv + BN stats + relu mask) in one kernel to win.
+
+The reference framework had no compute kernels of any kind (SURVEY.md §2);
+this is TPU-native perf work on the flagship benchmark workload.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # CompilerParams location varies across jax versions
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+_MAX_TM = 1024
+_MIN_TM = 16  # bf16 sublane tile height
+# VMEM spend per grid step: x/dy/dx tiles double-buffered by the
+# pipeline (bf16), the f32 dgrad accumulator before its bf16 cast, the
+# revisited f32 dW block, plus compiler stack slack — against the
+# core's ~16 MB. Late ResNet stages have wide channels (512x2048) where
+# the dW block alone is 4 MB, so rows must scale down with c+n
+# (measured on v5e: tm=896 at c=512,n=2048 asks 17.3 MB and tm=448 at
+# c=2048,n=512 asks 17.8 MB — the Mosaic stack allocator refuses both).
+_VMEM_BUDGET = 10 * 1024 * 1024
+
+
+def _pick_tm(m: int, c: int = 256, n: int = 256) -> int | None:
+    """Largest divisor of m that is a multiple of 16, <= _MAX_TM, and
+    whose blocks fit the VMEM budget — the grid must cover m exactly
+    and tiles must stay sublane-aligned."""
+    fixed = c * n * 4  # f32 dW accumulator (revisited block)
+    # x, dy, dx double-buffered bf16 + f32 matmul accumulators
+    row_bytes = 2 * (2 * c + 2 * n + 2 * c) + 4 * c + 4 * n
+    cap = (_VMEM_BUDGET - fixed) // row_bytes if fixed < _VMEM_BUDGET else 0
+    for tm in range(min(_MAX_TM, m, cap), _MIN_TM - 1, -1):
+        if m % tm == 0 and tm % _MIN_TM == 0:
+            return tm
+    return None
+
+
+def _fused_kernel(x_ref, dy_ref, w_ref, dx_ref, dw_ref):
+    i = pl.program_id(0)
+    dy = dy_ref[...]
+    # dgrad: dY @ W^T — contract the output-channel dim of both
+    dx_ref[...] = jax.lax.dot_general(
+        dy, w_ref[...], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).astype(dx_ref.dtype)
+    # wgrad partial for this tile: X^T @ dY, accumulated in f32 in the
+    # revisited output block (same block for every grid step)
+    part = jax.lax.dot_general(
+        x_ref[...], dy, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(i == 0)
+    def _():
+        dw_ref[...] = part
+
+    @pl.when(i > 0)
+    def _():
+        dw_ref[...] += part
+
+
+def _fused_backward_2d(x2, dy2, w2, interpret: bool):
+    """(M, C), (M, N), (C, N) -> dX (M, C) in x2.dtype, dW (C, N) f32."""
+    m, c = x2.shape
+    n = dy2.shape[1]
+    tm = _pick_tm(m, c, n)
+    if tm is None:  # shape the grid can't cover: plain XLA dots
+        dx = jax.lax.dot_general(
+            dy2, w2, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ).astype(x2.dtype)
+        dw = jax.lax.dot_general(
+            x2, dy2, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return dx, dw
+    kwargs = {}
+    if pltpu is not None and not interpret:
+        # the dW block accumulates across grid steps -> sequential grid
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)
+        )
+    return pl.pallas_call(
+        _fused_kernel,
+        grid=(m // tm,),
+        in_specs=[
+            pl.BlockSpec((tm, c), lambda i: (i, 0)),
+            pl.BlockSpec((tm, n), lambda i: (i, 0)),
+            pl.BlockSpec((c, n), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((tm, c), lambda i: (i, 0)),
+            pl.BlockSpec((c, n), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((m, c), x2.dtype),
+            jax.ShapeDtypeStruct((c, n), jnp.float32),
+        ],
+        interpret=interpret,
+        **kwargs,
+    )(x2, dy2, w2)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def conv1x1(x, kernel, compute_dtype=jnp.bfloat16, interpret: bool = False):
+    """Stride-1 1x1 convolution whose backward is the fused pallas pass.
+
+    Args:
+      x: (B, H, W, C) activations (any float dtype).
+      kernel: (1, 1, C, N) parameters (flax nn.Conv layout/naming, so the
+        parameter tree is identical whichever conv class a checkpoint
+        was trained with).
+      compute_dtype: MXU input dtype (bf16 on TPU).
+      interpret: run the backward kernel interpreted (CPU tests).
+
+    Returns (B, H, W, N) in compute_dtype, like nn.Conv(dtype=...).
+    """
+    return jax.lax.conv_general_dilated(
+        x.astype(compute_dtype),
+        kernel.astype(compute_dtype),
+        window_strides=(1, 1),
+        padding="VALID",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+def _conv1x1_fwd(x, kernel, compute_dtype, interpret):
+    return conv1x1(x, kernel, compute_dtype, interpret), (x, kernel)
+
+
+def _conv1x1_bwd(compute_dtype, interpret, residuals, dy):
+    x, kernel = residuals
+    b, h, w_, c = x.shape
+    n = kernel.shape[-1]
+    m = b * h * w_
+    x2 = x.astype(compute_dtype).reshape(m, c)
+    dy2 = dy.astype(compute_dtype).reshape(m, n)
+    w2 = kernel.astype(compute_dtype)[0, 0]
+    dx2, dw2 = _fused_backward_2d(x2, dy2, w2, interpret)
+    dx = dx2.reshape(b, h, w_, c).astype(x.dtype)
+    dw = dw2[None, None].astype(kernel.dtype)
+    return dx, dw
+
+
+conv1x1.defvjp(_conv1x1_fwd, _conv1x1_bwd)

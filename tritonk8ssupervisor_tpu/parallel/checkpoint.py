@@ -90,10 +90,24 @@ def maybe_restore(
     return ckpt, state, start_step, time.monotonic() - start
 
 
+def window_save_hook(ckpt: "TrainCheckpointer | None"):
+    """The benchmarks' periodic-durability hook for
+    perf.timed_windows(on_window=...): with a checkpointer, every window
+    boundary persists the state, so a pod killed mid-run resumes at the
+    last completed window rather than step 0 (SURVEY.md §5 failure
+    recovery); without one, None keeps the timed loop untouched."""
+    if ckpt is None:
+        return None
+    return lambda state: ckpt.save(int(state.step), state)
+
+
 def save_and_close(ckpt: "TrainCheckpointer | None", state: Any) -> None:
-    """The matching postamble: persist the final step and flush."""
+    """The matching postamble: persist the final step and flush. A step
+    the per-window hook already saved is not re-saved (the last window's
+    boundary IS the final step when no profile capture follows)."""
     if ckpt is not None:
-        ckpt.save(int(state.step), state, wait=True)
+        if ckpt.latest_step() != int(state.step):
+            ckpt.save(int(state.step), state, wait=True)
         ckpt.close()
 
 

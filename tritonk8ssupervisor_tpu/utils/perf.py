@@ -97,6 +97,7 @@ def timed_windows(
     windows: int,
     steps_per_call: int = 1,
     profile_dir: str | None = None,
+    on_window: Callable[[Any], None] | None = None,
 ) -> tuple[Any, dict]:
     """THE measurement discipline, shared by every benchmark so their
     numbers stay comparable: warm up, then time `windows` independent
@@ -109,6 +110,15 @@ def timed_windows(
     run_once: state -> (state, metrics) — one dispatch (which covers
     `steps_per_call` chained steps). Optionally captures a profiler trace
     of one steady-state dispatch after the measured windows.
+
+    on_window(state) runs after each window's fence — the benchmarks'
+    periodic-checkpoint hook, so a pod killed mid-run resumes at the
+    last window boundary rather than step 0 (SURVEY.md §5 failure
+    recovery). It runs between windows, outside any window's own timed
+    span; an async save can still contend with the next window's
+    dispatches, which is the durability-over-purity trade the GKE Job
+    path makes (the driver's bench.py passes no checkpoint_dir, so
+    BENCH numbers never pay it).
 
     Returns (state, timing) where timing carries final_loss, step_ms
     (median), step_ms_min, step_ms_windows, steps, windows, and
@@ -130,6 +140,8 @@ def timed_windows(
             state, metrics = run_once(state)
         final_loss = float(metrics["loss"])  # the fence
         window_seconds.append(time.monotonic() - start)
+        if on_window is not None:
+            on_window(state)
 
     if profile_dir:
         with maybe_trace(profile_dir):
