@@ -48,6 +48,14 @@ resource "google_container_cluster" "cluster" {
   release_channel {
     channel = "REGULAR"
   }
+
+  # Workload Identity: pods authenticate as Kubernetes service accounts
+  # federated into IAM, so storage/API access is granted per workload
+  # (e.g. the checkpoint bucket binding in docs/benchmarks.md) instead
+  # of riding whatever the node can reach.
+  workload_identity_config {
+    workload_pool = "${var.project}.svc.id.goog"
+  }
 }
 
 resource "google_container_node_pool" "tpu_pool" {
@@ -81,6 +89,22 @@ resource "google_container_node_pool" "tpu_pool" {
       slice = tostring(count.index)
     }
 
-    oauth_scopes = ["https://www.googleapis.com/auth/cloud-platform"]
+    # Minimal node identity by default: image pulls + logs + metrics.
+    # Workload permissions come from Workload Identity bindings, not the
+    # node. broad_node_scopes=true restores the old cloud-platform
+    # everything-scope for clusters that can't take WI bindings yet.
+    oauth_scopes = var.broad_node_scopes ? [
+      "https://www.googleapis.com/auth/cloud-platform",
+      ] : [
+      "https://www.googleapis.com/auth/devstorage.read_only",
+      "https://www.googleapis.com/auth/logging.write",
+      "https://www.googleapis.com/auth/monitoring",
+    ]
+
+    # GKE_METADATA serves each pod its Workload Identity credentials (and
+    # blocks the node's own service-account token from workloads).
+    workload_metadata_config {
+      mode = "GKE_METADATA"
+    }
   }
 }

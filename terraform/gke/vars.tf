@@ -61,3 +61,9 @@ variable "subnetwork" {
   default     = "default"
   description = "VPC subnetwork"
 }
+
+variable "broad_node_scopes" {
+  type        = bool
+  default     = false
+  description = "Opt out of minimal node scopes: give nodes the broad cloud-platform scope instead of Workload Identity bindings (pre-WI clusters only)"
+}

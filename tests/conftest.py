@@ -5,6 +5,13 @@ The dev image's sitecustomize registers and initialises the axon TPU
 backend at interpreter startup — before this conftest runs — so setting
 env vars is not enough: the already-initialised backend must be cleared
 and the platform re-pinned through jax.config.
+
+Speed tiers (r03 verdict weak #5: a 15-minute default loop erodes the
+dev discipline): tests that compile big jitted programs on the virtual
+mesh carry @pytest.mark.slow and are skipped by default, keeping
+`pytest -q` under ~3 minutes while every subsystem retains at least one
+default-tier test. The full suite is `pytest --runslow` (CI / pre-merge);
+`pytest -m slow --runslow` runs only the heavy tier.
 """
 
 import os
@@ -25,3 +32,30 @@ if jax.default_backend() != "cpu" or jax.device_count() != 8:
     jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_num_cpu_devices", 8)
     assert jax.default_backend() == "cpu" and jax.device_count() == 8
+
+import pytest  # noqa: E402
+
+
+def pytest_addoption(parser):
+    parser.addoption(
+        "--runslow",
+        action="store_true",
+        default=False,
+        help="also run tests marked slow (the full pre-merge suite)",
+    )
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: multi-second jit-compilation tests; skipped unless --runslow",
+    )
+
+
+def pytest_collection_modifyitems(config, items):
+    if config.getoption("--runslow"):
+        return
+    skip_slow = pytest.mark.skip(reason="slow tier: run with --runslow")
+    for item in items:
+        if "slow" in item.keywords:
+            item.add_marker(skip_slow)

@@ -10,6 +10,7 @@ import sys
 from pathlib import Path
 
 from tritonk8ssupervisor_tpu.benchmarks import containerbench
+import pytest
 
 
 def test_disk_benchmark_counts_bytes(tmp_path):
@@ -40,6 +41,7 @@ def test_cpu_benchmark_hashes_exact_byte_count():
     assert odd["md5"] == hashlib.md5(data).hexdigest()
 
 
+@pytest.mark.slow
 def test_lm_benchmark_sequence_parallel_smoke():
     """Tiny LM benchmark end-to-end on the CPU mesh with the ring path
     (sequence_parallelism=4) — the long-context configuration."""
@@ -71,6 +73,7 @@ def test_containerbench_cli_json(tmp_path):
     assert [r["workload"] for r in records] == ["disk", "cpu"]
 
 
+@pytest.mark.slow
 def test_bench_py_driver_contract():
     """bench.py is the driver's measurement entrypoint: exactly ONE JSON
     line on stdout carrying the four driver-read fields plus the r03

@@ -8,6 +8,7 @@ import pytest
 from tritonk8ssupervisor_tpu.models import ResNet18, ResNet50
 
 
+@pytest.mark.slow
 def test_resnet18_forward_shapes():
     model = ResNet18(num_classes=10)
     x = jnp.ones((2, 64, 64, 3), jnp.float32)

@@ -13,6 +13,7 @@ import subprocess
 import sys
 import textwrap
 from pathlib import Path
+import pytest
 
 REPO = Path(__file__).resolve().parent.parent
 
@@ -185,6 +186,7 @@ def test_two_process_rendezvous(tmp_path):
     assert "OK process 1" in outputs[1]
 
 
+@pytest.mark.slow
 def test_two_process_sharded_train_step():
     """The exact multi-host code path a 2-host v5e-16 slice executes,
     actually executed: a 2-process x 4-device CPU cluster builds the

@@ -213,3 +213,47 @@ def test_conv1x1_pick_tm_divides_and_falls_back():
                                rtol=1e-5, atol=1e-5)
     np.testing.assert_allclose(np.asarray(dw), np.asarray(x2.T @ dy2),
                                rtol=1e-5, atol=1e-5)
+
+
+# --------------------------------------------------- vocab-parallel loss (tp)
+
+
+def test_vocab_parallel_cross_entropy_matches_reference():
+    """ops/cross_entropy.vocab_parallel_cross_entropy under shard_map with
+    the class dim sharded 8 ways must equal the dense reference, values
+    and gradients — the tp loss that replaces gathering class-sharded
+    logits (r03 verdict weak #7)."""
+    import functools
+
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    # the repo's wrapper: psum-produced outputs defeat the static
+    # replication check, so it runs with the check disabled
+    from tritonk8ssupervisor_tpu.parallel.train import shard_map
+    from tritonk8ssupervisor_tpu.ops.cross_entropy import (
+        cross_entropy_loss_reference,
+        vocab_parallel_cross_entropy,
+    )
+
+    mesh = Mesh(jax.devices(), ("m",))
+    batch, classes = 16, 64
+    logits = jax.random.normal(jax.random.key(0), (batch, classes), jnp.float32)
+    labels = jax.random.randint(jax.random.key(1), (batch,), 0, classes)
+
+    fn = shard_map(
+        functools.partial(vocab_parallel_cross_entropy, axis_name="m"),
+        mesh=mesh,
+        in_specs=(P(None, "m"), P(None)),
+        out_specs=(P(None), P(None)),
+    )
+    losses, correct = fn(logits, labels)
+    ref = cross_entropy_loss_reference(logits, labels)
+    np.testing.assert_allclose(np.asarray(losses), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_array_equal(
+        np.asarray(correct), np.asarray(jnp.argmax(logits, -1) == labels))
+
+    g = jax.grad(lambda lo: jnp.mean(fn(lo, labels)[0]))(logits)
+    g_ref = jax.grad(lambda lo: jnp.mean(cross_entropy_loss_reference(lo, labels)))(logits)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref),
+                               rtol=1e-5, atol=1e-6)

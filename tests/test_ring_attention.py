@@ -41,6 +41,7 @@ def test_ring_output_stays_sequence_sharded():
     assert out.sharding.spec == sh.spec
 
 
+@pytest.mark.slow
 def test_ring_attention_differentiable():
     mesh = make_mesh(model_parallelism=4)
     q, k, v = qkv(seq=16)
@@ -94,6 +95,7 @@ def test_causal_fallback_when_blocks_dont_halve():
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_batch_dim_shards_over_data():
     """dp x sp composition (round-2 VERDICT weak #3): the shard_map specs
     must cover the data axis so the global batch is never gathered."""
@@ -150,6 +152,7 @@ def test_causal_zigzag_halves_the_flops():
     assert zigzag < 0.75 * dense, (zigzag, dense)
 
 
+@pytest.mark.slow
 def test_causal_no_longer_pays_the_noncausal_cost():
     """CPU-mesh wall-clock: causal must be measurably cheaper than the
     non-causal ring on a matmul-dominated shape (round-2 VERDICT #2 asked

@@ -51,6 +51,7 @@ def test_causal_masking_holds():
     assert not np.allclose(np.asarray(logits_a[0, 10:]), np.asarray(logits_b[0, 10:]))
 
 
+@pytest.mark.slow
 def test_ring_attention_model_matches_dense_model():
     mesh = make_mesh(model_parallelism=4)
 
@@ -70,6 +71,7 @@ def test_ring_attention_model_matches_dense_model():
     )
 
 
+@pytest.mark.slow
 def test_sequence_parallel_lm_train_step():
     """data x model = 2 x 4 mesh: batch over data, sequence over the ring
     axis; the LM step runs and learns."""

@@ -57,6 +57,7 @@ def to_tfvars(config: ClusterConfig) -> dict:
         "machine_type": config.gke_machine_type,
         "tpu_topology": str(config.parsed_topology),
         "nodes_per_slice": config.hosts_per_slice,
+        "broad_node_scopes": config.broad_node_scopes,
     }
 
 
@@ -252,6 +253,145 @@ def to_package_configmap(root: Path | None = None) -> dict:
     }
 
 
+def _slice_job_name(config: ClusterConfig, name: str, slice_index: int) -> str:
+    """Indexed-Job pod hostnames are {job_name}-{index}; with num_slices
+    > 1 jobs are named {name}-{slice}, so the coordinator address must
+    derive from the per-slice job name — each slice forms its own JAX
+    cluster (the reference joined each node through its own registration
+    URL, rancherhost/tasks/main.yml:19-24)."""
+    return f"{name}-{slice_index}" if config.num_slices > 1 else name
+
+
+def tpu_job_env(config: ClusterConfig, job_name: str, svc: str) -> list[dict]:
+    """The coordinator/topology env wiring every multi-host TPU Job needs
+    (the registrationUrl handoff analogue, rancherhost/tasks/main.yml:19-24):
+    jax.distributed.initialize reads JAX_*; libtpu's multi-host topology
+    discovery reads TPU_WORKER_HOSTNAMES (the full per-pod list — a bare
+    service name was the round-2 bug) and TPU_WORKER_ID. Shared by the
+    benchmark Job and user-supplied (BYO) workload Jobs so both wire the
+    same way."""
+    hosts = config.hosts_per_slice
+    topo = config.parsed_topology
+    index_ref = {
+        "valueFrom": {
+            "fieldRef": {
+                "fieldPath": "metadata.annotations['batch.kubernetes.io/job-completion-index']"
+            }
+        }
+    }
+    return [
+        {"name": "JAX_COORDINATOR_ADDRESS", "value": f"{job_name}-0.{svc}:8476"},
+        {"name": "JAX_NUM_PROCESSES", "value": str(hosts)},
+        {"name": "JAX_PROCESS_ID", **index_ref},
+        {"name": "TPU_TOPOLOGY", "value": str(topo)},
+        {
+            "name": "TPU_WORKER_HOSTNAMES",
+            "value": ",".join(f"{job_name}-{i}.{svc}" for i in range(hosts)),
+        },
+        {"name": "TPU_WORKER_ID", **index_ref},
+    ]
+
+
+def _indexed_tpu_job(
+    config: ClusterConfig,
+    *,
+    name: str,
+    job_name: str,
+    slice_index: int,
+    container: dict,
+    backoff_limit: int,
+    pod_spec_extra: dict | None = None,
+) -> dict:
+    """One Indexed Job spanning every host of a slice: one pod per TPU
+    host (SPMD — no master/worker asymmetry), nodeSelector pinning to
+    the accelerator+topology, google.com/tpu chip accounting via GKE's
+    device plugin."""
+    topo = config.parsed_topology
+    hosts = config.hosts_per_slice
+    return {
+        "apiVersion": "batch/v1",
+        "kind": "Job",
+        "metadata": {
+            "name": job_name,
+            "labels": {"app": name, "slice": str(slice_index)},
+        },
+        "spec": {
+            "completions": hosts,
+            "parallelism": hosts,
+            "completionMode": "Indexed",
+            "backoffLimit": backoff_limit,
+            "template": {
+                "metadata": {"labels": {"app": name}},
+                "spec": {
+                    "subdomain": f"{name}-svc",
+                    "restartPolicy": "Never",
+                    "nodeSelector": {
+                        "cloud.google.com/gke-tpu-accelerator": _gke_accelerator_label(
+                            config.generation
+                        ),
+                        "cloud.google.com/gke-tpu-topology": str(topo),
+                    },
+                    "containers": [container],
+                    **(pod_spec_extra or {}),
+                },
+            },
+        },
+    }
+
+
+def to_user_workload_job(
+    config: ClusterConfig,
+    *,
+    name: str,
+    image: str,
+    command: list[str],
+    slice_index: int = 0,
+    env: dict[str, str] | None = None,
+    backoff_limit: int = 0,
+) -> dict:
+    """A user-supplied (bring-your-own) training/serving container on the
+    provisioned TPU pool — the reference's third-party-app walkthrough
+    (its docs/detailed.md:255-371 deployed Ghost and Guestbook onto the
+    cluster) re-expressed for TPU workloads: your image + command, the
+    framework's slice wiring. The container gets the same coordinator/
+    topology env and chip requests as the benchmark Job, so any JAX
+    program that calls jax.distributed.initialize() (or this package's
+    parallel.initialize_from_env) forms the slice's mesh unchanged.
+
+    `env` adds/overrides plain-value variables (e.g. your HF_TOKEN or
+    config knobs). manifests/byo-workload.example.yaml shows a rendered
+    example; docs/detailed.md §2b is the walkthrough.
+    """
+    spec = config.spec
+    topo = config.parsed_topology
+    job_name = _slice_job_name(config, name, slice_index)
+    svc = f"{name}-svc"
+    env_block = tpu_job_env(config, job_name, svc)
+    for key, value in (env or {}).items():
+        env_block = [e for e in env_block if e["name"] != key]
+        env_block.append({"name": key, "value": value})
+    chips_on_host = spec.chips_on_host(topo)
+    container = {
+        "name": "workload",
+        "image": image,
+        "command": list(command),
+        "resources": {
+            "requests": {"google.com/tpu": str(chips_on_host)},
+            "limits": {"google.com/tpu": str(chips_on_host)},
+        },
+        "env": env_block,
+        "ports": [{"containerPort": 8476}],
+    }
+    return _indexed_tpu_job(
+        config,
+        name=name,
+        job_name=job_name,
+        slice_index=slice_index,
+        container=container,
+        backoff_limit=backoff_limit,
+    )
+
+
 def to_benchmark_job(
     config: ClusterConfig,
     *,
@@ -271,16 +411,9 @@ def to_benchmark_job(
     """
     spec = config.spec
     topo = config.parsed_topology
-    hosts = config.hosts_per_slice
     chips_on_host = spec.chips_on_host(topo)
     svc = f"{name}-svc"
-    # Indexed-Job pod hostnames are {job_name}-{index}; with num_slices > 1
-    # jobs are named {name}-{slice}, so the coordinator address must derive
-    # from the per-slice job name — each slice forms its own JAX cluster
-    # (the reference joined each node through its own registration URL,
-    # rancherhost/tasks/main.yml:19-24; a shared global coordinator would
-    # be both a dangling DNS name and wrong topology).
-    job_name = f"{name}-{slice_index}" if config.num_slices > 1 else name
+    job_name = _slice_job_name(config, name, slice_index)
     # Checkpoints need a home that outlives the pod; a gs:// bucket is the
     # durable choice (orbax writes it natively — the node pool's service
     # account needs storage read/write scope, see docs/benchmarks.md).
@@ -325,39 +458,7 @@ def to_benchmark_job(
             "requests": {"google.com/tpu": str(chips_on_host)},
             "limits": {"google.com/tpu": str(chips_on_host)},
         },
-        "env": [
-            # jax.distributed.initialize() on GKE reads these (the analogue
-            # of the registrationUrl handoff, rancherhost/tasks/main.yml:19-24)
-            {"name": "JAX_COORDINATOR_ADDRESS", "value": f"{job_name}-0.{svc}:8476"},
-            {"name": "JAX_NUM_PROCESSES", "value": str(hosts)},
-            {
-                "name": "JAX_PROCESS_ID",
-                "valueFrom": {
-                    "fieldRef": {
-                        "fieldPath": "metadata.annotations['batch.kubernetes.io/job-completion-index']"
-                    }
-                },
-            },
-            {"name": "TPU_TOPOLOGY", "value": str(topo)},
-            # libtpu's multi-host topology discovery wants the full
-            # comma-separated worker list, one entry per pod, resolvable
-            # in-cluster: Indexed-Job pods are {job}-{index} under the
-            # headless Service's subdomain. A bare service name here (the
-            # round-2 bug) is not a list and breaks worker enumeration on
-            # multi-host slices.
-            {
-                "name": "TPU_WORKER_HOSTNAMES",
-                "value": ",".join(f"{job_name}-{i}.{svc}" for i in range(hosts)),
-            },
-            {
-                "name": "TPU_WORKER_ID",
-                "valueFrom": {
-                    "fieldRef": {
-                        "fieldPath": "metadata.annotations['batch.kubernetes.io/job-completion-index']"
-                    }
-                },
-            },
-        ],
+        "env": tpu_job_env(config, job_name, svc),
         "ports": [{"containerPort": 8476}],
     }
     pod_spec_extra = {}
@@ -371,43 +472,23 @@ def to_benchmark_job(
                 "configMap": {"name": PACKAGE_CONFIGMAP_NAME},
             }
         ]
-    return {
-        "apiVersion": "batch/v1",
-        "kind": "Job",
-        "metadata": {
-            "name": job_name,
-            "labels": {"app": name, "slice": str(slice_index)},
-        },
-        "spec": {
-            "completions": hosts,
-            "parallelism": hosts,
-            "completionMode": "Indexed",
-            # Failure recovery (SURVEY.md §5; the reference's node-join
-            # converged on re-run, rancherhost/tasks/main.yml:2-9): one
-            # lost pod kills the slice's whole JAX cluster — every
-            # sibling crashes on the broken collective — so a single
-            # recovery costs ~`hosts` pod failures. With a checkpoint
-            # dir, budget 3 gang restarts (each retry self-resumes from
-            # the latest per-window save); without one a retry would
-            # replay the whole run from step 0, so keep fail-fast.
-            "backoffLimit": 3 * hosts if checkpoint_dir else 0,
-            "template": {
-                "metadata": {"labels": {"app": name}},
-                "spec": {
-                    "subdomain": svc,
-                    "restartPolicy": "Never",
-                    "nodeSelector": {
-                        "cloud.google.com/gke-tpu-accelerator": _gke_accelerator_label(
-                            config.generation
-                        ),
-                        "cloud.google.com/gke-tpu-topology": str(topo),
-                    },
-                    "containers": [container],
-                    **pod_spec_extra,
-                },
-            },
-        },
-    }
+    # Failure recovery (SURVEY.md §5; the reference's node-join converged
+    # on re-run, rancherhost/tasks/main.yml:2-9): one lost pod kills the
+    # slice's whole JAX cluster — every sibling crashes on the broken
+    # collective — so a single recovery costs ~`hosts` pod failures.
+    # With a checkpoint dir, budget 3 gang restarts (each retry
+    # self-resumes from the latest per-window save); without one a retry
+    # would replay the whole run from step 0, so keep fail-fast.
+    hosts = config.hosts_per_slice
+    return _indexed_tpu_job(
+        config,
+        name=name,
+        job_name=job_name,
+        slice_index=slice_index,
+        container=container,
+        backoff_limit=3 * hosts if checkpoint_dir else 0,
+        pod_spec_extra=pod_spec_extra,
+    )
 
 
 # THE host jax pin. The tpuhost role defaults

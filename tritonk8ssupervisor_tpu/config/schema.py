@@ -62,6 +62,12 @@ class ClusterConfig:
     # Host software (reference pinned docker-engine 1.12.6; we pin the TPU VM
     # runtime image instead — dockersetup/tasks/main.yml:42-46 analogue)
     runtime_version: str = ""  # "" -> generation default from the catalog
+    # GKE node identity: default is Workload Identity + minimal node
+    # scopes (logging/monitoring/image-pull). True restores the broad
+    # cloud-platform node scope — the 2017-era everything-identity the
+    # reference's VMs effectively ran with — as an explicit opt-in for
+    # clusters that can't use WI bindings yet.
+    broad_node_scopes: bool = False
 
     @property
     def region(self) -> str:
@@ -148,6 +154,7 @@ class ClusterConfig:
     # ---- flat KEY=value round-trip (store.py uses these) ----
 
     _INT_FIELDS = ("num_slices",)
+    _BOOL_FIELDS = ("broad_node_scopes",)
 
     def to_flat(self) -> dict[str, str]:
         return {
@@ -162,5 +169,10 @@ class ClusterConfig:
         for key, value in flat.items():
             name = key.lower()
             if name in known:
-                kwargs[name] = int(value) if name in cls._INT_FIELDS else value
+                if name in cls._INT_FIELDS:
+                    kwargs[name] = int(value)
+                elif name in cls._BOOL_FIELDS:
+                    kwargs[name] = value.strip().lower() in ("true", "1", "yes")
+                else:
+                    kwargs[name] = value
         return cls(**kwargs)

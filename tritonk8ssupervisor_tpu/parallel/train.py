@@ -24,6 +24,7 @@ from tritonk8ssupervisor_tpu.ops.cross_entropy import (
     cross_entropy_loss,
     cross_entropy_loss_reference,
     is_pallas_loss,
+    vocab_parallel_cross_entropy,
 )
 from tritonk8ssupervisor_tpu.parallel import mesh as mesh_lib
 
@@ -146,9 +147,44 @@ def make_train_step(
     slower than per-step dispatch (the async queue already pipelines), so
     the benchmark defaults to 1.
     """
+    data = mesh_lib.DATA_AXIS
+    model_ax = mesh_lib.MODEL_AXIS
+    tp = mesh.shape.get(model_ax, 1) > 1
+    if tp and loss_fn is not None:
+        raise ValueError(
+            "make_train_step: custom loss_fn is incompatible with "
+            "model_parallelism > 1 — the tp path computes the loss "
+            "vocab-parallel over class-sharded logits "
+            "(ops/cross_entropy.vocab_parallel_cross_entropy); a custom "
+            "loss would need the gathered logits that path exists to avoid"
+        )
     if loss_fn is None:
         loss_fn = _default_loss_fn()
-    loss_fn = _shard_loss_over_data(loss_fn, mesh)
+    if tp:
+        # With model parallelism the classifier's class dim is sharded
+        # over "model"; any loss that needs an example's every class
+        # would all-gather the (batch, classes) logits at the widest
+        # layer (r03 verdict weak #7). The vocab-parallel loss keeps the
+        # logits sharded: each device folds its class shard, psums
+        # finish the softmax (ops/cross_entropy.py).
+        import functools
+
+        loss_and_correct = shard_map(
+            functools.partial(
+                vocab_parallel_cross_entropy, axis_name=model_ax
+            ),
+            mesh=mesh,
+            in_specs=(P(data, model_ax), P(data)),
+            out_specs=(P(data), P(data)),
+        )
+    else:
+        loss_fn = _shard_loss_over_data(loss_fn, mesh)
+
+        def loss_and_correct(logits, labels):
+            return (
+                loss_fn(logits, labels),
+                jnp.argmax(logits, axis=-1) == labels,
+            )
 
     def compute_loss(params, batch_stats, images, labels):
         logits, updates = model.apply(
@@ -157,17 +193,17 @@ def make_train_step(
             train=True,
             mutable=["batch_stats"],
         )
-        loss = jnp.mean(loss_fn(logits, labels))
-        return loss, (updates["batch_stats"], logits)
+        losses, correct = loss_and_correct(logits, labels)
+        return jnp.mean(losses), (updates["batch_stats"], correct)
 
     def step(state: TrainState, images, labels):
         grad_fn = jax.value_and_grad(compute_loss, has_aux=True)
-        (loss, (new_stats, logits)), grads = grad_fn(
+        (loss, (new_stats, correct)), grads = grad_fn(
             state.params, state.batch_stats, images, labels
         )
         updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
-        accuracy = jnp.mean(jnp.argmax(logits, axis=-1) == labels)
+        accuracy = jnp.mean(correct)
         new_state = TrainState(
             step=state.step + 1,
             params=new_params,
