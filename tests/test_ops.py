@@ -257,3 +257,86 @@ def test_vocab_parallel_cross_entropy_matches_reference():
     g_ref = jax.grad(lambda lo: jnp.mean(cross_entropy_loss_reference(lo, labels)))(logits)
     np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref),
                                rtol=1e-5, atol=1e-6)
+
+
+def test_pair_kernel_matches_reference_with_grads():
+    """cross_entropy_loss_and_correct: one kernel pass yields losses AND
+    argmax-correctness (r04 — kills the separate full-logits argmax in
+    the train steps); values, flags, and gradients match the reference."""
+    from tritonk8ssupervisor_tpu.ops.cross_entropy import (
+        cross_entropy_loss_and_correct,
+        cross_entropy_loss_and_correct_reference,
+    )
+
+    k1, k2 = jax.random.split(jax.random.key(7))
+    logits = jax.random.normal(k1, (33, 200), jnp.float32) * 4
+    labels = jax.random.randint(k2, (33,), 0, 200)
+    losses, correct = cross_entropy_loss_and_correct(logits, labels, True)
+    ref_losses, ref_correct = cross_entropy_loss_and_correct_reference(
+        logits, labels
+    )
+    np.testing.assert_allclose(np.asarray(losses), np.asarray(ref_losses),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_array_equal(np.asarray(correct), np.asarray(ref_correct))
+    assert correct.dtype == jnp.bool_
+
+    g = jax.grad(
+        lambda lo: jnp.mean(cross_entropy_loss_and_correct(lo, labels, True)[0])
+    )(logits)
+    g_ref = jax.grad(
+        lambda lo: jnp.mean(cross_entropy_loss_reference(lo, labels))
+    )(logits)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref),
+                               rtol=1e-5, atol=1e-6)
+    # bf16 logits (the LM head's default since r04) stay supported
+    blosses, bcorrect = cross_entropy_loss_and_correct(
+        logits.astype(jnp.bfloat16), labels, True
+    )
+    np.testing.assert_allclose(np.asarray(blosses), np.asarray(ref_losses),
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_splash_block_selection():
+    """ops/flash_attention._splash_block: blocks must be 128-multiples
+    that divide the sequence; unservable lengths return None so the
+    caller falls back instead of crashing inside the kernel."""
+    from tritonk8ssupervisor_tpu.ops.flash_attention import _splash_block
+
+    assert _splash_block(1024) == 512
+    assert _splash_block(4096) == 512
+    assert _splash_block(640) == 128   # 128-multiple, but 512 doesn't divide
+    assert _splash_block(384) == 384
+    assert _splash_block(128) == 128
+    assert _splash_block(320) is None  # not a 128-multiple
+    assert _splash_block(64) is None
+
+
+def test_pair_kernel_invalid_labels_read_incorrect():
+    """Out-of-range labels (ignore-index conventions) must read
+    correct=False from BOTH fused kernels, matching argmax==label."""
+    import functools
+
+    from tritonk8ssupervisor_tpu.ops.cross_entropy import (
+        cross_entropy_loss_and_correct,
+        vocab_parallel_cross_entropy,
+    )
+    from tritonk8ssupervisor_tpu.parallel.train import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    logits = jax.random.normal(jax.random.key(0), (8, 64), jnp.float32)
+    labels = jnp.array([0, 5, -1, 63, 64, 1000, 2, -7])
+    _, correct = cross_entropy_loss_and_correct(logits, labels, True)
+    expected = np.asarray((jnp.argmax(logits, -1) == labels)
+                          & (labels >= 0) & (labels < 64))
+    invalid = np.asarray((labels < 0) | (labels >= 64))
+    assert not np.asarray(correct)[invalid].any()
+    np.testing.assert_array_equal(np.asarray(correct), expected)
+
+    mesh = Mesh(jax.devices(), ("m",))
+    fn = shard_map(
+        functools.partial(vocab_parallel_cross_entropy, axis_name="m"),
+        mesh=mesh, in_specs=(P(None, "m"), P(None)),
+        out_specs=(P(None), P(None)),
+    )
+    _, vp_correct = fn(logits, labels)
+    assert not np.asarray(vp_correct)[invalid].any()

@@ -194,7 +194,23 @@ def test_tensor_parallel_step_runs():
     assert jnp.isfinite(metrics["loss"])
     assert 0.0 <= float(metrics["accuracy"]) <= 1.0
 
-    # and the tp metrics agree with an unsharded reference step
+
+@pytest.mark.slow
+def test_tensor_parallel_metrics_match_single_device():
+    """The vocab-parallel tp loss must produce the same loss/accuracy as
+    an unsharded single-device step (slow tier: two full compiles)."""
+    mesh = make_mesh(model_parallelism=2)
+    model = ResNet18(num_classes=128, num_filters=32)
+    tx = train_lib.default_optimizer()
+    sample = jax.ShapeDtypeStruct((8, 32, 32, 3), jnp.float32)
+    state, shardings = train_lib.create_train_state(
+        model, jax.random.key(0), sample, mesh, tx
+    )
+    step = train_lib.make_train_step(model, tx, mesh, shardings)
+    images = jax.random.normal(jax.random.key(1), (8, 32, 32, 3))
+    labels = jax.random.randint(jax.random.key(2), (8,), 0, 128)
+    _, metrics = step(state, images, labels)
+
     mesh1 = make_mesh(devices=jax.devices()[:1])
     state1, sh1 = train_lib.create_train_state(
         model, jax.random.key(0), sample, mesh1, tx
@@ -308,3 +324,55 @@ def test_lm_train_step_with_pallas_interpret_loss_matches_reference():
         np.testing.assert_allclose(
             np.asarray(lk), np.asarray(lr), rtol=1e-4, atol=1e-5
         )
+
+
+def test_custom_loss_rejected_on_tp_mesh():
+    """Custom loss/metrics functions can't ride the vocab-parallel tp
+    path (it exists to avoid the gathered logits a custom loss would
+    need) — explicit error, not silent substitution."""
+    mesh = make_mesh(model_parallelism=2)
+    model = ResNet18(num_classes=128, num_filters=8)
+    tx = train_lib.default_optimizer()
+    # the guard fires before shardings are touched: no state init needed
+    shardings = None
+    from tritonk8ssupervisor_tpu.ops.cross_entropy import (
+        cross_entropy_loss_and_correct_interpret,
+        cross_entropy_loss_interpret,
+    )
+
+    with pytest.raises(ValueError, match="vocab-parallel"):
+        train_lib.make_train_step(
+            model, tx, mesh, shardings, loss_fn=cross_entropy_loss_interpret
+        )
+    with pytest.raises(ValueError, match="vocab-parallel"):
+        train_lib.make_train_step(
+            model, tx, mesh, shardings,
+            metrics_fn=cross_entropy_loss_and_correct_interpret,
+        )
+    with pytest.raises(ValueError, match="not both"):
+        train_lib.make_train_step(
+            model, tx, make_mesh(), shardings,
+            loss_fn=cross_entropy_loss_interpret,
+            metrics_fn=cross_entropy_loss_and_correct_interpret,
+        )
+
+
+@pytest.mark.slow
+def test_tp_mesh_with_nondivisible_classes_falls_back():
+    """num_classes the model axis doesn't divide never got class-sharded
+    (param_shardings replicates those kernels), so the tp step must take
+    the ordinary data-sharded loss path instead of crashing in the
+    vocab-parallel shard_map."""
+    mesh = make_mesh(model_parallelism=2)
+    # 11 classes: nothing 2-way-shardable about the classifier
+    model = ResNet18(num_classes=11, num_filters=32)
+    tx = train_lib.default_optimizer()
+    sample = jax.ShapeDtypeStruct((8, 32, 32, 3), jnp.float32)
+    state, shardings = train_lib.create_train_state(
+        model, jax.random.key(0), sample, mesh, tx
+    )
+    step = train_lib.make_train_step(model, tx, mesh, shardings)
+    images = jax.random.normal(jax.random.key(1), (8, 32, 32, 3))
+    labels = jax.random.randint(jax.random.key(2), (8,), 0, 11)
+    state, metrics = step(state, images, labels)
+    assert jnp.isfinite(metrics["loss"])

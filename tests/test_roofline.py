@@ -123,3 +123,87 @@ def test_missing_trace_raises(tmp_path):
         assert "trace.json.gz" in str(e)
     else:
         raise AssertionError("expected FileNotFoundError")
+
+
+# ----------------------------------------------------------- bench comparison
+
+
+def test_benchcompare_renders_old_and_new_records(tmp_path):
+    """utils/benchcompare handles r01-r03 single-record files, r04+
+    two-family arrays, and failure stubs, in one table (the reference's
+    side-by-side benchmark doc, driver-era)."""
+    import json as json_mod
+
+    from tritonk8ssupervisor_tpu.utils import benchcompare
+
+    old = tmp_path / "BENCH_r03.json"
+    old.write_text(json_mod.dumps({
+        "metric": "resnet50_images_per_sec_per_chip", "value": 2586.64,
+        "unit": "images/sec/chip", "vs_baseline": 2.5866,
+        "step_ms": 98.97, "mfu": 0.3158,
+    }) + "\n")
+    new = tmp_path / "BENCH_r04.json"
+    new.write_text(json_mod.dumps({
+        "metric": "resnet50_images_per_sec_per_chip", "value": 2584.0,
+        "unit": "images/sec/chip", "vs_baseline": 2.584,
+        "benchmarks": [
+            {"metric": "resnet50_images_per_sec_per_chip", "value": 2584.0,
+             "unit": "images/sec/chip", "vs_baseline": 2.584,
+             "step_ms": 99.07, "mfu": 0.3154},
+            {"metric": "transformer_lm_tokens_per_sec_per_chip",
+             "value": 122668.0, "unit": "tokens/sec/chip",
+             "vs_baseline": 1.2475, "step_ms": 66.78, "mfu": 0.4136},
+        ],
+    }) + "\n")
+    failed = tmp_path / "BENCH_err.json"
+    failed.write_text(json_mod.dumps({
+        "metric": "m", "value": 1, "unit": "u", "vs_baseline": 1,
+        "benchmarks": [
+            {"metric": "m", "value": 1, "unit": "u", "vs_baseline": 1},
+            {"metric": "transformer_lm_tokens_per_sec_per_chip",
+             "error": "OOM"},
+        ],
+    }) + "\n")
+
+    # the driver's envelope shape (BENCH_r{N}.json as written on disk)
+    wrapped = tmp_path / "BENCH_wrapped.json"
+    wrapped.write_text(json_mod.dumps({
+        "n": 3, "cmd": "python bench.py", "rc": 0,
+        "tail": "WARNING: noise\n" + json_mod.dumps(
+            {"metric": "wrapped_metric", "value": 7.0, "unit": "u",
+             "vs_baseline": 1.0}) + "\n",
+        "parsed": {"metric": "wrapped_metric", "value": 7.0, "unit": "u",
+                   "vs_baseline": 1.0},
+    }) + "\n")
+    assert benchcompare.load_records(wrapped)[0]["metric"] == "wrapped_metric"
+
+    rows = benchcompare.comparison_rows([old, new, failed])
+    assert [r["metric"] for r in rows] == [
+        "resnet50_images_per_sec_per_chip",
+        "resnet50_images_per_sec_per_chip",
+        "transformer_lm_tokens_per_sec_per_chip",
+        "m",
+        "transformer_lm_tokens_per_sec_per_chip",
+    ]
+    table = benchcompare.to_markdown(rows)
+    assert "122,668.00" in table
+    assert "41.4%" in table
+    assert "FAILED: OOM" in table
+    assert table.count("|----") <= 1  # one header rule
+
+
+def test_benchcompare_cli(tmp_path):
+    import json as json_mod
+
+    f = tmp_path / "b.json"
+    f.write_text(json_mod.dumps({
+        "metric": "x", "value": 2.0, "unit": "u", "vs_baseline": 1.0,
+    }) + "\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "tritonk8ssupervisor_tpu.utils.benchcompare",
+         str(f)],
+        capture_output=True, text=True, timeout=120,
+        cwd=Path(__file__).resolve().parent.parent,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "| b.json | x | 2.00 | u |" in proc.stdout

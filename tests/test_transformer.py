@@ -31,8 +31,15 @@ def test_forward_shapes_and_dtypes():
     variables = model.init(jax.random.key(0), tokens, train=False)
     logits = model.apply(variables, tokens, train=False)
     assert logits.shape == (2, 16, 128)
-    assert logits.dtype == jnp.float32
+    # bf16 logits by default since r04 (the biggest array in the LM
+    # program; the loss kernel upcasts per block), f32 by request
+    assert logits.dtype == jnp.bfloat16
     assert "batch_stats" not in variables  # no BN anywhere
+    f32_head = TransformerLM(
+        vocab_size=128, num_layers=2, num_heads=4, embed_dim=64,
+        max_seq_len=64, logits_dtype=jnp.float32,
+    )
+    assert f32_head.apply(variables, tokens, train=False).dtype == jnp.float32
 
 
 def test_causal_masking_holds():

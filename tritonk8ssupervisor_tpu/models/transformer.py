@@ -7,8 +7,10 @@ shards across the mesh — per-device activation memory scales as O(S/n)
 while the math stays exact.
 
 TPU layout notes: embeddings and MLP widths stay multiples of 128 (lane
-width) so XLA tiles them onto the MXU; compute in bf16, params in f32,
-logits in f32 for the softmax (same recipe as models/resnet.py).
+width) so XLA tiles them onto the MXU; compute in bf16, params in f32.
+Logits default to bf16 since r04 (the loss kernel does f32 math per
+block; see the lm_head comment) — `logits_dtype=float32` restores the
+f32 head.
 """
 
 from __future__ import annotations
@@ -68,6 +70,9 @@ class TransformerLM(nn.Module):
     max_seq_len: int = 2048
     attention_fn: AttentionFn = dense_attention
     dtype: Any = jnp.bfloat16
+    # dtype of the returned logits; see the lm_head comment below for
+    # why bf16 is the default (float32 restores the r03 head)
+    logits_dtype: Any = jnp.bfloat16
 
     @nn.compact
     def __call__(self, tokens, train: bool = True):
@@ -94,8 +99,15 @@ class TransformerLM(nn.Module):
                 dtype=self.dtype,
             )(x)
         x = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32)(x)
+        # bf16 logits: at LM vocab the logits are the program's biggest
+        # array ((batch*seq, 32k) = 0.5 GB at the benchmark shape), and
+        # every consumer re-reads it — loss kernel, its backward, the
+        # head's wgrad. r04 roofline: those passes run at HBM peak, so
+        # f32 logits cost ~3 ms/step of pure bandwidth. The loss kernel
+        # upcasts per block (f32 math inside), so only the stored array
+        # is rounded; set logits_dtype=float32 to keep the old head.
         logits = nn.Dense(
-            self.vocab_size, dtype=jnp.float32, param_dtype=jnp.float32,
+            self.vocab_size, dtype=self.logits_dtype, param_dtype=jnp.float32,
             name="lm_head",
         )(x)
         return logits

@@ -53,7 +53,7 @@ def cross_entropy_loss_reference(logits: jax.Array, labels: jax.Array) -> jax.Ar
     return -jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
 
 
-def _ce_kernel(logits_ref, labels_ref, out_ref, *, num_classes: int):
+def _ce_kernel(logits_ref, labels_ref, out_ref, correct_ref, *, num_classes: int):
     logits = logits_ref[...].astype(jnp.float32)  # (block_b, padded_c)
     labels = labels_ref[...]                      # (block_b, 1) int32
     col = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
@@ -65,6 +65,35 @@ def _ce_kernel(logits_ref, labels_ref, out_ref, *, num_classes: int):
     lse = jnp.log(jnp.sum(jnp.exp(shifted), axis=-1, keepdims=True))
     picked = jnp.sum(jnp.where(col == labels, shifted, 0.0), axis=-1, keepdims=True)
     out_ref[...] = lse - picked
+    # argmax == label (up to ties) for free: after the shift the row max
+    # is exactly 0, so the label is the argmax iff its shifted logit is
+    # 0 — no separate full-logits argmax pass for the accuracy metric
+    # (measured 1.4 ms/step over a 32k vocab at LM batch, r04 roofline).
+    # An out-of-range label (ignore-index conventions) matches no column
+    # — picked stays 0 — and must read incorrect, as argmax== would.
+    label_valid = (labels >= 0) & (labels < num_classes)
+    correct_ref[...] = ((picked >= 0.0) & label_valid).astype(jnp.float32)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def cross_entropy_loss_and_correct(
+    logits: jax.Array, labels: jax.Array, interpret: bool = False
+) -> tuple[jax.Array, jax.Array]:
+    """Per-example softmax cross-entropy AND argmax-correctness, fused on
+    TPU — one pass over the logits serves both the loss and the accuracy
+    metric (a separate argmax re-reads the full (batch, vocab) array;
+    measured 1.4 ms/step at LM scale).
+
+    Args:
+      logits: (batch, classes) float array (any float dtype; f32 math inside).
+      labels: (batch,) int class ids.
+      interpret: run the pallas kernel in interpreter mode (CPU tests).
+
+    Returns ((batch,) float32 losses, (batch,) bool correct) where
+    correct means the label's logit equals the row max (argmax == label
+    up to ties).
+    """
+    return _forward(logits, labels, interpret)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
@@ -80,7 +109,7 @@ def cross_entropy_loss(
 
     Returns (batch,) float32 losses.
     """
-    return _forward(logits, labels, interpret)
+    return _forward(logits, labels, interpret)[0]
 
 
 def _forward(logits, labels, interpret):
@@ -97,18 +126,24 @@ def _forward(logits, labels, interpret):
         labels = jnp.pad(labels, ((0, batch_pad),))
     if padded_c != num_classes:
         logits = jnp.pad(logits, ((0, 0), (0, padded_c - num_classes)))
-    out = pl.pallas_call(
+    out, correct = pl.pallas_call(
         functools.partial(_ce_kernel, num_classes=num_classes),
         grid=((batch + batch_pad) // block_b,),
         in_specs=[
             pl.BlockSpec((block_b, padded_c), lambda i: (i, 0)),
             pl.BlockSpec((block_b, 1), lambda i: (i, 0)),
         ],
-        out_specs=pl.BlockSpec((block_b, 1), lambda i: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct((batch + batch_pad, 1), jnp.float32),
+        out_specs=[
+            pl.BlockSpec((block_b, 1), lambda i: (i, 0)),
+            pl.BlockSpec((block_b, 1), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((batch + batch_pad, 1), jnp.float32),
+            jax.ShapeDtypeStruct((batch + batch_pad, 1), jnp.float32),
+        ],
         interpret=interpret,
     )(logits, labels.astype(jnp.int32)[:, None])
-    return out[:batch, 0]
+    return out[:batch, 0], correct[:batch, 0] > 0.5
 
 
 def cross_entropy_loss_interpret(logits: jax.Array, labels: jax.Array) -> jax.Array:
@@ -118,10 +153,37 @@ def cross_entropy_loss_interpret(logits: jax.Array, labels: jax.Array) -> jax.Ar
     return cross_entropy_loss(logits, labels, True)
 
 
+def cross_entropy_loss_and_correct_interpret(
+    logits: jax.Array, labels: jax.Array
+) -> tuple[jax.Array, jax.Array]:
+    """The pair kernel in interpreter mode (CPU tests / driver dryrun),
+    mirroring cross_entropy_loss_interpret."""
+    return cross_entropy_loss_and_correct(logits, labels, True)
+
+
+def cross_entropy_loss_and_correct_reference(
+    logits: jax.Array, labels: jax.Array
+) -> tuple[jax.Array, jax.Array]:
+    """Pure-XLA (losses, correct); ground truth for the pair kernel and
+    the off-TPU implementation of the train steps' metric path."""
+    return (
+        cross_entropy_loss_reference(logits, labels),
+        jnp.argmax(logits, axis=-1) == labels,
+    )
+
+
 def is_pallas_loss(fn) -> bool:
-    """True for either flavour of the fused kernel; the train-step
+    """True for any flavour of the fused kernel; the train-step
     factories must shard_map these (pallas has no SPMD partitioning rule)."""
-    return fn in (cross_entropy_loss, cross_entropy_loss_interpret)
+    return fn in (
+        cross_entropy_loss,
+        cross_entropy_loss_interpret,
+        cross_entropy_loss_and_correct,
+        cross_entropy_loss_and_correct_interpret,
+    ) or (
+        isinstance(fn, functools.partial)
+        and fn.func is cross_entropy_loss_and_correct
+    )
 
 
 def vocab_parallel_cross_entropy(
@@ -164,20 +226,39 @@ def vocab_parallel_cross_entropy(
     )[:, 0]
     picked = jax.lax.psum(jnp.where(mine, picked_here, 0.0), axis_name)
     losses = lse - picked
-    correct = picked >= global_max
+    # out-of-range labels (ignore-index conventions) belong to no shard:
+    # any_mine is False everywhere and correct must read False, matching
+    # what argmax== would say
+    any_mine = jax.lax.psum(mine.astype(jnp.int32), axis_name) > 0
+    correct = (picked >= global_max) & any_mine
     return losses, correct
 
 
-def _forward_fwd(logits, labels, interpret):
-    return _forward(logits, labels, interpret), (logits, labels)
-
-
-def _forward_bwd(interpret, residuals, g):
+def _dlogits(residuals, g):
     logits, labels = residuals
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
     onehot = jax.nn.one_hot(labels, logits.shape[-1], dtype=jnp.float32)
-    dlogits = (probs - onehot) * g[:, None]
-    return dlogits.astype(logits.dtype), None
+    return ((probs - onehot) * g[:, None]).astype(logits.dtype)
+
+
+def _forward_fwd(logits, labels, interpret):
+    return _forward(logits, labels, interpret)[0], (logits, labels)
+
+
+def _forward_bwd(interpret, residuals, g):
+    return _dlogits(residuals, g), None
 
 
 cross_entropy_loss.defvjp(_forward_fwd, _forward_bwd)
+
+
+def _forward_pair_fwd(logits, labels, interpret):
+    return _forward(logits, labels, interpret), (logits, labels)
+
+
+def _forward_pair_bwd(interpret, residuals, cts):
+    g, _ = cts  # the bool `correct` output carries a zero cotangent
+    return _dlogits(residuals, g), None
+
+
+cross_entropy_loss_and_correct.defvjp(_forward_pair_fwd, _forward_pair_bwd)

@@ -47,6 +47,17 @@ from tritonk8ssupervisor_tpu.ops.ring_attention import attention_reference
 _BLOCK = 512
 
 
+def _splash_block(seq: int) -> int | None:
+    """The splash block for this sequence length, or None when the
+    kernel can't serve it: blocks must be 128-lane multiples AND divide
+    the sequence, so the pick is the largest 128-multiple divisor of
+    seq up to the tuned 512 (e.g. seq 640 -> 128; seq 320, not a
+    128-multiple, -> None and the caller falls back)."""
+    if seq < 128 or seq % 128:
+        return None
+    return next(b for b in (_BLOCK, 384, 256, 128) if seq % b == 0)
+
+
 @functools.lru_cache(maxsize=32)
 def _splash_kernel(seq: int, num_heads: int, causal: bool, block: int):
     """Mask-partitioned splash kernel, cached per (seq, heads, causal,
@@ -120,8 +131,8 @@ def flash_attention(q, k, v, causal: bool = True):
     if jax.default_backend() != "tpu":
         return attention_reference(q, k, v, causal=causal)
     b, s, h, d = q.shape
-    block = min(_BLOCK, s)
-    if s % block == 0 and s >= 128:
+    block = _splash_block(s)
+    if block is not None:
         kernel = _splash_kernel(s, h, causal, block)
         # model convention (b, s, h, d) -> splash convention (b, h, s, d);
         # splash applies no sm_scale, so fold it into q

@@ -21,8 +21,8 @@ import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from tritonk8ssupervisor_tpu.ops.cross_entropy import (
-    cross_entropy_loss,
-    cross_entropy_loss_reference,
+    cross_entropy_loss_and_correct,
+    cross_entropy_loss_and_correct_reference,
     is_pallas_loss,
     vocab_parallel_cross_entropy,
 )
@@ -60,13 +60,16 @@ class TrainState:
     opt_state: Any
 
 
-def _default_loss_fn() -> Callable:
-    """One policy for both step factories: pallas fused loss on TPU,
+def _default_metrics_fn() -> Callable:
+    """(logits, labels) -> (losses, correct) policy for both step
+    factories: the fused pair kernel on TPU — one pass over the logits
+    serves the loss AND the accuracy flag, where a separate argmax
+    re-reads the full array (1.4 ms/step at LM vocab, r04 roofline) —
     pure-XLA reference elsewhere."""
     return (
-        cross_entropy_loss
+        cross_entropy_loss_and_correct
         if jax.default_backend() == "tpu"
-        else cross_entropy_loss_reference
+        else cross_entropy_loss_and_correct_reference
     )
 
 
@@ -85,6 +88,19 @@ def _shard_loss_over_data(loss_fn: Callable, mesh) -> Callable:
         mesh=mesh,
         in_specs=(P(data, None), P(data)),
         out_specs=P(data),
+    )
+
+
+def _shard_metrics_over_data(metrics_fn: Callable, mesh) -> Callable:
+    """_shard_loss_over_data for the (losses, correct) pair."""
+    if mesh.shape[mesh_lib.DATA_AXIS] == 1 or not is_pallas_loss(metrics_fn):
+        return metrics_fn
+    data = mesh_lib.DATA_AXIS
+    return shard_map(
+        metrics_fn,
+        mesh=mesh,
+        in_specs=(P(data, None), P(data)),
+        out_specs=(P(data), P(data)),
     )
 
 
@@ -134,11 +150,20 @@ def make_train_step(
     state_shardings,
     loss_fn: Callable | None = None,
     steps_per_call: int = 1,
+    metrics_fn: Callable | None = None,
 ):
     """Build the jitted train step: (state, images, labels) -> (state, metrics).
 
     images/labels arrive sharded over "data"; state stays in its shardings
     (donated, so parameters update in place in HBM).
+
+    The loss/accuracy path is chosen by mesh and arguments: with model
+    parallelism the vocab-parallel loss keeps class-sharded logits
+    sharded (no custom loss possible there); otherwise `metrics_fn`
+    ((logits, labels) -> (losses, correct); default: the fused pair
+    kernel on TPU) computes both metrics in one pass, and a plain
+    `loss_fn` (losses only; accuracy falls back to a separate argmax)
+    remains accepted for custom losses.
 
     steps_per_call > 1 chains that many optimizer steps inside one jitted
     call via lax.scan (metrics from the last step are returned), trading
@@ -150,26 +175,30 @@ def make_train_step(
     data = mesh_lib.DATA_AXIS
     model_ax = mesh_lib.MODEL_AXIS
     tp = mesh.shape.get(model_ax, 1) > 1
-    if tp and loss_fn is not None:
+    if loss_fn is not None and metrics_fn is not None:
+        raise ValueError("pass loss_fn or metrics_fn, not both")
+    if tp and (loss_fn is not None or metrics_fn is not None):
         raise ValueError(
-            "make_train_step: custom loss_fn is incompatible with "
-            "model_parallelism > 1 — the tp path computes the loss "
-            "vocab-parallel over class-sharded logits "
+            "make_train_step: custom loss/metrics functions are "
+            "incompatible with model_parallelism > 1 — the tp path "
+            "computes the loss vocab-parallel over class-sharded logits "
             "(ops/cross_entropy.vocab_parallel_cross_entropy); a custom "
             "loss would need the gathered logits that path exists to avoid"
         )
-    if loss_fn is None:
-        loss_fn = _default_loss_fn()
     if tp:
         # With model parallelism the classifier's class dim is sharded
         # over "model"; any loss that needs an example's every class
         # would all-gather the (batch, classes) logits at the widest
         # layer (r03 verdict weak #7). The vocab-parallel loss keeps the
         # logits sharded: each device folds its class shard, psums
-        # finish the softmax (ops/cross_entropy.py).
+        # finish the softmax (ops/cross_entropy.py). A class count the
+        # model axis doesn't divide never got sharded in the first place
+        # (mesh.param_shardings replicates non-divisible kernels), so it
+        # takes the ordinary data-sharded path — there are no sharded
+        # logits to gather.
         import functools
 
-        loss_and_correct = shard_map(
+        vp = shard_map(
             functools.partial(
                 vocab_parallel_cross_entropy, axis_name=model_ax
             ),
@@ -177,7 +206,15 @@ def make_train_step(
             in_specs=(P(data, model_ax), P(data)),
             out_specs=(P(data), P(data)),
         )
-    else:
+        dp_metrics = _shard_metrics_over_data(_default_metrics_fn(), mesh)
+        tp_size = mesh.shape[model_ax]
+
+        def loss_and_correct(logits, labels):
+            if logits.shape[-1] % tp_size == 0:
+                return vp(logits, labels)
+            return dp_metrics(logits, labels)
+    elif loss_fn is not None:
+        # custom loss: correctness needs its own pass over the logits
         loss_fn = _shard_loss_over_data(loss_fn, mesh)
 
         def loss_and_correct(logits, labels):
@@ -185,6 +222,10 @@ def make_train_step(
                 loss_fn(logits, labels),
                 jnp.argmax(logits, axis=-1) == labels,
             )
+    else:
+        loss_and_correct = _shard_metrics_over_data(
+            metrics_fn or _default_metrics_fn(), mesh
+        )
 
     def compute_loss(params, batch_stats, images, labels):
         logits, updates = model.apply(
@@ -248,6 +289,7 @@ def make_lm_train_step(
     state_shardings,
     seq_axis: str | None = None,
     loss_fn: Callable | None = None,
+    metrics_fn: Callable | None = None,
 ):
     """Causal-LM train step: (state, tokens) -> (state, metrics).
 
@@ -260,20 +302,30 @@ def make_lm_train_step(
     device's block for the pallas kernel, plain XLA otherwise. At LM vocab
     sizes the logits are the biggest array in the program; gathering them
     for the loss would dwarf every other collective.
+
+    `metrics_fn` ((flat_logits, labels) -> (losses, correct); default
+    the fused pair kernel on TPU) computes loss and accuracy in one pass
+    over the logits; a plain `loss_fn` is still accepted for custom
+    losses, paying a separate argmax for the accuracy metric.
     """
-    if loss_fn is None:
-        loss_fn = _default_loss_fn()
+    if loss_fn is not None and metrics_fn is not None:
+        raise ValueError("pass loss_fn or metrics_fn, not both")
+    if loss_fn is not None:
+        def pair_fn(flat, t):
+            return loss_fn(flat, t), flat.argmax(axis=-1) == t
+
+        pallas = is_pallas_loss(loss_fn)
+    else:
+        pair_fn = metrics_fn or _default_metrics_fn()
+        pallas = is_pallas_loss(pair_fn)
     data = mesh_lib.DATA_AXIS
-    shard_the_loss = is_pallas_loss(loss_fn) and (
+    shard_the_loss = pallas and (
         mesh.shape[data] > 1 or (seq_axis and mesh.shape[seq_axis] > 1)
     )
 
     def local_token_losses(logits, targets):
         b, s, v = logits.shape
-        flat = logits.reshape(b * s, v)
-        t = targets.reshape(-1)
-        losses = loss_fn(flat, t)
-        correct = flat.argmax(axis=-1) == t
+        losses, correct = pair_fn(logits.reshape(b * s, v), targets.reshape(-1))
         return losses.reshape(b, s), correct.reshape(b, s)
 
     if shard_the_loss:

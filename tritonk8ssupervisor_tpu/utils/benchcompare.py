@@ -1,0 +1,121 @@
+"""Cross-configuration/round benchmark comparison tables.
+
+The reference's benchmark doc was a two-configuration comparison table
+(reference docs/benchmarks.md:19-50, Triton vs AWS, same workloads side
+by side). This is its driver-era equivalent: feed it any set of
+BENCH_r{N}.json records (the one-line outputs of bench.py — single
+record in r01-r03, a `benchmarks` array carrying both families since
+r04) and it renders the side-by-side markdown table, one row per
+(file, family), so round-over-round and config-over-config comparisons
+are one command instead of hand-copied numbers:
+
+    python -m tritonk8ssupervisor_tpu.utils.benchcompare BENCH_r*.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from pathlib import Path
+
+
+def load_records(path: Path) -> list[dict]:
+    """The per-family records inside one bench file. Accepts bench.py's
+    raw one-line output AND the driver's BENCH_r{N}.json envelope
+    ({"cmd", "rc", "tail", "parsed"} with the record under `parsed` and
+    the raw line inside `tail`); within a record, the `benchmarks` array
+    (r04+) carries the families, else the record itself is the one."""
+    record = json.loads(path.read_text())
+    if "metric" not in record and ("parsed" in record or "tail" in record):
+        parsed = record.get("parsed")
+        if isinstance(parsed, dict) and parsed:
+            record = parsed
+        else:  # fall back to the last JSON line of the captured tail
+            lines = [
+                l for l in str(record.get("tail", "")).splitlines()
+                if l.startswith("{")
+            ]
+            if not lines:
+                raise json.JSONDecodeError("no benchmark line in tail", "", 0)
+            record = json.loads(lines[-1])
+    families = record.get("benchmarks")
+    if isinstance(families, list) and families:
+        return families
+    return [record]
+
+
+def comparison_rows(paths: list[Path]) -> list[dict]:
+    rows = []
+    for path in paths:
+        try:
+            records = load_records(path)
+        except (OSError, json.JSONDecodeError, IndexError) as e:
+            rows.append({"source": path.name, "metric": f"<unreadable: {e}>"})
+            continue
+        for rec in records:
+            rows.append(
+                {
+                    "source": path.name,
+                    "metric": rec.get("metric", "?"),
+                    "value": rec.get("value"),
+                    "unit": rec.get("unit", ""),
+                    "vs_baseline": rec.get("vs_baseline"),
+                    "step_ms": rec.get("step_ms"),
+                    "mfu": rec.get("mfu"),
+                    "error": rec.get("error"),
+                }
+            )
+    return rows
+
+
+def to_markdown(rows: list[dict]) -> str:
+    header = "| source | metric | value | unit | vs baseline | step ms | MFU |"
+    rule = "|---|---|---|---|---|---|---|"
+
+    def fmt(v, pct=False):
+        if v is None:
+            return "—"
+        if pct:
+            return f"{v * 100:.1f}%"
+        if isinstance(v, float):
+            return f"{v:,.2f}"
+        return str(v)
+
+    lines = [header, rule]
+    for row in rows:
+        if row.get("error"):
+            lines.append(
+                f"| {row['source']} | {row['metric']} | FAILED: "
+                f"{row['error']} | | | | |"
+            )
+            continue
+        lines.append(
+            "| {source} | {metric} | {value} | {unit} | {vs} | {step} | {mfu} |".format(
+                source=row["source"],
+                metric=row["metric"],
+                value=fmt(row.get("value")),
+                unit=row.get("unit", ""),
+                vs=fmt(row.get("vs_baseline")),
+                step=fmt(row.get("step_ms")),
+                mfu=fmt(row.get("mfu"), pct=True),
+            )
+        )
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("files", nargs="+", type=Path,
+                        help="BENCH_r{N}.json files (bench.py output lines)")
+    parser.add_argument("--json", action="store_true")
+    args = parser.parse_args(argv)
+    rows = comparison_rows(args.files)
+    if args.json:
+        print(json.dumps(rows, sort_keys=True))
+    else:
+        print(to_markdown(rows))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
