@@ -318,3 +318,31 @@ def test_byo_example_manifest_matches_compiler():
         backoff_limit=3 * config.hosts_per_slice,
     )
     assert docs == [cc.to_headless_service("my-trainer"), expected_job]
+
+
+def test_write_manifests_includes_workload_set(tmp_path):
+    """--workload-image compiles a BYO Job + Service per slice next to
+    the benchmark set (the CLI's first-class BYO path)."""
+    config = ClusterConfig(
+        project="p", cluster_name="c", generation="v5e", topology="4x4",
+        num_slices=2,
+    )
+    paths = cc.write_manifests(
+        config, tmp_path,
+        workload_image="gcr.io/p/t:1",
+        workload_command=["python", "train.py"],
+        workload_name="my-trainer",
+    )
+    names = [p.name for p in paths]
+    assert "workload-service.yaml" in names
+    assert "workload-job-0.yaml" in names and "workload-job-1.yaml" in names
+    job = yaml.safe_load((tmp_path / "workload-job-1.yaml").read_text())
+    assert job["metadata"]["name"] == "my-trainer-1"
+    c = job["spec"]["template"]["spec"]["containers"][0]
+    assert c["image"] == "gcr.io/p/t:1"
+    assert c["command"] == ["python", "train.py"]
+    svc = yaml.safe_load((tmp_path / "workload-service.yaml").read_text())
+    assert svc["metadata"]["name"] == "my-trainer-svc"
+    # without the flag, no workload files appear
+    plain = cc.write_manifests(config, tmp_path / "plain")
+    assert not [p for p in plain if "workload" in p.name]

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import argparse
 import os
+import shlex
 import sys
 import time
 from pathlib import Path
@@ -95,6 +96,27 @@ def build_parser() -> argparse.ArgumentParser:
         help="checkpoint directory for the generated benchmark Job — use a "
         "gs:// bucket so checkpoints survive pod restarts (each slice "
         "writes DIR/slice-N). Also read from TK8S_CHECKPOINT_DIR.",
+    )
+    parser.add_argument(
+        "--workload-image",
+        default=None,
+        metavar="IMAGE",
+        help="also compile a bring-your-own workload Job per slice for "
+        "this container image (same coordinator/topology wiring as the "
+        "benchmark Job; docs/detailed.md section 2b)",
+    )
+    parser.add_argument(
+        "--workload-command",
+        default=None,
+        metavar="CMD",
+        help='command line for --workload-image, one shell-style string '
+        '(e.g. "python train.py --steps 10000")',
+    )
+    parser.add_argument(
+        "--workload-name",
+        default="workload",
+        metavar="NAME",
+        help="Job/Service name prefix for --workload-image manifests",
     )
     parser.add_argument(
         "--show-config",
@@ -257,6 +279,12 @@ def provision(args, paths: state.RunPaths, prompter: Prompter) -> int:
         job_kwargs = {"image": args.bench_image} if args.bench_image else {}
         if args.checkpoint_dir:
             job_kwargs["checkpoint_dir"] = args.checkpoint_dir
+        if args.workload_image:
+            job_kwargs["workload_image"] = args.workload_image
+            job_kwargs["workload_command"] = shlex.split(
+                args.workload_command or ""
+            )
+            job_kwargs["workload_name"] = args.workload_name
         manifest_paths = compiler.write_manifests(
             config, paths.manifests_dir, **job_kwargs
         )

@@ -586,7 +586,18 @@ def _gke_accelerator_label(generation: str) -> str:
     }[generation]
 
 
-def write_manifests(config: ClusterConfig, manifests_dir: Path, **job_kwargs) -> list[Path]:
+def write_manifests(
+    config: ClusterConfig,
+    manifests_dir: Path,
+    workload_image: str = "",
+    workload_command: list[str] | None = None,
+    workload_name: str = "workload",
+    **job_kwargs,
+) -> list[Path]:
+    """Compile the benchmark Job set — and, when `workload_image` is
+    given, a user-supplied (BYO) workload Job set next to it, one Job per
+    slice with the same coordinator/topology wiring (the CLI's
+    --workload-image/--workload-command; docs/detailed.md §2b)."""
     manifests_dir.mkdir(parents=True, exist_ok=True)
     paths = []
     # package ConfigMap first: the Job's self-install mount depends on it
@@ -602,4 +613,25 @@ def write_manifests(config: ClusterConfig, manifests_dir: Path, **job_kwargs) ->
             yaml.safe_dump(to_benchmark_job(config, slice_index=i, **job_kwargs), sort_keys=False)
         )
         paths.append(job)
+    if workload_image:
+        wsvc = manifests_dir / "workload-service.yaml"
+        wsvc.write_text(
+            yaml.safe_dump(to_headless_service(workload_name), sort_keys=False)
+        )
+        paths.append(wsvc)
+        for i in range(config.num_slices):
+            wjob = manifests_dir / f"workload-job-{i}.yaml"
+            wjob.write_text(
+                yaml.safe_dump(
+                    to_user_workload_job(
+                        config,
+                        name=workload_name,
+                        image=workload_image,
+                        command=list(workload_command or []),
+                        slice_index=i,
+                    ),
+                    sort_keys=False,
+                )
+            )
+            paths.append(wjob)
     return paths
