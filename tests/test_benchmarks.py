@@ -60,6 +60,43 @@ def test_lm_benchmark_sequence_parallel_smoke():
     assert np.isfinite(result["final_loss"])
 
 
+@pytest.mark.slow
+def test_lm_benchmark_expert_parallel_smoke():
+    """Tiny MoE LM benchmark on the CPU mesh with experts sharded 2-way
+    — the expert-parallel configuration end to end."""
+    from tritonk8ssupervisor_tpu.benchmarks import lm
+
+    result = lm.run_benchmark(
+        vocab_size=256, num_layers=2, num_heads=2, embed_dim=32,
+        seq_len=32, batch_per_data_shard=1, steps=2, warmup=1, windows=1,
+        expert_parallelism=2, moe_experts=4,
+    )
+    assert result["expert_parallelism"] == 2
+    assert result["moe_experts"] == 4
+    assert result["tokens_per_sec"] > 0
+    import numpy as np
+
+    assert np.isfinite(result["final_loss"])
+
+
+@pytest.mark.slow
+def test_lm_benchmark_pipeline_parallel_smoke():
+    """Tiny pipelined LM benchmark on the CPU mesh (4 stages x 2 data)
+    — the pipeline-parallel configuration end to end."""
+    from tritonk8ssupervisor_tpu.benchmarks import lm
+
+    result = lm.run_benchmark(
+        vocab_size=256, num_layers=4, num_heads=2, embed_dim=32,
+        seq_len=32, batch_per_data_shard=2, steps=2, warmup=1, windows=1,
+        pipeline_parallelism=4, num_microbatches=2,
+    )
+    assert result["pipeline_parallelism"] == 4
+    assert result["tokens_per_sec"] > 0
+    import numpy as np
+
+    assert np.isfinite(result["final_loss"])
+
+
 def test_containerbench_cli_json(tmp_path):
     proc = subprocess.run(
         [sys.executable, "-m", "tritonk8ssupervisor_tpu.benchmarks.containerbench",

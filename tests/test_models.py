@@ -54,3 +54,38 @@ def test_batch_stats_update_in_train_mode():
     before = jax.tree_util.tree_leaves(variables["batch_stats"])
     after = jax.tree_util.tree_leaves(updates["batch_stats"])
     assert any(not jnp.allclose(b, a) for b, a in zip(before, after))
+
+
+@pytest.mark.slow
+def test_resnet_remat_matches_plain_backward():
+    """remat_blocks must be a pure scheduling change: identical loss and
+    gradients, same parameter tree (the HBM bytes-for-FLOPs A/B lever)."""
+    import numpy as np
+
+    x = jax.random.normal(jax.random.key(0), (2, 32, 32, 3), jnp.float32)
+    labels = jnp.asarray([1, 3])
+
+    def loss_with(model):
+        variables = model.init(jax.random.key(1), x, train=False)
+
+        def loss_fn(params):
+            logits, _ = model.apply(
+                {"params": params, "batch_stats": variables["batch_stats"]},
+                x, train=True, mutable=["batch_stats"],
+            )
+            one_hot = jax.nn.one_hot(labels, logits.shape[-1])
+            return -(one_hot * jax.nn.log_softmax(logits)).sum(-1).mean()
+
+        return jax.value_and_grad(loss_fn)(variables["params"])
+
+    plain = ResNet18(num_classes=10, num_filters=8)
+    remat = ResNet18(num_classes=10, num_filters=8, remat_blocks=True)
+    loss_a, grads_a = loss_with(plain)
+    loss_b, grads_b = loss_with(remat)
+    np.testing.assert_allclose(float(loss_a), float(loss_b), rtol=1e-6)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(grads_a), jax.tree_util.tree_leaves(grads_b)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+        )

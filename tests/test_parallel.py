@@ -17,21 +17,38 @@ from tritonk8ssupervisor_tpu.parallel import (
 )
 from tritonk8ssupervisor_tpu.parallel import train as train_lib
 from tritonk8ssupervisor_tpu.parallel.distributed import ClusterEnv
-from tritonk8ssupervisor_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+from tritonk8ssupervisor_tpu.parallel.mesh import (
+    DATA_AXIS,
+    EXPERT_AXIS,
+    MODEL_AXIS,
+    PIPE_AXIS,
+)
 
 
 # --------------------------------------------------------------------- mesh
 
 
 def test_make_mesh_shapes():
+    ones = {EXPERT_AXIS: 1, PIPE_AXIS: 1}
     mesh = make_mesh()
-    assert mesh.shape == {DATA_AXIS: 8, MODEL_AXIS: 1}
+    assert mesh.shape == {DATA_AXIS: 8, MODEL_AXIS: 1, **ones}
     mesh = make_mesh(model_parallelism=2)
-    assert mesh.shape == {DATA_AXIS: 4, MODEL_AXIS: 2}
-    with pytest.raises(ValueError, match="does not divide"):
+    assert mesh.shape == {DATA_AXIS: 4, MODEL_AXIS: 2, **ones}
+    mesh = make_mesh(model_parallelism=2, expert_parallelism=2)
+    assert mesh.shape == {
+        DATA_AXIS: 2, EXPERT_AXIS: 2, PIPE_AXIS: 1, MODEL_AXIS: 2,
+    }
+    mesh = make_mesh(pipeline_parallelism=4)
+    assert mesh.shape == {
+        DATA_AXIS: 2, EXPERT_AXIS: 1, PIPE_AXIS: 4, MODEL_AXIS: 1,
+    }
+    with pytest.raises(ValueError, match="do not divide"):
         make_mesh(model_parallelism=3)
-    with pytest.raises(ValueError, match="does not divide"):
+    with pytest.raises(ValueError, match="do not divide"):
         make_mesh(model_parallelism=0)
+    with pytest.raises(ValueError, match="do not divide"):
+        make_mesh(model_parallelism=2, expert_parallelism=2,
+                  pipeline_parallelism=4)
 
 
 def test_param_sharding_rules():
@@ -226,9 +243,20 @@ def test_tensor_parallel_metrics_match_single_device():
 
 
 def test_batch_sharding_layout():
+    # batch shards over (data, expert) jointly: non-MoE layers treat the
+    # expert axis as extra batch parallelism (GShard-style), and a size-1
+    # expert axis (the default) makes this the plain data layout
     mesh = make_mesh()
     sh = batch_sharding(mesh)
-    assert sh.spec == P(DATA_AXIS, None, None, None)
+    assert sh.spec == P((DATA_AXIS, EXPERT_AXIS), None, None, None)
+    # manually built meshes without the expert axis keep the old layout
+    import numpy as np
+    from jax.sharding import Mesh
+
+    legacy = Mesh(
+        np.asarray(jax.devices()).reshape(8, 1), (DATA_AXIS, MODEL_AXIS)
+    )
+    assert batch_sharding(legacy).spec == P((DATA_AXIS,), None, None, None)
 
 
 # ------------------------------------------------- pallas loss under shard_map

@@ -1,0 +1,208 @@
+"""Pipeline parallelism: the microbatched ppermute schedule must compute
+exactly the sequential function — forward AND backward — and the
+pipelined LM must match the dense TransformerLM it was split from.
+
+All on the 8-device virtual CPU mesh (conftest.py), per SURVEY.md §4:
+every parallelism axis gets a correctness test without TPU quota."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tritonk8ssupervisor_tpu.models import TransformerLM
+from tritonk8ssupervisor_tpu.parallel import make_mesh
+from tritonk8ssupervisor_tpu.parallel import pipeline as pp
+from tritonk8ssupervisor_tpu.parallel import train as train_lib
+from tritonk8ssupervisor_tpu.parallel.mesh import PIPE_AXIS
+
+
+def _affine_stage(params, x):
+    # one "layer" per stage: x -> tanh(x * w + b), params leaves (d,)
+    return jnp.tanh(x * params["w"] + params["b"])
+
+
+def _sequential(stage_params, microbatches):
+    def one(x):
+        for i in range(stage_params["w"].shape[0]):
+            x = _affine_stage(
+                jax.tree_util.tree_map(lambda p, i=i: p[i], stage_params), x
+            )
+        return x
+
+    return jax.vmap(one)(microbatches)
+
+
+def _stage_tree(key, num_stages, d):
+    kw, kb = jax.random.split(key)
+    return {
+        "w": jax.random.normal(kw, (num_stages, d)),
+        "b": 0.1 * jax.random.normal(kb, (num_stages, d)),
+    }
+
+
+def test_pipeline_apply_matches_sequential_forward():
+    mesh = make_mesh(pipeline_parallelism=4)  # data=2 x pipe=4
+    d = 8
+    params = _stage_tree(jax.random.key(0), 4, d)
+    mb = jax.random.normal(jax.random.key(1), (6, 4, d))
+    got = pp.pipeline_apply(_affine_stage, params, mb, mesh)
+    want = _sequential(params, mb)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_pipeline_apply_fewer_microbatches_than_stages():
+    # fill/drain must stay correct even when the pipeline never fills
+    mesh = make_mesh(pipeline_parallelism=4)
+    params = _stage_tree(jax.random.key(0), 4, 4)
+    mb = jax.random.normal(jax.random.key(1), (2, 2, 4))
+    got = pp.pipeline_apply(_affine_stage, params, mb, mesh)
+    want = _sequential(params, mb)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_pipeline_apply_gradients_match_sequential():
+    """The transpose of the schedule (ppermute reversal + scan transpose
+    + the data-axis psum shard_map inserts for replicated-in params)
+    must produce the sequential gradients."""
+    mesh = make_mesh(pipeline_parallelism=4)
+    d = 8
+    params = _stage_tree(jax.random.key(0), 4, d)
+    mb = jax.random.normal(jax.random.key(1), (4, 4, d))
+    tgt = jax.random.normal(jax.random.key(2), (4, 4, d))
+
+    def loss_pp(p):
+        return jnp.mean((pp.pipeline_apply(_affine_stage, p, mb, mesh) - tgt) ** 2)
+
+    def loss_seq(p):
+        return jnp.mean((_sequential(p, mb) - tgt) ** 2)
+
+    g_pp = jax.grad(loss_pp)(params)
+    g_seq = jax.grad(loss_seq)(params)
+    for k in ("w", "b"):
+        np.testing.assert_allclose(
+            np.asarray(g_pp[k]), np.asarray(g_seq[k]), rtol=1e-4, atol=1e-6
+        )
+
+
+def _tiny_lm(**kw):
+    return TransformerLM(
+        vocab_size=64, num_layers=4, num_heads=2, embed_dim=16,
+        max_seq_len=16, dtype=jnp.float32, logits_dtype=jnp.float32, **kw
+    )
+
+
+@pytest.mark.slow
+def test_pp_lm_forward_matches_dense_lm():
+    """A dense-LM checkpoint split by pipelined_lm_params must compute the
+    same logits through the pipeline."""
+    mesh = make_mesh(pipeline_parallelism=4)
+    model = _tiny_lm()
+    tokens = jax.random.randint(jax.random.key(0), (4, 16), 0, 64)
+    variables = model.init(jax.random.key(1), tokens, train=False)
+    want = model.apply(variables, tokens, train=False)
+
+    outer, stages, _ = pp.pipelined_lm_params(model, variables["params"], mesh)
+    forward = pp.make_pp_lm_forward(model, mesh, num_microbatches=2)
+    got = jax.jit(forward)(outer, stages, tokens)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.slow
+def test_pp_lm_train_step_matches_dense_step():
+    """One pp train step from a shared init must produce the dense step's
+    loss/accuracy (same params, same batch), and update the stage params."""
+    mesh = make_mesh(pipeline_parallelism=4)
+    model = _tiny_lm()
+    tx = train_lib.default_optimizer(learning_rate=0.1)
+    sample = jax.ShapeDtypeStruct((4, 16), jnp.int32)
+    state, shardings = pp.create_pp_lm_state(
+        model, jax.random.key(0), sample, mesh, tx
+    )
+    assert state.params["stages"]["qkv"]["kernel"].shape[0] == 4
+    spec = shardings.params["stages"]["qkv"]["kernel"].spec
+    assert spec[0] == PIPE_AXIS
+
+    # dense twin on a single device from the same init
+    mesh1 = make_mesh(devices=jax.devices()[:1])
+    dense_state, dense_sh = train_lib.create_train_state(
+        model, jax.random.key(0), sample, mesh1, tx
+    )
+    dense_step = train_lib.make_lm_train_step(model, tx, mesh1, dense_sh)
+
+    step = pp.make_pp_lm_train_step(
+        model, tx, mesh, shardings, num_microbatches=2
+    )
+    tokens = jax.random.randint(jax.random.key(2), (4, 16), 0, 64)
+    before = np.asarray(state.params["stages"]["qkv"]["kernel"])
+    state, metrics = step(state, tokens)
+    dense_state, dense_metrics = dense_step(dense_state, tokens)
+    np.testing.assert_allclose(
+        float(metrics["loss"]), float(dense_metrics["loss"]),
+        rtol=1e-4, atol=1e-5,
+    )
+    np.testing.assert_allclose(
+        float(metrics["accuracy"]), float(dense_metrics["accuracy"]),
+        atol=1e-6,
+    )
+    after = np.asarray(state.params["stages"]["qkv"]["kernel"])
+    assert not np.array_equal(before, after), "stage params did not update"
+
+
+def test_stack_unstack_roundtrip():
+    model = _tiny_lm()
+    tokens = jnp.zeros((2, 16), jnp.int32)
+    params = model.init(jax.random.key(0), tokens, train=False)["params"]
+    stacked = pp.stack_block_params(params, 4)
+    back = pp.unstack_block_params(stacked, 4)
+    for i in range(4):
+        a = jax.tree_util.tree_leaves(params[f"Block_{i}"])
+        b = jax.tree_util.tree_leaves(back[f"Block_{i}"])
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_pipelined_lm_params_validates_divisibility():
+    mesh = make_mesh(pipeline_parallelism=4)
+    model = _tiny_lm()
+    bad = TransformerLM(
+        vocab_size=64, num_layers=3, num_heads=2, embed_dim=16,
+        max_seq_len=16, dtype=jnp.float32,
+    )
+    tokens = jnp.zeros((2, 16), jnp.int32)
+    params = bad.init(jax.random.key(0), tokens, train=False)["params"]
+    with pytest.raises(ValueError, match="not divisible"):
+        pp.pipelined_lm_params(bad, params, mesh)
+    params4 = model.init(jax.random.key(0), tokens, train=False)["params"]
+    outer, stages, sh = pp.pipelined_lm_params(model, params4, mesh)
+    assert set(outer) == {"tok_embed", "pos_embed", "LayerNorm_0", "lm_head"}
+
+
+@pytest.mark.slow
+def test_pp_lm_forward_remat_matches_plain():
+    """remat through the pipeline stage fn must be a pure scheduling
+    change (the --remat + --pipeline-parallelism combination)."""
+    mesh = make_mesh(pipeline_parallelism=4)
+    model = _tiny_lm()
+    model_rm = _tiny_lm(remat_blocks=True)
+    tokens = jax.random.randint(jax.random.key(0), (4, 16), 0, 64)
+    variables = model.init(jax.random.key(1), tokens, train=False)
+    outer, stages, _ = pp.pipelined_lm_params(model, variables["params"], mesh)
+
+    plain = jax.jit(pp.make_pp_lm_forward(model, mesh, num_microbatches=2))
+    remat = jax.jit(pp.make_pp_lm_forward(model_rm, mesh, num_microbatches=2))
+    np.testing.assert_allclose(
+        np.asarray(plain(outer, stages, tokens)),
+        np.asarray(remat(outer, stages, tokens)),
+        rtol=1e-6, atol=1e-7,
+    )
+
+
+def test_lm_benchmark_rejects_non_dividing_experts():
+    from tritonk8ssupervisor_tpu.benchmarks import lm
+
+    with pytest.raises(ValueError, match="divisible by"):
+        lm.run_benchmark(moe_experts=6, expert_parallelism=4)

@@ -25,6 +25,7 @@ from tritonk8ssupervisor_tpu.models import TransformerLM
 from tritonk8ssupervisor_tpu.ops.ring_attention import ring_attention
 from tritonk8ssupervisor_tpu.parallel import initialize_from_env, make_mesh
 from tritonk8ssupervisor_tpu.parallel import train as train_lib
+from tritonk8ssupervisor_tpu.parallel import mesh as mesh_lib
 from tritonk8ssupervisor_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
 
 
@@ -39,6 +40,12 @@ def run_benchmark(
     warmup: int = 3,
     windows: int = 3,
     sequence_parallelism: int = 1,
+    expert_parallelism: int = 1,
+    moe_experts: int = 0,
+    moe_every: int = 2,
+    pipeline_parallelism: int = 1,
+    num_microbatches: int = 4,
+    remat: bool = False,
     attention: str = "auto",
     learning_rate: float = 3e-2,
     checkpoint_dir: str | None = None,
@@ -51,6 +58,12 @@ def run_benchmark(
     `attention` picks dense XLA attention (default — fastest up to the
     seq length whose score matrix fits HBM) or the fused pallas kernel
     ("flash" — enables longer single-chip sequences).
+
+    moe_experts > 0 makes every `moe_every`-th block a mixture of
+    experts (models/moe.py); expert_parallelism shards the experts over
+    the mesh's "expert" axis. pipeline_parallelism > 1 runs the block
+    stack through the ppermute pipeline (parallel/pipeline.py) with
+    `num_microbatches` microbatches.
     """
     if seq_len % max(sequence_parallelism, 1):
         raise ValueError(
@@ -58,9 +71,32 @@ def run_benchmark(
             f"--sequence-parallelism {sequence_parallelism} "
             "(the sequence axis shards evenly across the ring)"
         )
-    mesh = make_mesh(model_parallelism=sequence_parallelism)
+    if pipeline_parallelism > 1 and sequence_parallelism > 1:
+        raise ValueError(
+            "--pipeline-parallelism and --sequence-parallelism are "
+            "separate strategies in this benchmark: the pipeline stages "
+            "the block stack, the ring shards inside every block"
+        )
+    if pipeline_parallelism > 1 and moe_experts:
+        raise ValueError(
+            "--pipeline-parallelism with --moe-experts is not wired: the "
+            "pipeline's stage function runs the dense block"
+        )
+    if moe_experts and moe_experts % expert_parallelism:
+        raise ValueError(
+            f"--moe-experts {moe_experts} must be divisible by "
+            f"--expert-parallelism {expert_parallelism}: a non-dividing "
+            "expert count would silently replicate every expert weight "
+            "(mesh.param_shardings only shards evenly-dividing leading "
+            "dims) while the run reports itself expert-parallel"
+        )
+    mesh = make_mesh(
+        model_parallelism=sequence_parallelism,
+        expert_parallelism=expert_parallelism,
+        pipeline_parallelism=pipeline_parallelism,
+    )
     num_chips = mesh.devices.size
-    global_batch = batch_per_data_shard * mesh.shape[DATA_AXIS]
+    global_batch = batch_per_data_shard * mesh_lib.batch_degree(mesh)
 
     if attention not in ("auto", "dense", "flash"):
         raise ValueError(
@@ -96,17 +132,31 @@ def run_benchmark(
         embed_dim=embed_dim,
         max_seq_len=seq_len,
         attention_fn=attention_fn,
+        moe_experts=moe_experts,
+        moe_every=moe_every,
+        moe_mesh=mesh if moe_experts else None,
+        remat_blocks=remat,
     )
     tx = train_lib.default_optimizer(learning_rate=learning_rate)
     sample = jax.ShapeDtypeStruct((global_batch, seq_len), jnp.int32)
     init_start = time.monotonic()
-    state, shardings = train_lib.create_train_state(
-        model, jax.random.key(0), sample, mesh, tx
-    )
     seq_axis = MODEL_AXIS if sequence_parallelism > 1 else None
-    step = train_lib.make_lm_train_step(
-        model, tx, mesh, shardings, seq_axis=seq_axis
-    )
+    if pipeline_parallelism > 1:
+        from tritonk8ssupervisor_tpu.parallel import pipeline as pp_lib
+
+        state, shardings = pp_lib.create_pp_lm_state(
+            model, jax.random.key(0), sample, mesh, tx
+        )
+        step = pp_lib.make_pp_lm_train_step(
+            model, tx, mesh, shardings, num_microbatches=num_microbatches
+        )
+    else:
+        state, shardings = train_lib.create_train_state(
+            model, jax.random.key(0), sample, mesh, tx
+        )
+        step = train_lib.make_lm_train_step(
+            model, tx, mesh, shardings, seq_axis=seq_axis
+        )
 
     # Checkpoint/resume (SURVEY.md §5), same contract as the flagship:
     # resume from the latest step when the directory carries one (local or
@@ -124,7 +174,7 @@ def run_benchmark(
         restore_seconds = time.monotonic() - restore_start
     tokens = jax.device_put(
         jax.random.randint(jax.random.key(1), sample.shape, 0, vocab_size),
-        NamedSharding(mesh, P(DATA_AXIS, seq_axis)),
+        NamedSharding(mesh, P(mesh_lib.batch_axes(mesh), seq_axis)),
     )
 
     # THE measurement discipline, shared with the flagship
@@ -177,6 +227,9 @@ def run_benchmark(
         "platform": jax.default_backend(),
         "num_chips": int(num_chips),
         "sequence_parallelism": int(sequence_parallelism),
+        "expert_parallelism": int(expert_parallelism),
+        "moe_experts": int(moe_experts),
+        "pipeline_parallelism": int(pipeline_parallelism),
         "attention": "ring" if sequence_parallelism > 1 else attention,
         "global_batch": int(global_batch),
         "seq_len": seq_len,
@@ -207,6 +260,32 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--warmup", type=int, default=3)
     parser.add_argument("--windows", type=int, default=3, help="timed windows")
     parser.add_argument("--sequence-parallelism", type=int, default=1)
+    parser.add_argument(
+        "--expert-parallelism", type=int, default=1,
+        help="shard MoE experts over the mesh's 'expert' axis "
+        "(requires --moe-experts)",
+    )
+    parser.add_argument(
+        "--moe-experts", type=int, default=0,
+        help="make every --moe-every'th block a mixture of this many "
+        "experts (models/moe.py); 0 = dense MLPs",
+    )
+    parser.add_argument("--moe-every", type=int, default=2)
+    parser.add_argument(
+        "--pipeline-parallelism", type=int, default=1,
+        help="stage the block stack over the mesh's 'pipe' axis "
+        "(parallel/pipeline.py GPipe schedule)",
+    )
+    parser.add_argument(
+        "--num-microbatches", type=int, default=4,
+        help="microbatches per step when --pipeline-parallelism > 1",
+    )
+    parser.add_argument(
+        "--remat",
+        action="store_true",
+        help="rematerialise blocks in the backward (jax.checkpoint) — "
+        "trades recompute FLOPs for activation bytes at long sequence",
+    )
     parser.add_argument(
         "--attention",
         choices=("auto", "dense", "flash"),
@@ -247,6 +326,12 @@ def main(argv: list[str] | None = None) -> int:
         warmup=args.warmup,
         windows=args.windows,
         sequence_parallelism=args.sequence_parallelism,
+        expert_parallelism=args.expert_parallelism,
+        moe_experts=args.moe_experts,
+        moe_every=args.moe_every,
+        pipeline_parallelism=args.pipeline_parallelism,
+        num_microbatches=args.num_microbatches,
+        remat=args.remat,
         attention=args.attention,
         checkpoint_dir=args.checkpoint_dir,
         profile_dir=args.profile,

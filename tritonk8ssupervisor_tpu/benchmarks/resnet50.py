@@ -33,7 +33,7 @@ from tritonk8ssupervisor_tpu.parallel import (
     make_mesh,
 )
 from tritonk8ssupervisor_tpu.parallel import train as train_lib
-from tritonk8ssupervisor_tpu.parallel.mesh import DATA_AXIS
+from tritonk8ssupervisor_tpu.parallel import mesh as mesh_lib
 
 MODELS = {"resnet50": ResNet50, "resnet18": ResNet18}
 
@@ -50,6 +50,7 @@ def run_benchmark(
     model_parallelism: int = 1,
     learning_rate: float = 0.1,
     fused_1x1_bwd: bool = False,
+    remat: bool = False,
     checkpoint_dir: str | None = None,
     profile_dir: str | None = None,
 ) -> dict:
@@ -66,7 +67,7 @@ def run_benchmark(
     """
     mesh = make_mesh(model_parallelism=model_parallelism)
     num_chips = mesh.devices.size
-    data_degree = mesh.shape[DATA_AXIS]
+    data_degree = mesh_lib.batch_degree(mesh)
     global_batch = batch_per_chip * data_degree
 
     # Measured on v5e (100-step windows): per-step dispatch pipelines fine
@@ -82,7 +83,8 @@ def run_benchmark(
         )
 
     model = MODELS[model_name](
-        num_classes=num_classes, fused_1x1_bwd=fused_1x1_bwd
+        num_classes=num_classes, fused_1x1_bwd=fused_1x1_bwd,
+        remat_blocks=remat,
     )
     tx = train_lib.default_optimizer(learning_rate=learning_rate)
     # bf16 input halves the first conv's HBM read (the model computes in
@@ -196,6 +198,13 @@ def build_parser() -> argparse.ArgumentParser:
         "backward stages",
     )
     parser.add_argument(
+        "--remat",
+        action="store_true",
+        help="rematerialise residual blocks in the backward "
+        "(jax.checkpoint) — A/B lever trading recompute FLOPs for "
+        "activation bytes on the HBM-bound step",
+    )
+    parser.add_argument(
         "--profile",
         default=None,
         metavar="DIR",
@@ -226,6 +235,7 @@ def main(argv: list[str] | None = None) -> int:
         steps_per_call=args.steps_per_call,
         model_parallelism=args.model_parallelism,
         fused_1x1_bwd=args.fused_1x1_bwd,
+        remat=args.remat,
         checkpoint_dir=args.checkpoint_dir,
         profile_dir=args.profile,
     )

@@ -7,7 +7,8 @@ ResNet-50 in JAX, exercised by benchmarks/resnet50.py both standalone on a
 TPU VM slice and as a K8s Job (config/compile.py to_benchmark_job).
 """
 
+from tritonk8ssupervisor_tpu.models.moe import MoEMLP
 from tritonk8ssupervisor_tpu.models.resnet import ResNet, ResNet18, ResNet50
 from tritonk8ssupervisor_tpu.models.transformer import TransformerLM
 
-__all__ = ["ResNet", "ResNet18", "ResNet50", "TransformerLM"]
+__all__ = ["MoEMLP", "ResNet", "ResNet18", "ResNet50", "TransformerLM"]

@@ -1,0 +1,258 @@
+"""Mixture-of-experts MLP with expert parallelism over the mesh.
+
+TPU-first design (GShard/Switch lineage, re-derived for this mesh):
+
+- Routing is static-shaped: every (batch row, expert) pair gets a fixed
+  `capacity` of token slots, chosen at trace time, so the whole layer is
+  one compiled program — no data-dependent shapes, no host round trips.
+  Tokens beyond capacity are dropped (their combine weight is zero and
+  the residual stream carries them through unchanged), the standard
+  trade for XLA-compilable MoE.
+- Dispatch and combine are einsums against a (batch, seq, expert,
+  capacity) one-hot. With the batch dim sharded over the mesh's batch
+  axes and the expert dim of the dispatched activations + expert
+  parameters sharded over "expert" (parallel/mesh.py param_shardings
+  routes any parameter whose name contains "expert" there), XLA lowers
+  the layout change between them to an all_to_all over ICI — the
+  expert-parallel collective, placed by the compiler rather than called
+  by hand (same inversion as the gradient psum, SURVEY.md §2.5).
+- Router math in float32 (softmax over expert logits is tiny but
+  precision-critical); expert FFN math in bf16 like every other matmul.
+
+Aux losses (load-balance + router z-loss) are sown into the
+"moe_losses" collection; parallel/train.make_lm_train_step folds every
+sown leaf into the optimized loss, so MoE slots into the existing LM
+step factory without a new signature.
+
+The reference framework has no MoE (or any model code — SURVEY.md §2.5);
+this exists so expert parallelism is a first-class mesh axis alongside
+dp/tp/sp/pp rather than a bolt-on.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tritonk8ssupervisor_tpu.parallel.mesh import (
+    EXPERT_AXIS,
+    batch_axes,
+)
+
+
+def _constraint_mesh(explicit):
+    """The mesh to pin MoE layouts against: the module's `mesh` attribute
+    when set, else the ambient mesh installed by jax.sharding.use_mesh
+    (None when neither exists — sharding propagation alone then decides,
+    which XLA resolves by all-gathering the expert weights; fine for
+    single-device runs, wasteful on a real expert axis)."""
+    if explicit is not None:
+        return explicit
+    ambient = jax.sharding.get_abstract_mesh()
+    return None if ambient.empty else ambient
+
+
+def compute_capacity(
+    seq_len: int, num_experts: int, k: int, capacity_factor: float
+) -> int:
+    """Token slots per (batch row, expert): ceil(cf * k * s / E), >= 1."""
+    return max(1, math.ceil(capacity_factor * k * seq_len / num_experts))
+
+
+def top_k_dispatch(
+    router_probs: jax.Array, k: int, capacity: int
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Static-shaped top-k routing with per-(row, expert) capacity.
+
+    Args:
+      router_probs: (batch, seq, experts) f32 softmax outputs.
+      k: choices per token (1 = Switch, 2 = GShard default).
+      capacity: slots per (batch row, expert).
+
+    Returns (dispatch, combine, top1_mask):
+      dispatch (b, s, E, C) — 0/1; token (b, s) occupies slot c of
+        expert e. A token's kth choice only lands after every token's
+        (k-1)th choice (choice-major slot ranking), matching the
+        priority the gating weights imply.
+      combine  (b, s, E, C) — dispatch weighted by the token's
+        renormalised gate for that expert (sums to <= 1 over (E, C)).
+      top1_mask (b, s, E) — one-hot of each token's first choice, for
+        the load-balance loss.
+    """
+    b, s, e = router_probs.shape
+    gates, idx = jax.lax.top_k(router_probs, k)  # (b, s, k)
+    masks = jax.nn.one_hot(idx, e, dtype=router_probs.dtype)  # (b, s, k, E)
+
+    # Slot positions: count earlier claims on the same expert, ranking
+    # all first choices before any second choice (choice-major), then by
+    # sequence position — the deterministic priority order.
+    cm = masks.transpose(0, 2, 1, 3).reshape(b, k * s, e)
+    pos_cm = jnp.cumsum(cm, axis=1) - cm
+    pos = pos_cm.reshape(b, k, s, e).transpose(0, 2, 1, 3)  # (b, s, k, E)
+    sel_pos = (pos * masks).sum(-1)  # (b, s, k) slot within chosen expert
+    kept = (sel_pos < capacity) * masks.sum(-1)  # (b, s, k) choice kept?
+
+    # Renormalise gates over kept choices so dropped choices don't leak
+    # probability mass; a token with every choice dropped contributes 0.
+    kept_gate = gates * kept
+    denom = jnp.maximum(kept_gate.sum(-1, keepdims=True), 1e-9)
+    norm_gates = kept_gate / denom
+
+    slot_oh = jax.nn.one_hot(
+        sel_pos.astype(jnp.int32), capacity, dtype=router_probs.dtype
+    )
+    chosen = masks * kept[..., None]  # (b, s, k, E)
+    dispatch = jnp.einsum("bske,bskc->bsec", chosen, slot_oh)
+    combine = jnp.einsum("bske,bskc,bsk->bsec", chosen, slot_oh, norm_gates)
+    return dispatch, combine, masks[:, :, 0]
+
+
+def load_balance_loss(
+    router_probs: jax.Array, top1_mask: jax.Array
+) -> jax.Array:
+    """E * sum_e(fraction routed to e * mean prob of e) — minimised (=1)
+    at a uniform routing; the Switch/GShard auxiliary."""
+    e = router_probs.shape[-1]
+    f = top1_mask.reshape(-1, e).mean(0)
+    p = router_probs.reshape(-1, e).mean(0)
+    return e * jnp.sum(f * p)
+
+
+class MoEMLP(nn.Module):
+    """Drop-in replacement for a transformer MLP: top-k routed experts.
+
+    Parameter names carry "expert" so mesh.param_shardings shards their
+    leading expert dim over the "expert" axis (and the FFN width over
+    "model" when both divide — ep x tp on the same kernel).
+    """
+
+    num_experts: int
+    mlp_ratio: int = 4
+    k: int = 2
+    capacity_factor: float = 1.25
+    aux_weight: float = 1e-2
+    z_weight: float = 1e-3
+    dtype: Any = jnp.bfloat16
+    # the device mesh to pin the expert layout against (see
+    # _constraint_mesh); optional — without it the layer is still
+    # correct, but XLA gathers expert weights instead of all_to_all-ing
+    # tokens
+    mesh: Any = None
+
+    @nn.compact
+    def __call__(self, x):
+        b, s, d = x.shape
+        e = self.num_experts
+        f = self.mlp_ratio * d
+        capacity = compute_capacity(s, e, self.k, self.capacity_factor)
+
+        wg = self.param(
+            "router_kernel", nn.initializers.lecun_normal(), (d, e),
+            jnp.float32,
+        )
+        w_up = self.param(
+            "expert_up_kernel",
+            nn.initializers.lecun_normal(batch_axis=(0,)),
+            (e, d, f),
+            jnp.float32,
+        )
+        b_up = self.param(
+            "expert_up_bias", nn.initializers.zeros_init(), (e, f),
+            jnp.float32,
+        )
+        w_down = self.param(
+            "expert_down_kernel",
+            nn.initializers.lecun_normal(batch_axis=(0,)),
+            (e, f, d),
+            jnp.float32,
+        )
+        b_down = self.param(
+            "expert_down_bias", nn.initializers.zeros_init(), (e, d),
+            jnp.float32,
+        )
+
+        # Router in f32; the logits feed both the dispatch and the losses.
+        logits = jnp.einsum("bsd,de->bse", x.astype(jnp.float32), wg)
+        probs = jax.nn.softmax(logits, axis=-1)
+        dispatch, combine, top1 = top_k_dispatch(probs, self.k, capacity)
+
+        lb = load_balance_loss(probs, top1)
+        # z-loss keeps router logits from drifting to magnitudes where
+        # the f32 softmax saturates (ST-MoE) — cheap insurance.
+        zl = jnp.mean(jax.nn.logsumexp(logits, axis=-1) ** 2)
+        self.sow(
+            "moe_losses",
+            "router",
+            self.aux_weight * lb + self.z_weight * zl,
+        )
+
+        # (b, s, d) batch-sharded -> (E, b, C, d) expert-sharded: with
+        # the layout pinned below, XLA lowers this boundary to an
+        # all_to_all (tokens travel; weights stay put).
+        mesh = _constraint_mesh(self.mesh)
+        if mesh is not None and EXPERT_AXIS in mesh.axis_names:
+            from jax.sharding import Mesh
+
+            def pin(t, *spec):
+                if isinstance(mesh, Mesh):
+                    return jax.lax.with_sharding_constraint(
+                        t, NamedSharding(mesh, P(*spec))
+                    )
+                return jax.lax.with_sharding_constraint(t, P(*spec))
+
+            # batch rows stay over "data" in the expert layout; the
+            # expert dim takes over the "expert" axis
+            expert_row = tuple(
+                a for a in batch_axes(mesh) if a != EXPERT_AXIS
+            )
+        else:
+            def pin(t, *spec):
+                return t
+
+            expert_row = ()
+
+        xe = jnp.einsum(
+            "bsec,bsd->ebcd", dispatch.astype(self.dtype), x.astype(self.dtype)
+        )
+        xe = pin(xe, EXPERT_AXIS, expert_row, None, None)
+        h = jnp.einsum("ebcd,edf->ebcf", xe, w_up.astype(self.dtype))
+        h = h + b_up.astype(self.dtype)[:, None, None, :]
+        h = nn.gelu(h)
+        y = jnp.einsum("ebcf,efd->ebcd", h, w_down.astype(self.dtype))
+        y = y + b_down.astype(self.dtype)[:, None, None, :]
+        y = pin(y, EXPERT_AXIS, expert_row, None, None)
+        # expert-sharded -> batch-sharded (the second all_to_all), with
+        # the gate weights folded in
+        out = jnp.einsum("bsec,ebcd->bsd", combine.astype(self.dtype), y)
+        if mesh is not None and EXPERT_AXIS in mesh.axis_names:
+            out = pin(out, batch_axes(mesh), None, None)
+        return out
+
+
+def moe_mlp_reference(variables: dict, x: jax.Array, k: int) -> jax.Array:
+    """Per-token reference for tests: same math as MoEMLP with unlimited
+    capacity (no drops), computed the naive way — every expert applied to
+    every token, gathered by gate. f32 throughout."""
+    p = variables["params"]
+    logits = jnp.einsum("bsd,de->bse", x, p["router_kernel"])
+    probs = jax.nn.softmax(logits, axis=-1)
+    gates, idx = jax.lax.top_k(probs, k)
+    gates = gates / jnp.maximum(gates.sum(-1, keepdims=True), 1e-9)
+
+    h = jnp.einsum("bsd,edf->ebsf", x, p["expert_up_kernel"])
+    h = h + p["expert_up_bias"][:, None, None, :]
+    h = nn.gelu(h)
+    y = jnp.einsum("ebsf,efd->ebsd", h, p["expert_down_kernel"])
+    y = y + p["expert_down_bias"][:, None, None, :]  # (E, b, s, d)
+
+    sel = jnp.take_along_axis(
+        y.transpose(1, 2, 0, 3),  # (b, s, E, d)
+        idx[..., None],
+        axis=2,
+    )  # (b, s, k, d)
+    return jnp.einsum("bskd,bsk->bsd", sel, gates)

@@ -214,6 +214,13 @@ class ResNet(nn.Module):
     # (docs/benchmarks.md "The 99 ms wall, proven"). Kept as the
     # checked-in evidence + restart point; off by default.
     fused_1x1_bwd: bool = False
+    # Rematerialise each residual block in the backward (jax.checkpoint
+    # via nn.remat): the bytes-for-FLOPs lever for the HBM-bound step —
+    # forward saves only block boundaries, the backward recomputes block
+    # internals instead of reading them back. Same math, same parameter
+    # tree. A/B lever for the bandwidth-bound backward; measured results
+    # in docs/benchmarks.md.
+    remat_blocks: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -262,15 +269,26 @@ class ResNet(nn.Module):
         x = norm(name="stem_norm")(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        block_cls = (
+            nn.remat(self.block_cls) if self.remat_blocks else self.block_cls
+        )
+        # explicit names pin the parameter tree to the plain auto-names
+        # (nn.remat would otherwise prefix them "Checkpoint...", changing
+        # both the tree and the per-module init rng) — remat stays a pure
+        # scheduling A/B, checkpoints interchangeable
+        base = self.block_cls.__name__
+        idx = 0
         for stage, size in enumerate(self.stage_sizes):
             for block in range(size):
                 strides = (2, 2) if stage > 0 and block == 0 else (1, 1)
-                x = self.block_cls(
+                x = block_cls(
                     filters=self.num_filters * 2**stage,
                     strides=strides,
                     conv=conv,
                     norm=norm,
+                    name=f"{base}_{idx}",
                 )(x)
+                idx += 1
         x = jnp.mean(x, axis=(1, 2))
         # logits in f32: the loss softmax needs the dynamic range
         x = nn.Dense(self.num_classes, dtype=jnp.float32,

@@ -36,6 +36,13 @@ class Block(nn.Module):
     attention_fn: AttentionFn
     mlp_ratio: int
     dtype: Any
+    # > 0 replaces this block's dense MLP with a mixture of experts
+    # (models/moe.py) — expert parameters shard over the mesh's "expert"
+    # axis, dispatch/combine become all_to_alls
+    moe_experts: int = 0
+    moe_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_mesh: Any = None
 
     @nn.compact
     def __call__(self, x):
@@ -53,6 +60,19 @@ class Block(nn.Module):
         x = x + dense(e, name="proj")(attn.reshape(b, s, e))
 
         y = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32)(x)
+        if self.moe_experts:
+            from tritonk8ssupervisor_tpu.models.moe import MoEMLP
+
+            y = MoEMLP(
+                num_experts=self.moe_experts,
+                mlp_ratio=self.mlp_ratio,
+                k=self.moe_k,
+                capacity_factor=self.moe_capacity_factor,
+                dtype=self.dtype,
+                mesh=self.moe_mesh,
+                name="moe_mlp",
+            )(y)
+            return x + y
         y = dense(self.mlp_ratio * e, name="mlp_up")(y)
         y = nn.gelu(y)
         x = x + dense(e, name="mlp_down")(y)
@@ -73,6 +93,21 @@ class TransformerLM(nn.Module):
     # dtype of the returned logits; see the lm_head comment below for
     # why bf16 is the default (float32 restores the r03 head)
     logits_dtype: Any = jnp.bfloat16
+    # moe_experts > 0 makes every `moe_every`-th block (the 2nd, 4th, ...
+    # — the GShard placement) a mixture-of-experts block; the router aux
+    # losses land in the "moe_losses" collection, which
+    # parallel/train.make_lm_train_step folds into the optimized loss
+    moe_experts: int = 0
+    moe_every: int = 2
+    moe_k: int = 2
+    moe_capacity_factor: float = 1.25
+    # mesh to pin the MoE expert layout against (models/moe.py
+    # _constraint_mesh); optional
+    moe_mesh: Any = None
+    # rematerialise each block in the backward (jax.checkpoint): trades
+    # recompute FLOPs for activation bytes — the long-context lever when
+    # saved per-layer activations dominate HBM
+    remat_blocks: bool = False
 
     @nn.compact
     def __call__(self, tokens, train: bool = True):
@@ -91,12 +126,22 @@ class TransformerLM(nn.Module):
             jnp.float32,
         )
         x = tok + pos[:s].astype(self.dtype)
-        for _ in range(self.num_layers):
-            x = Block(
+        block_cls = nn.remat(Block) if self.remat_blocks else Block
+        for i in range(self.num_layers):
+            moe_here = self.moe_experts and (i + 1) % self.moe_every == 0
+            # explicit Block_i names pin the tree across the remat A/B
+            # (nn.remat would auto-name "CheckpointBlock_i") and match
+            # what parallel/pipeline.py slices by name
+            x = block_cls(
                 num_heads=self.num_heads,
                 attention_fn=self.attention_fn,
                 mlp_ratio=self.mlp_ratio,
                 dtype=self.dtype,
+                moe_experts=self.moe_experts if moe_here else 0,
+                moe_k=self.moe_k,
+                moe_capacity_factor=self.moe_capacity_factor,
+                moe_mesh=self.moe_mesh,
+                name=f"Block_{i}",
             )(x)
         x = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32)(x)
         # bf16 logits: at LM vocab the logits are the program's biggest

@@ -281,14 +281,30 @@ def _ring_shard_zigzag(q, k, v, *, axis_name: str, axes):
 def _resolve_batch_axis(
     mesh: Mesh, axis_name: str, batch_axis, batch: int | None
 ):
-    """Default the batch axis to the mesh's data axis when it exists, is
-    distinct from the ring axis, and divides the batch (a None batch
-    skips the divisibility check — used when the batch isn't known)."""
+    """Default the batch axis to the mesh's batch axes (data, plus expert
+    when that axis exists with size > 1 — non-MoE layers treat expert
+    parallelism as extra batch parallelism, mesh.batch_axes) when they
+    exist, are distinct from the ring axis, and divide the batch (a None
+    batch skips the divisibility check — used when the batch isn't
+    known)."""
     if batch_axis != "auto":
         return batch_axis
-    if DATA_AXIS in mesh.axis_names and DATA_AXIS != axis_name:
-        if batch is None or batch % mesh.shape[DATA_AXIS] == 0:
-            return DATA_AXIS
+    from tritonk8ssupervisor_tpu.parallel.mesh import EXPERT_AXIS
+
+    cands = tuple(
+        a
+        for a in (DATA_AXIS, EXPERT_AXIS)
+        if a in mesh.axis_names
+        and a != axis_name
+        and (a == DATA_AXIS or mesh.shape[a] > 1)
+    )
+    if not cands or DATA_AXIS not in cands:
+        return None
+    degree = 1
+    for a in cands:
+        degree *= mesh.shape[a]
+    if batch is None or batch % degree == 0:
+        return cands if len(cands) > 1 else cands[0]
     return None
 
 
@@ -312,7 +328,12 @@ def ring_attention(
     """
     n = mesh.shape[axis_name]
     batch_axis = _resolve_batch_axis(mesh, axis_name, batch_axis, q.shape[0])
-    axes = (axis_name,) if batch_axis is None else (batch_axis, axis_name)
+    if batch_axis is None:
+        axes = (axis_name,)
+    elif isinstance(batch_axis, tuple):
+        axes = (*batch_axis, axis_name)
+    else:
+        axes = (batch_axis, axis_name)
     if causal and (q.shape[1] // n) % 2 == 0:
         body = functools.partial(
             _ring_shard_zigzag, axis_name=axis_name, axes=axes

@@ -1,12 +1,21 @@
 """Device mesh construction and sharding rules.
 
 Axes:
-  "data"  — batch parallelism; gradients are psum-reduced across it by XLA
-            (the only strategy the benchmark *requires* per SURVEY.md §2.5).
-  "model" — tensor parallelism for wide parameters (classifier head, wide
-            convs); kept in the mesh so larger models slot in without
-            re-plumbing (SURVEY.md §2.5: "written so other strategies can
-            slot in").
+  "data"   — batch parallelism; gradients are psum-reduced across it by XLA
+             (the only strategy the benchmark *requires* per SURVEY.md §2.5).
+  "expert" — expert parallelism for mixture-of-experts layers
+             (models/moe.py): expert-indexed parameters shard their leading
+             expert dim here, and the MoE dispatch/combine einsums become
+             XLA all_to_alls between the batch layout and the expert layout.
+             For every non-MoE layer the axis is extra batch parallelism —
+             batch shards over ("data", "expert") jointly (GShard-style), so
+             an expert axis of 1 (the default) degrades to the plain mesh.
+  "pipe"   — pipeline parallelism (parallel/pipeline.py): layer-stage
+             parameters shard their leading stage dim here; activations hop
+             stage-to-stage over ICI via ppermute in a microbatched schedule.
+  "model"  — tensor parallelism for wide parameters (classifier head, wide
+             convs) and the ring-attention sequence axis; innermost, so its
+             collectives ride the fastest ICI links.
 
 On a real slice the mesh axes ride ICI (device order from
 jax.devices() preserves torus locality); across hosts XLA routes the same
@@ -15,6 +24,7 @@ collectives over DCN after jax.distributed.initialize (distributed.py).
 
 from __future__ import annotations
 
+import math
 from typing import Any, Sequence
 
 import jax
@@ -22,35 +32,77 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 DATA_AXIS = "data"
+EXPERT_AXIS = "expert"
+PIPE_AXIS = "pipe"
 MODEL_AXIS = "model"
 
 
 def make_mesh(
     devices: Sequence[Any] | None = None,
     model_parallelism: int = 1,
+    expert_parallelism: int = 1,
+    pipeline_parallelism: int = 1,
 ) -> Mesh:
-    """A (data, model) mesh over `devices` (default: all global devices).
+    """A (data, expert, pipe, model) mesh over `devices` (default: all
+    global devices).
 
-    model_parallelism must divide the device count; the rest is data.
+    The named parallelism degrees must divide the device count; the rest
+    is data. All degrees default to 1, in which case the extra axes are
+    size-1 and every sharding rule degrades to plain data parallelism.
     """
     devices = list(devices) if devices is not None else list(jax.devices())
     n = len(devices)
-    if model_parallelism < 1 or n % model_parallelism:
+    denom = model_parallelism * expert_parallelism * pipeline_parallelism
+    if (
+        model_parallelism < 1
+        or expert_parallelism < 1
+        or pipeline_parallelism < 1
+        or n % denom
+    ):
         raise ValueError(
-            f"model_parallelism={model_parallelism} does not divide "
-            f"device count {n}"
+            f"parallelism degrees model={model_parallelism} "
+            f"expert={expert_parallelism} pipe={pipeline_parallelism} "
+            f"do not divide device count {n}"
         )
-    grid = np.asarray(devices).reshape(n // model_parallelism, model_parallelism)
-    return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
+    grid = np.asarray(devices).reshape(
+        n // denom, expert_parallelism, pipeline_parallelism, model_parallelism
+    )
+    return Mesh(grid, (DATA_AXIS, EXPERT_AXIS, PIPE_AXIS, MODEL_AXIS))
+
+
+def batch_axes(mesh: Mesh) -> tuple[str, ...]:
+    """The mesh axes the batch dim shards over: ("data", "expert") when
+    both exist — non-MoE layers treat expert parallelism as extra data
+    parallelism — restricted to axes the mesh actually has, so manually
+    built (data, model) meshes keep working."""
+    return tuple(
+        a for a in (DATA_AXIS, EXPERT_AXIS) if a in mesh.axis_names
+    )
+
+
+def batch_degree(mesh: Mesh) -> int:
+    """Number of batch shards: the product of the batch axes' sizes."""
+    return math.prod(mesh.shape[a] for a in batch_axes(mesh))
 
 
 def batch_sharding(mesh: Mesh, ndim: int = 4) -> NamedSharding:
-    """Shard the leading (batch) dim over "data"; replicate the rest."""
-    return NamedSharding(mesh, P(DATA_AXIS, *([None] * (ndim - 1))))
+    """Shard the leading (batch) dim over the batch axes; replicate the rest."""
+    return NamedSharding(mesh, P(batch_axes(mesh), *([None] * (ndim - 1))))
 
 
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
+
+
+def _is_expert_param(path) -> bool:
+    """True for parameters that carry a leading expert dim: anything under
+    a module/param name containing "expert" (models/moe.py names its
+    per-expert kernels that way)."""
+    for entry in path:
+        name = getattr(entry, "key", getattr(entry, "name", None))
+        if isinstance(name, str) and "expert" in name.lower():
+            return True
+    return False
 
 
 def param_shardings(
@@ -60,18 +112,41 @@ def param_shardings(
 ) -> Any:
     """Sharding tree for a parameter pytree.
 
-    Rule: shard the last (output-feature) axis of any array over "model"
-    when it divides evenly and the array is big enough to be worth the
-    collective; replicate everything else. With model_parallelism == 1
-    this degrades to pure replication — classic data parallelism, where
-    XLA turns the `jit` gradient sum into a psum over "data".
+    Rules:
+    - Expert-indexed parameters (tree path contains "expert", leading dim
+      divisible by the expert axis) shard dim 0 over "expert"; their last
+      dim additionally shards over "model" when it divides — ep and tp
+      compose on the same kernel.
+    - Otherwise, shard the last (output-feature) axis of any array over
+      "model" when it divides evenly and the array is big enough to be
+      worth the collective; replicate everything else. With
+      model_parallelism == 1 this degrades to pure replication — classic
+      data parallelism, where XLA turns the `jit` gradient sum into a
+      psum over the batch axes.
     """
-    model_size = mesh.shape[MODEL_AXIS]
+    model_size = mesh.shape.get(MODEL_AXIS, 1)
+    expert_size = mesh.shape.get(EXPERT_AXIS, 1)
 
-    def rule(x):
+    def rule(path, x):
+        if not hasattr(x, "ndim"):
+            return NamedSharding(mesh, P())
+        if (
+            expert_size > 1
+            and x.ndim >= 2
+            and _is_expert_param(path)
+            and x.shape[0] % expert_size == 0
+        ):
+            spec = [EXPERT_AXIS] + [None] * (x.ndim - 1)
+            if (
+                model_size > 1
+                and x.ndim >= 3
+                and x.shape[-1] % model_size == 0
+                and x.size >= min_shard_size
+            ):
+                spec[-1] = MODEL_AXIS
+            return NamedSharding(mesh, P(*spec))
         if (
             model_size > 1
-            and hasattr(x, "ndim")
             and x.ndim >= 2
             and x.shape[-1] % model_size == 0
             and x.size >= min_shard_size
@@ -80,4 +155,4 @@ def param_shardings(
             return NamedSharding(mesh, P(*spec))
         return NamedSharding(mesh, P())
 
-    return jax.tree_util.tree_map(rule, params)
+    return jax.tree_util.tree_map_with_path(rule, params)

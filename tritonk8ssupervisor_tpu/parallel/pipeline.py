@@ -1,0 +1,314 @@
+"""Pipeline parallelism over the mesh's "pipe" axis.
+
+TPU-first shape (the shard_map + ppermute schedule from the public
+scaling playbook, re-derived for this mesh — NOT a port; the reference
+framework has no parallelism code at all, SURVEY.md §2.5):
+
+- Layer-stage parameters shard their leading stage dim over "pipe":
+  device p holds only stage p's weights. Activations hop p -> p+1 over
+  ICI via lax.ppermute — the only pipeline communication, one microbatch
+  per tick.
+- The schedule is the classic GPipe fill-and-drain: with M microbatches
+  and P stages, a lax.scan runs M + P - 1 ticks; stage 0 feeds a fresh
+  microbatch each tick while earlier microbatches march down the
+  stages. Everything is static-shaped — the scan, the ppermute ring and
+  the output buffer compile to one XLA while-loop.
+- Data parallelism composes orthogonally: the microbatch batch dim
+  stays sharded over the mesh's batch axes inside the shard_map, and
+  the gradient psum over those axes is inserted by shard_map's
+  transpose exactly where the jit path gets it from XLA.
+
+`pipeline_apply` is the generic primitive (any stage_fn); the LM
+helpers below run TransformerLM's block stack through it so the same
+model family covers dp / tp / sp / ep / pp on one mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tritonk8ssupervisor_tpu.parallel import mesh as mesh_lib
+from tritonk8ssupervisor_tpu.parallel import train as train_lib
+from tritonk8ssupervisor_tpu.parallel.mesh import PIPE_AXIS
+from tritonk8ssupervisor_tpu.parallel.train import TrainState, shard_map
+
+
+def pipeline_apply(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_params: Any,
+    microbatches: jax.Array,
+    mesh,
+    axis: str = PIPE_AXIS,
+):
+    """Run microbatches through a P-stage pipeline sharded over `axis`.
+
+    Args:
+      stage_fn: (params_for_one_stage, x) -> y; pure, same x/y shape
+        (a residual-block stack). Applied by every stage to its own
+        parameter slice.
+      stage_params: pytree whose leaves lead with the stage dim P
+        (sharded over `axis` — device p computes with slice p).
+      microbatches: (M, mb, ...) — M microbatches; the mb (batch) dim
+        may additionally be sharded over the mesh's batch axes.
+      mesh: the device mesh; mesh.shape[axis] == P must divide nothing
+        further — each stage is one shard of `axis`.
+
+    Returns (M, mb, ...) outputs of the final stage, microbatch i the
+    result of stage_{P-1}(...stage_0(microbatches[i])).
+    """
+    num_stages = mesh.shape[axis]
+    num_micro = microbatches.shape[0]
+    batch = mesh_lib.batch_axes(mesh)
+
+    def per_device(params, mb):
+        # params: leaves (1, ...) — this device's stage; mb: (M, mb_shard, ...)
+        params = jax.tree_util.tree_map(lambda x: x[0], params)
+        stage = jax.lax.axis_index(axis)
+        is_first = stage == 0
+        is_last = stage == num_stages - 1
+        ticks = num_micro + num_stages - 1
+
+        def tick(carry, t):
+            recv, outputs = carry
+            feed_idx = jnp.minimum(t, num_micro - 1)
+            x_in = jnp.where(
+                is_first,
+                jax.lax.dynamic_index_in_dim(mb, feed_idx, 0, keepdims=False),
+                recv,
+            )
+            y = stage_fn(params, x_in)
+            # the last stage finishes microbatch t-(P-1) at tick t;
+            # earlier ticks write garbage at slot 0, overwritten at
+            # t = P-1 (writes land in increasing slot order)
+            out_idx = jnp.maximum(t - (num_stages - 1), 0)
+            outputs = jax.lax.dynamic_update_index_in_dim(
+                outputs, y, out_idx, 0
+            )
+            # hop to the next stage; stage 0 receives zeros (unused — it
+            # always feeds fresh microbatches)
+            recv = jax.lax.ppermute(
+                y, axis, [(i, i + 1) for i in range(num_stages - 1)]
+            )
+            return (recv, outputs), None
+
+        zero = jnp.zeros(mb.shape[1:], mb.dtype)
+        outputs = jnp.zeros(mb.shape, mb.dtype)
+        (_, outputs), _ = jax.lax.scan(
+            tick, (zero, outputs), jnp.arange(ticks)
+        )
+        # every device carries an output buffer; only the last stage's is
+        # the pipeline's result. Emit (1, M, mb, ...) per device -> the
+        # caller reads stage P-1's slice; masking the rest keeps the
+        # gathered array unambiguous.
+        outputs = jnp.where(is_last, outputs, jnp.zeros_like(outputs))
+        return outputs[None]
+
+    mb_spec = P(None, batch, *([None] * (microbatches.ndim - 2)))
+    out_spec = P(axis, None, batch, *([None] * (microbatches.ndim - 2)))
+    params_spec = jax.tree_util.tree_map(
+        lambda x: P(axis, *([None] * (x.ndim - 1))), stage_params
+    )
+    fn = shard_map(
+        per_device,
+        mesh=mesh,
+        in_specs=(params_spec, mb_spec),
+        out_specs=out_spec,
+    )
+    stacked = fn(stage_params, microbatches)  # (P, M, mb, ...)
+    return stacked[num_stages - 1]
+
+
+# ----------------------------------------------------- LM over the pipeline
+
+
+def stack_block_params(params: dict, num_layers: int) -> Any:
+    """TransformerLM's per-layer Block_i subtrees stacked into one tree
+    with a leading (num_layers,) dim — the layout pipeline stages slice.
+    Inverse: unstack_block_params."""
+    per_layer = [params[f"Block_{i}"] for i in range(num_layers)]
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *per_layer)
+
+
+def unstack_block_params(stacked: Any, num_layers: int) -> dict:
+    return {
+        f"Block_{i}": jax.tree_util.tree_map(lambda x, i=i: x[i], stacked)
+        for i in range(num_layers)
+    }
+
+
+def lm_stage_fn(block_module, remat: bool = False) -> Callable:
+    """Stage function for pipeline_apply: scan a stage's stacked layer
+    params (L_per_stage, ...) through one Block module. `remat`
+    checkpoints each layer so the backward recomputes block internals
+    instead of storing them — the same lever as the dense model's
+    remat_blocks flag."""
+
+    def apply_layer(layer_params, h):
+        return block_module.apply({"params": layer_params}, h)
+
+    if remat:
+        apply_layer = jax.checkpoint(apply_layer)
+
+    def run(stage_params, x):
+        def body(h, layer_params):
+            return apply_layer(layer_params, h), None
+
+        out, _ = jax.lax.scan(body, x, stage_params)
+        return out
+
+    return run
+
+
+def pipelined_lm_params(model, params: dict, mesh, axis: str = PIPE_AXIS):
+    """Split a TransformerLM parameter tree for pipeline execution.
+
+    Returns (outer, stages, shardings): `outer` keeps the embedding /
+    final-norm / head params (data-parallel, replicated), `stages` is
+    the block stack reshaped to (P, L/P, ...) with dim 0 sharded over
+    the pipe axis. Raises when the axis doesn't divide the layer count.
+    """
+    num_stages = mesh.shape[axis]
+    n = model.num_layers
+    if n % num_stages:
+        raise ValueError(
+            f"num_layers={n} not divisible by pipeline stages {num_stages}"
+        )
+    outer = {k: v for k, v in params.items() if not k.startswith("Block_")}
+    stacked = stack_block_params(params, n)
+    stages = jax.tree_util.tree_map(
+        lambda x: x.reshape((num_stages, n // num_stages) + x.shape[1:]),
+        stacked,
+    )
+    stage_sh = jax.tree_util.tree_map(
+        lambda x: NamedSharding(mesh, P(axis, *([None] * (x.ndim - 1)))),
+        stages,
+    )
+    outer_sh = jax.tree_util.tree_map(
+        lambda x: NamedSharding(mesh, P()), outer
+    )
+    return outer, stages, {"outer": outer_sh, "stages": stage_sh}
+
+
+def make_pp_lm_forward(
+    model, mesh, num_microbatches: int, axis: str = PIPE_AXIS
+) -> Callable:
+    """(outer, stages, tokens) -> logits: TransformerLM with its block
+    stack pipelined over `axis`.
+
+    Embedding and head are data-parallel (replicated params, batch-
+    sharded activations) outside the pipeline; the block stack — where
+    the depth lives — runs through pipeline_apply. The standalone
+    module applications reuse the exact nn.Embed/LayerNorm/Dense math
+    of models/transformer.py, so a dense-LM checkpoint converts with
+    pipelined_lm_params and computes the same function.
+    """
+    from tritonk8ssupervisor_tpu.models.transformer import Block
+
+    block = Block(
+        num_heads=model.num_heads,
+        attention_fn=model.attention_fn,
+        mlp_ratio=model.mlp_ratio,
+        dtype=model.dtype,
+    )
+    stage = lm_stage_fn(block, remat=model.remat_blocks)
+    embed_mod = nn.Embed(
+        model.vocab_size, model.embed_dim, dtype=model.dtype,
+        param_dtype=jnp.float32,
+    )
+    norm_mod = nn.LayerNorm(dtype=model.dtype, param_dtype=jnp.float32)
+    head_mod = nn.Dense(
+        model.vocab_size, dtype=model.logits_dtype, param_dtype=jnp.float32
+    )
+
+    def forward(outer, stages, tokens):
+        b, s = tokens.shape
+        m = num_microbatches
+        if b % m:
+            raise ValueError(f"batch {b} not divisible by microbatches {m}")
+        x = embed_mod.apply({"params": outer["tok_embed"]}, tokens)
+        x = x + outer["pos_embed"][:s].astype(model.dtype)
+        mb = x.reshape(m, b // m, s, x.shape[-1])
+        y = pipeline_apply(stage, stages, mb, mesh, axis)
+        x = y.reshape(b, s, x.shape[-1])
+        x = norm_mod.apply({"params": outer["LayerNorm_0"]}, x)
+        return head_mod.apply({"params": outer["lm_head"]}, x)
+
+    return forward
+
+
+def pp_state_shardings(tree: Any, mesh, axis: str = PIPE_AXIS) -> Any:
+    """Shardings for a pp TrainState (or any pytree of it): leaves under
+    a "stages" key whose leading dim equals the pipe-axis size shard
+    there; everything else replicates. Path-based, so the optimizer's
+    momentum (which mirrors the params tree under optax's state) gets
+    the same layout as the parameters it tracks."""
+    num_stages = mesh.shape[axis]
+
+    def rule(path, x):
+        names = {
+            getattr(e, "key", getattr(e, "name", None)) for e in path
+        }
+        if (
+            "stages" in names
+            and hasattr(x, "ndim")
+            and x.ndim >= 1
+            and x.shape[0] == num_stages
+        ):
+            return NamedSharding(mesh, P(axis, *([None] * (x.ndim - 1))))
+        return NamedSharding(mesh, P())
+
+    return jax.tree_util.tree_map_with_path(rule, tree)
+
+
+def create_pp_lm_state(
+    model, rng: jax.Array, sample_tokens, mesh, tx,
+    axis: str = PIPE_AXIS,
+):
+    """TrainState for the pipelined LM, born sharded (stages over the
+    pipe axis). params = {"outer": ..., "stages": (P, L/P, ...)}."""
+
+    def init_fn(rng):
+        tokens = jnp.zeros(sample_tokens.shape, sample_tokens.dtype)
+        variables = model.init(rng, tokens, train=False)
+        outer, stages, _ = pipelined_lm_params(
+            model, variables["params"], mesh, axis
+        )
+        params = {"outer": outer, "stages": stages}
+        return TrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            batch_stats={},
+            opt_state=tx.init(params),
+        )
+
+    shapes = jax.eval_shape(init_fn, rng)
+    shardings = pp_state_shardings(shapes, mesh, axis)
+    state = jax.jit(init_fn, out_shardings=shardings)(rng)
+    return state, shardings
+
+
+def make_pp_lm_train_step(
+    model, tx, mesh, state_shardings,
+    num_microbatches: int,
+    axis: str = PIPE_AXIS,
+    metrics_fn: Callable | None = None,
+):
+    """Causal-LM train step with the block stack pipelined: (state,
+    tokens) -> (state, metrics). A thin forward_fn plug into
+    train.make_lm_train_step, so loss masking, metrics, and the
+    optimizer step are the SAME code as the dense path — only the
+    forward differs."""
+    forward = make_pp_lm_forward(model, mesh, num_microbatches, axis)
+
+    def forward_fn(params, tokens):
+        return forward(params["outer"], params["stages"], tokens), {}
+
+    return train_lib.make_lm_train_step(
+        model, tx, mesh, state_shardings,
+        metrics_fn=metrics_fn, forward_fn=forward_fn,
+    )

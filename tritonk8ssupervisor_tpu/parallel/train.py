@@ -80,27 +80,27 @@ def _shard_loss_over_data(loss_fn: Callable, mesh) -> Callable:
     partition or silently all-gather the full (global_batch, classes)
     logits; shard_map pins the kernel to each device's batch shard —
     collectives-free, since the loss is pointwise per example."""
-    if mesh.shape[mesh_lib.DATA_AXIS] == 1 or not is_pallas_loss(loss_fn):
+    if mesh_lib.batch_degree(mesh) == 1 or not is_pallas_loss(loss_fn):
         return loss_fn
-    data = mesh_lib.DATA_AXIS
+    batch = mesh_lib.batch_axes(mesh)
     return shard_map(
         loss_fn,
         mesh=mesh,
-        in_specs=(P(data, None), P(data)),
-        out_specs=P(data),
+        in_specs=(P(batch, None), P(batch)),
+        out_specs=P(batch),
     )
 
 
 def _shard_metrics_over_data(metrics_fn: Callable, mesh) -> Callable:
     """_shard_loss_over_data for the (losses, correct) pair."""
-    if mesh.shape[mesh_lib.DATA_AXIS] == 1 or not is_pallas_loss(metrics_fn):
+    if mesh_lib.batch_degree(mesh) == 1 or not is_pallas_loss(metrics_fn):
         return metrics_fn
-    data = mesh_lib.DATA_AXIS
+    batch = mesh_lib.batch_axes(mesh)
     return shard_map(
         metrics_fn,
         mesh=mesh,
-        in_specs=(P(data, None), P(data)),
-        out_specs=(P(data), P(data)),
+        in_specs=(P(batch, None), P(batch)),
+        out_specs=(P(batch), P(batch)),
     )
 
 
@@ -172,7 +172,7 @@ def make_train_step(
     slower than per-step dispatch (the async queue already pipelines), so
     the benchmark defaults to 1.
     """
-    data = mesh_lib.DATA_AXIS
+    batch = mesh_lib.batch_axes(mesh)
     model_ax = mesh_lib.MODEL_AXIS
     tp = mesh.shape.get(model_ax, 1) > 1
     if loss_fn is not None and metrics_fn is not None:
@@ -203,8 +203,8 @@ def make_train_step(
                 vocab_parallel_cross_entropy, axis_name=model_ax
             ),
             mesh=mesh,
-            in_specs=(P(data, model_ax), P(data)),
-            out_specs=(P(data), P(data)),
+            in_specs=(P(batch, model_ax), P(batch)),
+            out_specs=(P(batch), P(batch)),
         )
         dp_metrics = _shard_metrics_over_data(_default_metrics_fn(), mesh)
         tp_size = mesh.shape[model_ax]
@@ -254,9 +254,8 @@ def make_train_step(
         return new_state, {"loss": loss, "accuracy": accuracy}
 
     fn = _maybe_chain_steps(step, steps_per_call)
-    data = mesh_lib.DATA_AXIS
-    image_sh = NamedSharding(mesh, P(data, None, None, None))
-    label_sh = NamedSharding(mesh, P(data))
+    image_sh = NamedSharding(mesh, P(batch, None, None, None))
+    label_sh = NamedSharding(mesh, P(batch))
     metric_sh = NamedSharding(mesh, P())
     return jax.jit(
         fn,
@@ -290,6 +289,7 @@ def make_lm_train_step(
     seq_axis: str | None = None,
     loss_fn: Callable | None = None,
     metrics_fn: Callable | None = None,
+    forward_fn: Callable | None = None,
 ):
     """Causal-LM train step: (state, tokens) -> (state, metrics).
 
@@ -307,6 +307,11 @@ def make_lm_train_step(
     the fused pair kernel on TPU) computes loss and accuracy in one pass
     over the logits; a plain `loss_fn` is still accepted for custom
     losses, paying a separate argmax for the accuracy metric.
+
+    `forward_fn` ((params, tokens) -> (logits, sown_collections))
+    replaces the default model.apply — the hook parallel/pipeline.py
+    uses to run the block stack through the ppermute pipeline while
+    sharing this factory's loss masking, metrics and optimizer step.
     """
     if loss_fn is not None and metrics_fn is not None:
         raise ValueError("pass loss_fn or metrics_fn, not both")
@@ -318,9 +323,10 @@ def make_lm_train_step(
     else:
         pair_fn = metrics_fn or _default_metrics_fn()
         pallas = is_pallas_loss(pair_fn)
-    data = mesh_lib.DATA_AXIS
+    batch = mesh_lib.batch_axes(mesh)
     shard_the_loss = pallas and (
-        mesh.shape[data] > 1 or (seq_axis and mesh.shape[seq_axis] > 1)
+        mesh_lib.batch_degree(mesh) > 1
+        or (seq_axis and mesh.shape[seq_axis] > 1)
     )
 
     def local_token_losses(logits, targets):
@@ -329,8 +335,8 @@ def make_lm_train_step(
         return losses.reshape(b, s), correct.reshape(b, s)
 
     if shard_the_loss:
-        spec3 = P(data, seq_axis, None)
-        spec2 = P(data, seq_axis)
+        spec3 = P(batch, seq_axis, None)
+        spec2 = P(batch, seq_axis)
         token_losses = shard_map(
             local_token_losses,
             mesh=mesh,
@@ -340,8 +346,18 @@ def make_lm_train_step(
     else:
         token_losses = local_token_losses
 
+    if forward_fn is None:
+        # "moe_losses" collects the router load-balance/z losses MoE
+        # layers sow (models/moe.py); for dense models it's empty and
+        # the apply is identical to the plain form.
+        def forward_fn(params, tokens):
+            return model.apply(
+                {"params": params}, tokens, train=True,
+                mutable=["moe_losses"],
+            )
+
     def compute_loss(params, tokens):
-        logits = model.apply({"params": params}, tokens, train=True)
+        logits, sown = forward_fn(params, tokens)
         # next-token targets; the wrapped position s-1 is masked out below
         targets = jnp.roll(tokens, -1, axis=1)
         losses, correct = token_losses(logits, targets)
@@ -350,11 +366,15 @@ def make_lm_train_step(
         denom = tokens.shape[0] * (s - 1)
         loss = jnp.where(mask[None, :], losses, 0.0).sum() / denom
         accuracy = jnp.where(mask[None, :], correct, False).sum() / denom
-        return loss, accuracy
+        aux = sum(
+            jnp.sum(leaf)
+            for leaf in jax.tree_util.tree_leaves(sown.get("moe_losses", {}))
+        )
+        return loss + aux, (loss, accuracy)
 
     def step(state: TrainState, tokens):
         grad_fn = jax.value_and_grad(compute_loss, has_aux=True)
-        (loss, accuracy), grads = grad_fn(state.params, tokens)
+        (_, (loss, accuracy)), grads = grad_fn(state.params, tokens)
         updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         new_state = TrainState(
@@ -365,7 +385,7 @@ def make_lm_train_step(
         )
         return new_state, {"loss": loss, "accuracy": accuracy}
 
-    token_sh = NamedSharding(mesh, P(mesh_lib.DATA_AXIS, seq_axis))
+    token_sh = NamedSharding(mesh, P(batch, seq_axis))
     metric_sh = NamedSharding(mesh, P())
     return jax.jit(
         step,
