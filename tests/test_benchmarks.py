@@ -152,3 +152,18 @@ def test_bench_py_driver_contract():
         for key in ("metric", "value", "unit", "vs_baseline", "step_ms"):
             assert key in b, b
         assert b["value"] > 0
+
+
+@pytest.mark.slow
+def test_decode_benchmark_smoke():
+    """Tiny decode benchmark end to end on CPU (the serving-side
+    measurement surface)."""
+    from tritonk8ssupervisor_tpu.benchmarks import decode as db
+
+    result = db.run_benchmark(
+        vocab_size=128, num_layers=2, num_heads=2, embed_dim=32,
+        prompt_len=8, new_tokens=8, batch=2, repeats=1,
+    )
+    assert result["decode_tokens_per_sec"] > 0
+    assert result["ms_per_token_per_stream"] > 0
+    assert result["batch"] == 2

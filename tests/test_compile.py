@@ -1,5 +1,6 @@
 import json
 
+import pytest
 import yaml
 
 from tritonk8ssupervisor_tpu.config import compile as cc
@@ -346,3 +347,45 @@ def test_write_manifests_includes_workload_set(tmp_path):
     # without the flag, no workload files appear
     plain = cc.write_manifests(config, tmp_path / "plain")
     assert not [p for p in plain if "workload" in p.name]
+
+
+def test_benchmark_job_workload_and_flags():
+    """--bench-workload lm + --bench-flags put the LM module and the
+    parallelism knobs into the Job command (both image branches), so
+    ring/MoE/pipeline configurations deploy onto the provisioned pool."""
+    flags = ("--sequence-parallelism", "4")
+    job = cc.to_benchmark_job(cfg(), workload="lm", bench_flags=flags)
+    [container] = job["spec"]["template"]["spec"]["containers"]
+    script = container["command"][-1]  # bash -c self-install string
+    assert "tritonk8ssupervisor_tpu.benchmarks.lm" in script
+    assert "--sequence-parallelism 4" in script
+    assert "benchmarks.resnet50" not in script
+
+    job = cc.to_benchmark_job(
+        cfg(), image="gcr.io/proj/bench:1", workload="lm",
+        bench_flags=("--moe-experts", "8", "--expert-parallelism", "4"),
+    )
+    [container] = job["spec"]["template"]["spec"]["containers"]
+    assert container["command"][:3] == [
+        "python", "-m", "tritonk8ssupervisor_tpu.benchmarks.lm"
+    ]
+    assert container["command"][3:] == [
+        "--json", "--moe-experts", "8", "--expert-parallelism", "4"
+    ]
+
+    with pytest.raises(ValueError, match="workload"):
+        cc.to_benchmark_job(cfg(), workload="bert")
+
+
+def test_benchmark_job_rejects_checkpoint_dir_for_decode():
+    """--checkpoint-dir + --bench-workload decode must fail at manifest
+    compile time, not as a crash-looping Job (decode's argparse has no
+    such flag)."""
+    with pytest.raises(ValueError, match="not supported by the 'decode'"):
+        cc.to_benchmark_job(cfg(), workload="decode",
+                            checkpoint_dir="gs://b/p")
+    # training workloads keep accepting it
+    job = cc.to_benchmark_job(cfg(), workload="vit",
+                              checkpoint_dir="gs://b/p")
+    script = job["spec"]["template"]["spec"]["containers"][0]["command"][-1]
+    assert "--model vit" in script and "--checkpoint-dir" in script

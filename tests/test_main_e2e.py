@@ -303,3 +303,27 @@ def test_checkpoint_dir_flows_into_manifests(fake_world, capsys):
     script = job["spec"]["template"]["spec"]["containers"][0]["command"][-1]
     assert "--checkpoint-dir gs://bkt/ckpt/slice-0" in script
     assert "gcsfs" in script
+
+
+def test_bench_workload_and_flags_reach_manifest(fake_world, capsys):
+    """--bench-workload lm --bench-flags "...": the compiled Job manifest
+    must invoke the LM module with the parallelism knobs (the path by
+    which sp/ep/pp configurations deploy onto the provisioned pool)."""
+    work, _ = fake_world
+    config_path = saved_config(work, MODE="gke", TOPOLOGY="2x2",
+                               CLUSTER_NAME="stub-cluster")
+    rc = main([
+        "--yes", "--config", str(config_path), "--workdir", str(work),
+        "--bench-workload", "lm",
+        "--bench-flags", "--seq-len 8192 --sequence-parallelism 4",
+    ])
+    assert rc == 0, capsys.readouterr().out
+    import yaml
+
+    job = yaml.safe_load(
+        (work / "manifests" / "generated" / "bench-job-0.yaml").read_text()
+    )
+    [container] = job["spec"]["template"]["spec"]["containers"]
+    script = container["command"][-1]  # bash -c self-install string
+    assert "tritonk8ssupervisor_tpu.benchmarks.lm" in script
+    assert "--seq-len 8192 --sequence-parallelism 4" in script

@@ -1,0 +1,108 @@
+"""ViT family: forward contract, training on the mesh through the same
+step factory as ResNet, and the shared levers (MoE MLPs, remat)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tritonk8ssupervisor_tpu.models import ViT
+from tritonk8ssupervisor_tpu.parallel import batch_sharding, make_mesh
+from tritonk8ssupervisor_tpu.parallel import train as train_lib
+
+
+def _tiny_vit(**kw):
+    defaults = dict(
+        num_classes=10, patch_size=8, num_layers=2, num_heads=2,
+        embed_dim=32, dtype=jnp.float32,
+    )
+    defaults.update(kw)
+    return ViT(**defaults)
+
+
+def test_vit_forward_contract():
+    model = _tiny_vit()
+    x = jnp.ones((2, 32, 32, 3), jnp.float32)
+    variables = model.init(jax.random.key(0), x, train=False)
+    logits = model.apply(variables, x, train=False)
+    assert logits.shape == (2, 10)
+    assert logits.dtype == jnp.float32  # head stays f32 for the softmax
+    # 32/8 = 4x4 patches + CLS
+    assert variables["params"]["pos_embed"].shape == (17, 32)
+    assert "batch_stats" not in variables  # norm-free (LayerNorm only)
+
+
+def test_vit_rejects_non_dividing_patch():
+    model = _tiny_vit()
+    with pytest.raises(ValueError, match="not divisible"):
+        model.init(jax.random.key(0), jnp.ones((1, 30, 30, 3)), train=False)
+
+
+@pytest.mark.slow
+def test_vit_train_step_on_mesh():
+    """ViT trains through make_train_step (no batch_stats — the step
+    factory must tolerate stat-free models) on the 8-device mesh."""
+    mesh = make_mesh()
+    model = _tiny_vit()
+    tx = train_lib.default_optimizer(learning_rate=0.05)
+    sample = jax.ShapeDtypeStruct((16, 32, 32, 3), jnp.float32)
+    state, shardings = train_lib.create_train_state(
+        model, jax.random.key(0), sample, mesh, tx
+    )
+    step = train_lib.make_train_step(model, tx, mesh, shardings)
+    images = jax.device_put(
+        jax.random.normal(jax.random.key(1), (16, 32, 32, 3)),
+        batch_sharding(mesh, 4),
+    )
+    labels = jax.device_put(
+        jax.random.randint(jax.random.key(2), (16,), 0, 10),
+        batch_sharding(mesh, 1),
+    )
+    before = np.asarray(state.params["Block_0"]["qkv"]["kernel"])
+    state, metrics = step(state, images, labels)
+    assert np.isfinite(float(metrics["loss"]))
+    after = np.asarray(state.params["Block_0"]["qkv"]["kernel"])
+    assert not np.array_equal(before, after)
+
+
+@pytest.mark.slow
+def test_vit_moe_aux_losses_fold_into_objective():
+    """A MoE ViT must fold the router losses into the optimized loss
+    (make_train_step's moe_losses collection), changing the update."""
+    mesh = make_mesh(devices=jax.devices()[:1])
+    tx = train_lib.default_optimizer(learning_rate=0.05)
+    sample = jax.ShapeDtypeStruct((4, 32, 32, 3), jnp.float32)
+    images = jax.random.normal(jax.random.key(1), (4, 32, 32, 3))
+    labels = jax.random.randint(jax.random.key(2), (4,), 0, 10)
+
+    model = _tiny_vit(moe_experts=4, moe_every=2)
+    variables = model.init(jax.random.key(0), images, train=False)
+    assert "expert_up_kernel" in variables["params"]["Block_1"]["moe_mlp"]
+    state, shardings = train_lib.create_train_state(
+        model, jax.random.key(0), sample, mesh, tx
+    )
+    step = train_lib.make_train_step(model, tx, mesh, shardings)
+    state, metrics = step(state, images, labels)
+    assert np.isfinite(float(metrics["loss"]))
+    # the router params must receive gradient through the aux loss: a
+    # pure CE objective gives the router zero grad when capacity drops
+    # nothing changes the output... the lb loss always does
+    router_before = np.asarray(
+        variables["params"]["Block_1"]["moe_mlp"]["router_kernel"]
+    )
+    router_after = np.asarray(
+        state.params["Block_1"]["moe_mlp"]["router_kernel"]
+    )
+    assert not np.array_equal(router_before, router_after)
+
+
+@pytest.mark.slow
+def test_vit_remat_matches_plain():
+    model = _tiny_vit()
+    model_rm = _tiny_vit(remat_blocks=True)
+    x = jax.random.normal(jax.random.key(0), (2, 32, 32, 3))
+    variables = model.init(jax.random.key(1), x, train=False)
+    a = model.apply(variables, x, train=False)
+    b = model_rm.apply(variables, x, train=False)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                               rtol=1e-6, atol=1e-7)

@@ -1,0 +1,144 @@
+"""Autoregressive decode throughput benchmark (tokens/sec, ms/token).
+
+The serving-side companion to the training benchmarks: measures
+KV-cache generation (models/decode.py) for the GPT-2-small-class LM the
+training benchmark uses, so the same checkpoint's serving behavior has
+a regression-guarded number next to its training throughput.
+
+Measurement discipline: `generate` is one jitted dispatch (prefill +
+a lax.scan over decode steps), so the fence is a device fetch of the
+generated tokens; `repeats` independent timed calls give a min/median
+spread. Decode is bandwidth-bound (every step re-reads the KV cache and
+the weights), so tokens/sec scales with batch until the cache read
+saturates HBM — the batch sweep below is the interesting axis.
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+from tritonk8ssupervisor_tpu.models import TransformerLM
+from tritonk8ssupervisor_tpu.models import decode as dec
+
+
+def run_benchmark(
+    vocab_size: int = 32768,
+    num_layers: int = 12,
+    num_heads: int = 12,
+    embed_dim: int = 768,
+    prompt_len: int = 128,
+    new_tokens: int = 512,
+    batch: int = 8,
+    temperature: float = 0.0,
+    repeats: int = 3,
+) -> dict:
+    max_len = prompt_len + new_tokens
+    model = TransformerLM(
+        vocab_size=vocab_size,
+        num_layers=num_layers,
+        num_heads=num_heads,
+        embed_dim=embed_dim,
+        max_seq_len=max_len,
+    )
+    prompt = jax.random.randint(
+        jax.random.key(0), (batch, prompt_len), 0, vocab_size
+    )
+    params = model.init(jax.random.key(1), prompt, train=False)["params"]
+
+    fn = jax.jit(
+        functools.partial(
+            dec.generate,
+            model,
+            max_new_tokens=new_tokens,
+            temperature=temperature,
+            max_len=max_len,
+        )
+    )
+    rng = jax.random.key(2)
+
+    def timed_call():
+        # fence with a HOST FETCH of the generated tokens, not
+        # block_until_ready: through the tunneled backend the latter can
+        # return before execution completes (the same reason
+        # utils/perf.timed_windows fences on a loss fetch) — a fetch
+        # cannot lie about whether the tokens exist
+        start = time.monotonic()
+        out = fn(params, prompt=prompt, rng=rng)
+        out = jax.device_get(out)
+        elapsed = time.monotonic() - start
+        assert out.shape == (batch, new_tokens)
+        return elapsed
+
+    compile_seconds = timed_call()
+    times = sorted(timed_call() for _ in range(repeats))
+    median = times[len(times) // 2]
+    total_tokens = batch * new_tokens
+    return {
+        "model": "transformer_lm_decode",
+        "platform": jax.default_backend(),
+        "batch": batch,
+        "prompt_len": prompt_len,
+        "new_tokens": new_tokens,
+        "temperature": temperature,
+        "decode_tokens_per_sec": total_tokens / median,
+        "ms_per_token_per_stream": median / new_tokens * 1000,
+        "seconds_median": median,
+        "seconds_min": times[0],
+        "compile_seconds": compile_seconds,
+    }
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--vocab-size", type=int, default=32768)
+    parser.add_argument("--num-layers", type=int, default=12)
+    parser.add_argument("--num-heads", type=int, default=12)
+    parser.add_argument("--embed-dim", type=int, default=768)
+    parser.add_argument("--prompt-len", type=int, default=128)
+    parser.add_argument("--new-tokens", type=int, default=512)
+    parser.add_argument("--batch", type=int, default=8)
+    parser.add_argument("--temperature", type=float, default=0.0)
+    parser.add_argument("--repeats", type=int, default=3)
+    parser.add_argument("--json", action="store_true")
+    return parser
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    # multi-host rendezvous when the Job/ansible env provides coordinates
+    # (same contract as the training benchmarks; no-ops on a single host)
+    from tritonk8ssupervisor_tpu.parallel import initialize_from_env
+
+    initialize_from_env()
+    result = run_benchmark(
+        vocab_size=args.vocab_size,
+        num_layers=args.num_layers,
+        num_heads=args.num_heads,
+        embed_dim=args.embed_dim,
+        prompt_len=args.prompt_len,
+        new_tokens=args.new_tokens,
+        batch=args.batch,
+        temperature=args.temperature,
+        repeats=args.repeats,
+    )
+    if args.json:
+        print(json.dumps(result, sort_keys=True))
+    else:
+        print(
+            f"decode on {result['platform']}: batch {result['batch']}, "
+            f"{result['decode_tokens_per_sec']:.0f} tok/s, "
+            f"{result['ms_per_token_per_stream']:.2f} ms/token/stream "
+            f"(prompt {result['prompt_len']}, {result['new_tokens']} new, "
+            f"compile {result['compile_seconds']:.1f}s)"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -26,7 +26,7 @@ import jax.numpy as jnp
 
 from tritonk8ssupervisor_tpu.utils import perf
 
-from tritonk8ssupervisor_tpu.models import ResNet18, ResNet50
+from tritonk8ssupervisor_tpu.models import ResNet18, ResNet50, ViT
 from tritonk8ssupervisor_tpu.parallel import (
     batch_sharding,
     initialize_from_env,
@@ -35,7 +35,9 @@ from tritonk8ssupervisor_tpu.parallel import (
 from tritonk8ssupervisor_tpu.parallel import train as train_lib
 from tritonk8ssupervisor_tpu.parallel import mesh as mesh_lib
 
-MODELS = {"resnet50": ResNet50, "resnet18": ResNet18}
+# all image-classifier families share this benchmark's harness; "vit"
+# is ViT-S/16 (models/vit.py), the transformer vision family
+MODELS = {"resnet50": ResNet50, "resnet18": ResNet18, "vit": ViT}
 
 
 def run_benchmark(
@@ -82,10 +84,15 @@ def run_benchmark(
             f"({steps_per_call})"
         )
 
-    model = MODELS[model_name](
-        num_classes=num_classes, fused_1x1_bwd=fused_1x1_bwd,
-        remat_blocks=remat,
-    )
+    model_kwargs = {"num_classes": num_classes, "remat_blocks": remat}
+    if model_name.startswith("resnet"):
+        model_kwargs["fused_1x1_bwd"] = fused_1x1_bwd
+    elif fused_1x1_bwd:
+        raise ValueError(
+            "--fused-1x1-bwd is a ResNet lever (pallas conv backward); "
+            f"{model_name} has no 1x1 convolutions"
+        )
+    model = MODELS[model_name](**model_kwargs)
     tx = train_lib.default_optimizer(learning_rate=learning_rate)
     # bf16 input halves the first conv's HBM read (the model computes in
     # bf16 regardless); measured +4% throughput (106 vs 110 ms/step) on v5e

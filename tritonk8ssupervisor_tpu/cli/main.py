@@ -98,6 +98,25 @@ def build_parser() -> argparse.ArgumentParser:
         "writes DIR/slice-N). Also read from TK8S_CHECKPOINT_DIR.",
     )
     parser.add_argument(
+        "--bench-workload",
+        choices=sorted(compiler.BENCH_WORKLOADS),
+        default=os.environ.get("TK8S_BENCH_WORKLOAD") or "resnet50",
+        help="benchmark family for the generated Job: resnet50 (the "
+        "flagship), vit (transformer vision), lm (long-context "
+        "Transformer — combine with --bench-flags for ring/MoE/pipeline "
+        "parallelism), or decode (KV-cache serving throughput). Also "
+        "read from TK8S_BENCH_WORKLOAD.",
+    )
+    parser.add_argument(
+        "--bench-flags",
+        default=os.environ.get("TK8S_BENCH_FLAGS") or "",
+        metavar="FLAGS",
+        help="extra flags appended to the benchmark Job's module "
+        "invocation, shell-style (e.g. \"--sequence-parallelism 4\" or "
+        "\"--moe-experts 8 --expert-parallelism 4\"). Also read from "
+        "TK8S_BENCH_FLAGS.",
+    )
+    parser.add_argument(
         "--workload-image",
         default=None,
         metavar="IMAGE",
@@ -279,6 +298,10 @@ def provision(args, paths: state.RunPaths, prompter: Prompter) -> int:
         job_kwargs = {"image": args.bench_image} if args.bench_image else {}
         if args.checkpoint_dir:
             job_kwargs["checkpoint_dir"] = args.checkpoint_dir
+        if args.bench_workload != "resnet50":
+            job_kwargs["workload"] = args.bench_workload
+        if args.bench_flags:
+            job_kwargs["bench_flags"] = tuple(shlex.split(args.bench_flags))
         if args.workload_image:
             job_kwargs["workload_image"] = args.workload_image
             job_kwargs["workload_command"] = shlex.split(

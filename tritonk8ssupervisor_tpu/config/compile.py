@@ -218,6 +218,21 @@ def write_ansible_configs(
 
 # -------------------------------------------------------------- k8s manifests
 
+# benchmark families deployable as the cluster Job (--bench-workload):
+# name -> (module, flags the name implies). "vit" rides the image-
+# training harness with its model selector; "decode" is the serving-
+# side KV-cache generation benchmark.
+BENCH_WORKLOADS = {
+    "resnet50": ("tritonk8ssupervisor_tpu.benchmarks.resnet50", ()),
+    "vit": ("tritonk8ssupervisor_tpu.benchmarks.resnet50",
+            ("--model", "vit")),
+    "lm": ("tritonk8ssupervisor_tpu.benchmarks.lm", ()),
+    "decode": ("tritonk8ssupervisor_tpu.benchmarks.decode", ()),
+}
+# workloads whose module accepts --checkpoint-dir (training runs that
+# save/resume state; decode generates, nothing to checkpoint)
+CHECKPOINTABLE_WORKLOADS = {"resnet50", "vit", "lm"}
+
 
 def bench_command(module: str = "tritonk8ssupervisor_tpu.benchmarks.resnet50",
                   extra_args: tuple[str, ...] = ("--json",),
@@ -400,15 +415,42 @@ def to_benchmark_job(
     command: list[str] | None = None,
     slice_index: int = 0,
     checkpoint_dir: str = "",
+    workload: str = "resnet50",
+    bench_flags: tuple[str, ...] = (),
 ) -> dict:
-    """ResNet-50 benchmark as an Indexed Job spanning every host of a slice.
+    """Training benchmark as an Indexed Job spanning every host of a slice.
 
     This is the TPU-native re-expression of the reference's benchmark
     container workload (docs/benchmarks.md:1-4) and its node-join logic
     (rancherhost/tasks/main.yml:26-34): instead of a rancher/agent phoning
     home, K8s schedules one pod per TPU host; the completion index + a
     headless service give jax.distributed.initialize its coordinator.
+
+    `workload` picks the benchmark family ("resnet50" — the flagship —
+    or "lm", the long-context Transformer); `bench_flags` append raw
+    module flags, which is how the parallelism knobs reach the cluster
+    (e.g. ("--sequence-parallelism", "4") or ("--moe-experts", "8",
+    "--expert-parallelism", "4") — benchmarks/lm.py validates the
+    combinations at startup, so a bad set fails the Job loudly on the
+    first pod log line rather than silently running something else).
     """
+    if workload not in BENCH_WORKLOADS:
+        raise ValueError(
+            f"workload={workload!r}: expected one of "
+            f"{sorted(BENCH_WORKLOADS)}"
+        )
+    module, implied_flags = BENCH_WORKLOADS[workload]
+    bench_flags = (*implied_flags, *bench_flags)
+    if checkpoint_dir and workload not in CHECKPOINTABLE_WORKLOADS:
+        # caught here, at manifest compile time, because the module's
+        # argparse would otherwise reject --checkpoint-dir on every pod
+        # and the Job would burn its whole restart budget on a
+        # guaranteed-failing command
+        raise ValueError(
+            f"--checkpoint-dir is not supported by the {workload!r} "
+            f"workload (training workloads only: "
+            f"{sorted(CHECKPOINTABLE_WORKLOADS)})"
+        )
     spec = config.spec
     topo = config.parsed_topology
     chips_on_host = spec.chips_on_host(topo)
@@ -424,7 +466,7 @@ def to_benchmark_job(
             "checkpoint_dir only applies to the generated benchmark "
             "command; bake the flag into the explicit `command` instead"
         )
-    bench_args: tuple[str, ...] = ("--json",)
+    bench_args: tuple[str, ...] = ("--json", *bench_flags)
     extra_packages: tuple[str, ...] = ()
     if checkpoint_dir:
         slice_dir = checkpoint_dir.rstrip("/") + f"/slice-{slice_index}"
@@ -440,15 +482,11 @@ def to_benchmark_job(
     self_install = command is None and image == BENCH_IMAGE_DEFAULT
     if command is None:
         command = (
-            ["bash", "-c", bench_command(extra_args=bench_args,
+            ["bash", "-c", bench_command(module=module,
+                                         extra_args=bench_args,
                                          extra_packages=extra_packages)]
             if self_install
-            else [
-                "python",
-                "-m",
-                "tritonk8ssupervisor_tpu.benchmarks.resnet50",
-                *bench_args,
-            ]
+            else ["python", "-m", module, *bench_args]
         )
     container = {
         "name": "bench",

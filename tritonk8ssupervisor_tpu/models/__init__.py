@@ -10,5 +10,8 @@ TPU VM slice and as a K8s Job (config/compile.py to_benchmark_job).
 from tritonk8ssupervisor_tpu.models.moe import MoEMLP
 from tritonk8ssupervisor_tpu.models.resnet import ResNet, ResNet18, ResNet50
 from tritonk8ssupervisor_tpu.models.transformer import TransformerLM
+from tritonk8ssupervisor_tpu.models.vit import ViT
 
-__all__ = ["MoEMLP", "ResNet", "ResNet18", "ResNet50", "TransformerLM"]
+__all__ = [
+    "MoEMLP", "ResNet", "ResNet18", "ResNet50", "TransformerLM", "ViT",
+]

@@ -1,0 +1,210 @@
+"""Autoregressive decoding with a KV cache for TransformerLM.
+
+The serving-side counterpart of the training stack: prefill runs the
+prompt through one full-sequence forward (MXU-shaped matmuls) while
+writing each layer's K/V into a static-shape cache; decode then steps
+one token at a time inside a single `lax.scan` — every step is the same
+compiled program (static cache length, masked attention against the
+cache), so the whole generation is ONE dispatch, no per-token Python.
+
+TPU-first choices:
+- The cache is (layers stacked implicitly per-dict, batch, max_len,
+  heads, head_dim) bf16, allocated once; positions beyond `pos` are
+  masked with -inf rather than sliced — static shapes keep XLA's tiling
+  and avoid recompilation per step.
+- Single-token attention is a (1, t)·(t, d) contraction — bandwidth
+  bound by the cache read, the canonical decode regime; batching
+  decodes amortises it (measured in benchmarks/lm.py --decode).
+- Greedy or temperature sampling, both inside the scan
+  (jax.random.categorical on the fly; keys split per step).
+
+Parameter layout is models/transformer.py's tree verbatim (Block_i/qkv,
+proj, mlp_up, mlp_down, LayerNorm_0/1, tok_embed, pos_embed,
+LayerNorm_0, lm_head) — a trained/checkpointed LM decodes without any
+conversion. Equivalence with the training forward is pinned by
+tests/test_decode.py (greedy continuation == stepwise argmax of the
+full forward).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def init_kv_cache(model, batch: int, max_len: int) -> dict:
+    """Zeroed per-layer K/V cache: {Block_i: {k, v: (B, L, H, D)}} bf16."""
+    head_dim = model.embed_dim // model.num_heads
+    shape = (batch, max_len, model.num_heads, head_dim)
+    return {
+        f"Block_{i}": {
+            "k": jnp.zeros(shape, jnp.bfloat16),
+            "v": jnp.zeros(shape, jnp.bfloat16),
+        }
+        for i in range(model.num_layers)
+    }
+
+
+def _ln(p, x, dtype):
+    return nn.LayerNorm(dtype=dtype, param_dtype=jnp.float32).apply(
+        {"params": p}, x
+    )
+
+
+def _dense(p, x, features, dtype):
+    return nn.Dense(features, dtype=dtype, param_dtype=jnp.float32).apply(
+        {"params": p}, x
+    )
+
+
+def _block_with_cache(bp, x, cache_kv, pos, num_heads, mlp_ratio, dtype,
+                      prefill: bool):
+    """One transformer block over `x` ((B, S, E); S = prompt len in
+    prefill, 1 in decode), reading/writing the layer cache.
+
+    prefill=True: causal attention within x + cache write at [0, S).
+    prefill=False: x is one token at position `pos`; attention runs
+    against cache[0..pos] (static length, masked), cache written at pos.
+    """
+    b, s, e = x.shape
+    head_dim = e // num_heads
+    y = _ln(bp["LayerNorm_0"], x, dtype)
+    qkv = _dense(bp["qkv"], y, 3 * e, dtype)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(b, s, num_heads, head_dim)
+    k = k.reshape(b, s, num_heads, head_dim)
+    v = v.reshape(b, s, num_heads, head_dim)
+
+    if prefill:
+        new_k = jax.lax.dynamic_update_slice(
+            cache_kv["k"], k.astype(jnp.bfloat16), (0, 0, 0, 0)
+        )
+        new_v = jax.lax.dynamic_update_slice(
+            cache_kv["v"], v.astype(jnp.bfloat16), (0, 0, 0, 0)
+        )
+        # causal attention within the prompt — same arithmetic order as
+        # ops/ring_attention.attention_reference (the training forward)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(
+            head_dim
+        ).astype(q.dtype)
+        mask = jnp.tril(jnp.ones((s, s), bool))
+        scores = jnp.where(mask[None, None], scores, NEG_INF)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+    else:
+        new_k = jax.lax.dynamic_update_slice(
+            cache_kv["k"], k.astype(jnp.bfloat16), (0, pos, 0, 0)
+        )
+        new_v = jax.lax.dynamic_update_slice(
+            cache_kv["v"], v.astype(jnp.bfloat16), (0, pos, 0, 0)
+        )
+        max_len = new_k.shape[1]
+        scores = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, new_k.astype(q.dtype)
+        ) / jnp.sqrt(head_dim).astype(q.dtype)
+        valid = jnp.arange(max_len) <= pos  # static shape, masked tail
+        scores = jnp.where(valid[None, None, None], scores, NEG_INF)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+        attn = jnp.einsum(
+            "bhqk,bkhd->bqhd", probs.astype(dtype), new_v.astype(dtype)
+        )
+
+    x = x + _dense(bp["proj"], attn.reshape(b, s, e), e, dtype)
+    y = _ln(bp["LayerNorm_1"], x, dtype)
+    y = _dense(bp["mlp_up"], y, mlp_ratio * e, dtype)
+    y = nn.gelu(y)
+    x = x + _dense(bp["mlp_down"], y, e, dtype)
+    return x, {"k": new_k, "v": new_v}
+
+
+def _embed(params, tokens, pos_start, model):
+    emb = params["tok_embed"]["embedding"]
+    x = jnp.take(emb, tokens, axis=0).astype(model.dtype)
+    s = tokens.shape[1]
+    pos = jax.lax.dynamic_slice_in_dim(
+        params["pos_embed"], pos_start, s, axis=0
+    )
+    return x + pos.astype(model.dtype)
+
+
+def _head(params, x, model):
+    x = _ln(params["LayerNorm_0"], x, model.dtype)
+    return _dense(params["lm_head"], x, model.vocab_size, jnp.float32)
+
+
+def prefill(model, params, tokens, max_len: int):
+    """Run the prompt (B, S) through the stack, filling a length-max_len
+    cache. Returns (cache, last_logits (B, vocab))."""
+    b, s = tokens.shape
+    if s > max_len:
+        raise ValueError(f"prompt length {s} exceeds cache length {max_len}")
+    cache = init_kv_cache(model, b, max_len)
+    x = _embed(params, tokens, 0, model)
+    for i in range(model.num_layers):
+        name = f"Block_{i}"
+        x, cache[name] = _block_with_cache(
+            params[name], x, cache[name], 0,
+            model.num_heads, model.mlp_ratio, model.dtype, prefill=True,
+        )
+    logits = _head(params, x[:, -1:], model)
+    return cache, logits[:, 0]
+
+
+def generate(
+    model,
+    params,
+    prompt: jax.Array,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    rng: jax.Array | None = None,
+    max_len: int | None = None,
+) -> jax.Array:
+    """Greedy (temperature=0) or sampled continuation of `prompt` (B, S).
+
+    Returns (B, max_new_tokens) int32. jit-able end to end; the decode
+    loop is one lax.scan (one compiled step reused for every token).
+    """
+    b, s = prompt.shape
+    max_len = max_len or model.max_seq_len
+    if max_len > model.max_seq_len:
+        # past max_seq_len there are no position embeddings; the
+        # dynamic slice would silently clamp and reuse the last window
+        raise ValueError(
+            f"max_len {max_len} exceeds model.max_seq_len "
+            f"{model.max_seq_len} (no position embeddings past it)"
+        )
+    if s + max_new_tokens > max_len:
+        raise ValueError(
+            f"prompt {s} + new {max_new_tokens} exceeds cache {max_len}"
+        )
+    if temperature > 0 and rng is None:
+        raise ValueError("sampling (temperature > 0) needs an rng key")
+    cache, logits = prefill(model, params, prompt, max_len)
+    rng = rng if rng is not None else jax.random.key(0)
+
+    def pick(logits, key):
+        if temperature > 0:
+            return jax.random.categorical(key, logits / temperature, axis=-1)
+        return jnp.argmax(logits, axis=-1)
+
+    def step(carry, key):
+        cache, logits, pos = carry
+        token = pick(logits, key).astype(jnp.int32)  # (B,)
+        x = _embed(params, token[:, None], pos, model)
+        for i in range(model.num_layers):
+            name = f"Block_{i}"
+            x, cache[name] = _block_with_cache(
+                params[name], x, cache[name], pos,
+                model.num_heads, model.mlp_ratio, model.dtype, prefill=False,
+            )
+        logits = _head(params, x, model)[:, 0]
+        return (cache, logits, pos + 1), token
+
+    keys = jax.random.split(rng, max_new_tokens)
+    (_, _, _), tokens = jax.lax.scan(step, (cache, logits, s), keys)
+    return tokens.T  # (B, max_new_tokens)

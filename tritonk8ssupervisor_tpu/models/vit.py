@@ -1,0 +1,107 @@
+"""Vision Transformer (ViT) classifier — the third model family.
+
+Reuses the LM's transformer Block (models/transformer.py) with
+bidirectional attention, so every attention strategy and parallelism
+lever the LM has (dense/flash kernels, tensor-sharded wide params,
+remat, MoE MLPs) applies to vision with zero extra wiring. TPU layout
+notes: patchify is one stride-P conv (a single MXU matmul over the
+patch pixels); embed widths stay multiples of 128 (lane width); compute
+bf16, params f32, classifier head f32 for the softmax — the same
+discipline as the other families.
+
+The reference framework has no model code (SURVEY.md §2.5); this family
+exists so the zoo covers the standard vision-transformer recipe next to
+the conv (ResNet) and language (TransformerLM/MoE) families.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from tritonk8ssupervisor_tpu.models.transformer import Block
+from tritonk8ssupervisor_tpu.ops.ring_attention import attention_reference
+
+
+def bidirectional_attention(q, k, v, causal: bool = False):
+    """ViT attention: every patch attends to every patch. The Block
+    passes causal=True; ignore it — classification has no causal order."""
+    return attention_reference(q, k, v, causal=False)
+
+
+class ViT(nn.Module):
+    """images (B, H, W, C) -> logits (B, num_classes).
+
+    Standard recipe: patchify conv -> [CLS] token + learned positions ->
+    pre-norm transformer blocks -> final norm -> take [CLS] -> linear
+    head. ViT-S/16-class defaults sized so CPU tests stay fast when
+    shrunk and the 224x224 configuration is real.
+    """
+
+    num_classes: int = 1000
+    patch_size: int = 16
+    num_layers: int = 12
+    num_heads: int = 6
+    embed_dim: int = 384
+    mlp_ratio: int = 4
+    dtype: Any = jnp.bfloat16
+    attention_fn: Any = bidirectional_attention
+    # same levers as TransformerLM (see its field comments)
+    moe_experts: int = 0
+    moe_every: int = 2
+    moe_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_mesh: Any = None
+    remat_blocks: bool = False
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        b, h, w, _ = x.shape
+        p = self.patch_size
+        if h % p or w % p:
+            raise ValueError(f"image {h}x{w} not divisible by patch {p}")
+        # patchify: one stride-p conv == per-patch linear projection
+        x = nn.Conv(
+            self.embed_dim, (p, p), strides=(p, p), padding="VALID",
+            dtype=self.dtype, param_dtype=jnp.float32, name="patch_embed",
+        )(x.astype(self.dtype))
+        x = x.reshape(b, -1, self.embed_dim)  # (B, patches, D)
+        n = x.shape[1]
+
+        cls = self.param(
+            "cls_token", nn.initializers.zeros_init(), (1, 1, self.embed_dim),
+            jnp.float32,
+        )
+        x = jnp.concatenate(
+            [jnp.broadcast_to(cls.astype(self.dtype), (b, 1, self.embed_dim)), x],
+            axis=1,
+        )
+        pos = self.param(
+            "pos_embed", nn.initializers.normal(0.02),
+            (n + 1, self.embed_dim), jnp.float32,
+        )
+        x = x + pos.astype(self.dtype)
+
+        block_cls = nn.remat(Block) if self.remat_blocks else Block
+        for i in range(self.num_layers):
+            moe_here = self.moe_experts and (i + 1) % self.moe_every == 0
+            x = block_cls(
+                num_heads=self.num_heads,
+                attention_fn=self.attention_fn,
+                mlp_ratio=self.mlp_ratio,
+                dtype=self.dtype,
+                moe_experts=self.moe_experts if moe_here else 0,
+                moe_k=self.moe_k,
+                moe_capacity_factor=self.moe_capacity_factor,
+                moe_mesh=self.moe_mesh,
+                name=f"Block_{i}",
+            )(x)
+
+        x = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32)(x)
+        # classification reads the [CLS] position; logits f32 for the loss
+        return nn.Dense(
+            self.num_classes, dtype=jnp.float32, param_dtype=jnp.float32,
+            name="classifier",
+        )(x[:, 0])

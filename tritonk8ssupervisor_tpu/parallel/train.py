@@ -228,18 +228,28 @@ def make_train_step(
         )
 
     def compute_loss(params, batch_stats, images, labels):
+        # mutable: batch-norm stats (absent for norm-free models like
+        # ViT) + the MoE router losses (absent for dense models) — both
+        # degrade to empty collections
         logits, updates = model.apply(
             {"params": params, "batch_stats": batch_stats},
             images,
             train=True,
-            mutable=["batch_stats"],
+            mutable=["batch_stats", "moe_losses"],
         )
         losses, correct = loss_and_correct(logits, labels)
-        return jnp.mean(losses), (updates["batch_stats"], correct)
+        aux = sum(
+            jnp.sum(leaf)
+            for leaf in jax.tree_util.tree_leaves(
+                updates.get("moe_losses", {})
+            )
+        )
+        loss = jnp.mean(losses)
+        return loss + aux, (loss, updates.get("batch_stats", {}), correct)
 
     def step(state: TrainState, images, labels):
         grad_fn = jax.value_and_grad(compute_loss, has_aux=True)
-        (loss, (new_stats, correct)), grads = grad_fn(
+        (_, (loss, new_stats, correct)), grads = grad_fn(
             state.params, state.batch_stats, images, labels
         )
         updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
