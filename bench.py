@@ -32,6 +32,9 @@ TPU_BASELINE_IMG_S_CHIP = 1000.0
 # tokens/sec/chip for the 12L/768d seq-1024 LM, as first measured on the
 # v5e in r03 (docs/benchmarks.md) — the regression-guard baseline
 TPU_BASELINE_TOK_S_CHIP = 98327.0
+# images/sec/chip for ViT-S/16 bf16 bs256, as first measured on the v5e
+# in r04 (docs/benchmarks.md) — round-over-round regression guard
+TPU_BASELINE_VIT_IMG_S_CHIP = 2612.0
 
 
 def _common_fields(result: dict) -> dict:
@@ -79,6 +82,46 @@ def resnet_record(on_tpu: bool) -> dict:
         "value": round(value, 2),
         "unit": "images/sec/chip",
         "vs_baseline": round(value / TPU_BASELINE_IMG_S_CHIP, 4),
+        **_common_fields(result),
+        "flops_per_image": result["flops_per_image"],
+    }
+
+
+def vit_record(on_tpu: bool) -> dict:
+    from tritonk8ssupervisor_tpu.benchmarks.resnet50 import run_benchmark
+
+    if on_tpu:
+        # ViT-S/16, same harness/discipline as the flagship; 2 windows
+        # (spread was 0.02 ms in the r04 measurement) keep the driver
+        # pass under a minute after compile
+        result = run_benchmark(
+            model_name="vit",
+            batch_per_chip=256,
+            image_size=224,
+            steps=100,
+            warmup=5,
+            windows=2,
+        )
+    else:
+        result = run_benchmark(
+            model_name="vit",
+            batch_per_chip=8,
+            image_size=32,
+            num_classes=100,
+            steps=3,
+            warmup=1,
+            windows=1,
+        )
+    value = result["images_per_sec_per_chip"]
+    # CPU smoke runs a different shape entirely — name the series apart
+    # so a metric-keyed guard never compares it against the v5e baseline
+    # (same contract as the LM's _smoke suffix and resnet18-vs-50)
+    name = "vit" if on_tpu else "vit_smoke"
+    return {
+        "metric": f"{name}_images_per_sec_per_chip",
+        "value": round(value, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(value / TPU_BASELINE_VIT_IMG_S_CHIP, 4),
         **_common_fields(result),
         "flops_per_image": result["flops_per_image"],
     }
@@ -138,21 +181,24 @@ def main() -> int:
     on_tpu = jax.default_backend() not in ("cpu",)
     resnet = resnet_record(on_tpu)
     families = [resnet]
-    # An LM-only failure must not discard the already-measured flagship
-    # record — the driver's four-field contract rides on ResNet.
+    # A companion-family failure must not discard the already-measured
+    # flagship record — the driver's four-field contract rides on
+    # ResNet. Failed families emit an error stub under the SAME series
+    # name the success path would use: a guard must be able to tell
+    # "failed this round" from "never ran" (e.g. r01-r03 records).
     lm_name = "transformer_lm" if on_tpu else "transformer_lm_smoke"
-    try:
-        families.append(lm_record(on_tpu))
-    except Exception as exc:  # noqa: BLE001 - report, don't lose the flagship
-        print(f"lm benchmark failed ({exc!r}); emitting flagship only",
-              file=sys.stderr)
-        # machine-readable absence under the SAME series name the
-        # success path would use: a guard must be able to tell "failed
-        # this round" from "never ran" (e.g. r01-r03 records)
-        families.append({
-            "metric": f"{lm_name}_tokens_per_sec_per_chip",
-            "error": repr(exc),
-        })
+    vit_name = "vit" if on_tpu else "vit_smoke"
+    companions = [
+        (f"{lm_name}_tokens_per_sec_per_chip", lm_record),
+        (f"{vit_name}_images_per_sec_per_chip", vit_record),
+    ]
+    for series, record_fn in companions:
+        try:
+            families.append(record_fn(on_tpu))
+        except Exception as exc:  # noqa: BLE001 - report, keep the flagship
+            print(f"{series} failed ({exc!r}); emitting stub",
+                  file=sys.stderr)
+            families.append({"metric": series, "error": repr(exc)})
     record = {
         # the four driver-read fields (flagship family)
         **resnet,

@@ -141,12 +141,13 @@ def test_bench_py_driver_contract():
     assert record["value"] > 0
     assert record["platform"] == "cpu"
     assert record["num_chips"] == 8
-    # both benchmark families ride the same line (r03 verdict weak #3):
-    # flagship ResNet stays top-level; the LM record joins it in the array
+    # every benchmark family rides the same line (r03 verdict weak #3):
+    # flagship ResNet stays top-level; LM + ViT join it in the array
     families = record["benchmarks"]
     assert [b["metric"] for b in families] == [
         record["metric"],
         "transformer_lm_smoke_tokens_per_sec_per_chip",
+        "vit_smoke_images_per_sec_per_chip",
     ]
     for b in families:
         for key in ("metric", "value", "unit", "vs_baseline", "step_ms"):
@@ -162,8 +163,15 @@ def test_decode_benchmark_smoke():
 
     result = db.run_benchmark(
         vocab_size=128, num_layers=2, num_heads=2, embed_dim=32,
-        prompt_len=8, new_tokens=8, batch=2, repeats=1,
+        prompt_len=8, new_tokens=8, batch=8, repeats=1,
     )
     assert result["decode_tokens_per_sec"] > 0
     assert result["ms_per_token_per_stream"] > 0
-    assert result["batch"] == 2
+    assert result["batch"] == 8
+    assert result["num_chips"] == 8  # data-parallel over the CPU mesh
+
+    with pytest.raises(ValueError, match="divisible"):
+        db.run_benchmark(
+            vocab_size=128, num_layers=1, num_heads=2, embed_dim=32,
+            prompt_len=8, new_tokens=8, batch=3, repeats=1,
+        )

@@ -93,3 +93,39 @@ def test_generate_rejects_cache_beyond_position_embeddings():
     tokens, params = _init(model)
     with pytest.raises(ValueError, match="max_seq_len"):
         dec.generate(model, params, tokens, 4, max_len=64)
+
+
+def test_greedy_decode_matches_with_bf16_logits_head():
+    """The decode head must use the model's configured logits dtype:
+    with the default bf16 head, near-tie logits round the same way in
+    decode and in the training forward, keeping argmax identical."""
+    model = TransformerLM(
+        vocab_size=97, num_layers=2, num_heads=2, embed_dim=32,
+        max_seq_len=32, dtype=jnp.float32,  # logits_dtype stays bf16
+    )
+    tokens, params = _init(model)
+    got = dec.generate(model, params, tokens, 4)
+    seq = tokens
+    for _ in range(4):
+        logits = model.apply({"params": params}, seq, train=False)
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(seq[:, 5:]))
+
+
+@pytest.mark.slow
+def test_generate_data_parallel_over_mesh_matches_single_device():
+    """Batch-sharded decode over the 8-device mesh must produce the same
+    tokens as the unsharded run (the benchmark's slice-wide mode)."""
+    from tritonk8ssupervisor_tpu.parallel import batch_sharding, make_mesh
+    from tritonk8ssupervisor_tpu.parallel.mesh import replicated
+
+    model = _model()
+    tokens, params = _init(model, batch=8)
+    want = dec.generate(model, params, tokens, 5)
+
+    mesh = make_mesh()
+    tokens_sh = jax.device_put(tokens, batch_sharding(mesh, 2))
+    params_sh = jax.device_put(params, replicated(mesh))
+    got = dec.generate(model, params_sh, tokens_sh, 5)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))

@@ -126,7 +126,9 @@ TRAIN_WORKER = textwrap.dedent(
 
     # --- the exact data-parallel step a multi-host slice runs (dp=8) ---
     mesh = make_mesh()
-    assert dict(mesh.shape) == {DATA_AXIS: 8, MODEL_AXIS: 1}, mesh.shape
+    assert dict(mesh.shape) == {
+        DATA_AXIS: 8, "expert": 1, "pipe": 1, MODEL_AXIS: 1
+    }, mesh.shape
     model = ResNet18(num_classes=10, num_filters=8)
     tx = train_lib.default_optimizer(learning_rate=0.05)
     sample = jax.ShapeDtypeStruct((16, 32, 32, 3), jnp.float32)
@@ -175,7 +177,57 @@ TRAIN_WORKER = textwrap.dedent(
     lm_loss = float(lm_metrics["loss"])
     assert np.isfinite(lm_loss), lm_loss
 
-    print(f"TRAIN OK process {env.process_id} loss {loss:.4f} lm {lm_loss:.4f}", flush=True)
+    # --- the pipelined LM step with the pipe axis SPANNING the process
+    # boundary (dp=4 x pp=2): stage 0 lives in process 0's devices,
+    # stage 1 in process 1's, activations ppermute across ---
+    from tritonk8ssupervisor_tpu.parallel import pipeline as pp_lib
+
+    mesh = make_mesh(pipeline_parallelism=2)
+    pp_model = TransformerLM(
+        vocab_size=64, num_layers=4, num_heads=4, embed_dim=32,
+        max_seq_len=16,
+    )
+    pp_state, pp_sh = pp_lib.create_pp_lm_state(
+        pp_model, jax.random.key(0), jax.ShapeDtypeStruct((8, 16), jnp.int32),
+        mesh, tx,
+    )
+    pp_step = pp_lib.make_pp_lm_train_step(
+        pp_model, tx, mesh, pp_sh, num_microbatches=2
+    )
+    from tritonk8ssupervisor_tpu.parallel.mesh import batch_axes
+    pp_tokens = global_array(
+        (8, 16), NamedSharding(mesh, P(batch_axes(mesh), None)),
+        rng.integers(0, 64, (8, 16)).astype(np.int32),
+    )
+    pp_state, pp_metrics = pp_step(pp_state, pp_tokens)
+    pp_loss = float(pp_metrics["loss"])
+    assert np.isfinite(pp_loss), pp_loss
+
+    # --- the MoE LM step with experts sharded ACROSS processes
+    # (dp=4 x ep=2): the dispatch all_to_all crosses the boundary ---
+    mesh = make_mesh(expert_parallelism=2)
+    moe = TransformerLM(
+        vocab_size=64, num_layers=2, num_heads=4, embed_dim=32,
+        max_seq_len=16, moe_experts=4, moe_every=2, moe_mesh=mesh,
+    )
+    moe_state, moe_sh = train_lib.create_train_state(
+        moe, jax.random.key(0), jax.ShapeDtypeStruct((8, 16), jnp.int32),
+        mesh, tx,
+    )
+    moe_step = train_lib.make_lm_train_step(moe, tx, mesh, moe_sh)
+    moe_tokens = global_array(
+        (8, 16), NamedSharding(mesh, P(batch_axes(mesh), None)),
+        rng.integers(0, 64, (8, 16)).astype(np.int32),
+    )
+    moe_state, moe_metrics = moe_step(moe_state, moe_tokens)
+    moe_loss = float(moe_metrics["loss"])
+    assert np.isfinite(moe_loss), moe_loss
+
+    print(
+        f"TRAIN OK process {env.process_id} loss {loss:.4f} lm {lm_loss:.4f} "
+        f"pp {pp_loss:.4f} moe {moe_loss:.4f}",
+        flush=True,
+    )
     """
 )
 
@@ -189,12 +241,14 @@ def test_two_process_rendezvous(tmp_path):
 @pytest.mark.slow
 def test_two_process_sharded_train_step():
     """The exact multi-host code path a 2-host v5e-16 slice executes,
-    actually executed: a 2-process x 4-device CPU cluster builds the
-    (data, model) mesh spanning both processes and runs one real
-    make_train_step (dp=8) and one ring-attention LM step (dp=2 x sp=4,
-    K/V ppermute hops crossing the process boundary). Round-2 VERDICT
-    missing item #3: before this, the dryrun's sharded step only ever ran
-    inside ONE process."""
+    actually executed: a 2-process x 4-device CPU cluster builds meshes
+    spanning both processes and runs one real make_train_step (dp=8), a
+    ring-attention LM step (dp=2 x sp=4, K/V ppermute hops crossing the
+    process boundary), a pipelined LM step (dp=4 x pp=2 — stage 0 in
+    process 0, stage 1 in process 1, activations ppermute across), and a
+    MoE LM step (dp=4 x ep=2 — the dispatch all_to_all crossing the
+    boundary). Round-2 VERDICT missing item #3: before this, the
+    dryrun's sharded steps only ever ran inside ONE process."""
     outputs = run_cluster(TRAIN_WORKER, devices_per_process=4)
     assert "TRAIN OK process 0" in outputs[0]
     assert "TRAIN OK process 1" in outputs[1]

@@ -25,6 +25,9 @@ import jax.numpy as jnp
 
 from tritonk8ssupervisor_tpu.models import TransformerLM
 from tritonk8ssupervisor_tpu.models import decode as dec
+from tritonk8ssupervisor_tpu.parallel import batch_sharding, make_mesh
+from tritonk8ssupervisor_tpu.parallel import mesh as mesh_lib
+from tritonk8ssupervisor_tpu.parallel.mesh import replicated
 
 
 def run_benchmark(
@@ -46,10 +49,27 @@ def run_benchmark(
         embed_dim=embed_dim,
         max_seq_len=max_len,
     )
-    prompt = jax.random.randint(
-        jax.random.key(0), (batch, prompt_len), 0, vocab_size
+    # data-parallel decode over every chip the process set sees:
+    # params replicate, the batch (and with it the KV cache, by
+    # propagation) shards over the mesh's batch axes — so a slice-wide
+    # Job measures the slice, not chip 0 with the rest idle
+    mesh = make_mesh()
+    num_chips = int(mesh.devices.size)
+    if batch % mesh_lib.batch_degree(mesh):
+        raise ValueError(
+            f"--batch {batch} must be divisible by the {num_chips}-chip "
+            "data-parallel degree (each chip decodes batch/chips streams)"
+        )
+    prompt = jax.device_put(
+        jax.random.randint(
+            jax.random.key(0), (batch, prompt_len), 0, vocab_size
+        ),
+        batch_sharding(mesh, 2),
     )
-    params = model.init(jax.random.key(1), prompt, train=False)["params"]
+    params = jax.device_put(
+        model.init(jax.random.key(1), prompt, train=False)["params"],
+        replicated(mesh),
+    )
 
     fn = jax.jit(
         functools.partial(
@@ -82,11 +102,13 @@ def run_benchmark(
     return {
         "model": "transformer_lm_decode",
         "platform": jax.default_backend(),
+        "num_chips": num_chips,
         "batch": batch,
         "prompt_len": prompt_len,
         "new_tokens": new_tokens,
         "temperature": temperature,
         "decode_tokens_per_sec": total_tokens / median,
+        "decode_tokens_per_sec_per_chip": total_tokens / median / num_chips,
         "ms_per_token_per_stream": median / new_tokens * 1000,
         "seconds_median": median,
         "seconds_min": times[0],

@@ -14,7 +14,7 @@ TPU-first choices:
   and avoid recompilation per step.
 - Single-token attention is a (1, t)·(t, d) contraction — bandwidth
   bound by the cache read, the canonical decode regime; batching
-  decodes amortises it (measured in benchmarks/lm.py --decode).
+  decodes amortises it (measured by benchmarks/decode.py).
 - Greedy or temperature sampling, both inside the scan
   (jax.random.categorical on the fly; keys split per step).
 
@@ -134,7 +134,13 @@ def _embed(params, tokens, pos_start, model):
 
 def _head(params, x, model):
     x = _ln(params["LayerNorm_0"], x, model.dtype)
-    return _dense(params["lm_head"], x, model.vocab_size, jnp.float32)
+    # the model's configured logits dtype (bf16 by default since r04),
+    # NOT hardcoded f32 — near-tie logits round differently in bf16 vs
+    # f32 and argmax would pick a different token than the training
+    # forward, breaking the token-for-token equivalence claim
+    return _dense(
+        params["lm_head"], x, model.vocab_size, model.logits_dtype
+    )
 
 
 def prefill(model, params, tokens, max_len: int):

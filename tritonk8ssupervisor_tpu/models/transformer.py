@@ -36,6 +36,11 @@ class Block(nn.Module):
     attention_fn: AttentionFn
     mlp_ratio: int
     dtype: Any
+    # causal masking flag forwarded to attention_fn: True for LMs,
+    # False for bidirectional consumers (ViT) — held here so EVERY
+    # attention strategy honors it rather than each consumer wrapping
+    # attention_fn to override it
+    causal: bool = True
     # > 0 replaces this block's dense MLP with a mixture of experts
     # (models/moe.py) — expert parameters shard over the mesh's "expert"
     # axis, dispatch/combine become all_to_alls
@@ -56,7 +61,7 @@ class Block(nn.Module):
         q = q.reshape(b, s, self.num_heads, head_dim)
         k = k.reshape(b, s, self.num_heads, head_dim)
         v = v.reshape(b, s, self.num_heads, head_dim)
-        attn = self.attention_fn(q, k, v, causal=True)
+        attn = self.attention_fn(q, k, v, causal=self.causal)
         x = x + dense(e, name="proj")(attn.reshape(b, s, e))
 
         y = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32)(x)

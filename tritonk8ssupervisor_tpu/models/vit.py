@@ -21,14 +21,7 @@ from typing import Any
 import flax.linen as nn
 import jax.numpy as jnp
 
-from tritonk8ssupervisor_tpu.models.transformer import Block
-from tritonk8ssupervisor_tpu.ops.ring_attention import attention_reference
-
-
-def bidirectional_attention(q, k, v, causal: bool = False):
-    """ViT attention: every patch attends to every patch. The Block
-    passes causal=True; ignore it — classification has no causal order."""
-    return attention_reference(q, k, v, causal=False)
+from tritonk8ssupervisor_tpu.models.transformer import Block, dense_attention
 
 
 class ViT(nn.Module):
@@ -47,7 +40,11 @@ class ViT(nn.Module):
     embed_dim: int = 384
     mlp_ratio: int = 4
     dtype: Any = jnp.bfloat16
-    attention_fn: Any = bidirectional_attention
+    # any of the LM's attention strategies plug in here; blocks run with
+    # causal=False (classification has no causal order), so the flag is
+    # honored by whichever strategy is passed rather than overridden in
+    # a wrapper
+    attention_fn: Any = dense_attention
     # same levers as TransformerLM (see its field comments)
     moe_experts: int = 0
     moe_every: int = 2
@@ -92,6 +89,7 @@ class ViT(nn.Module):
                 attention_fn=self.attention_fn,
                 mlp_ratio=self.mlp_ratio,
                 dtype=self.dtype,
+                causal=False,
                 moe_experts=self.moe_experts if moe_here else 0,
                 moe_k=self.moe_k,
                 moe_capacity_factor=self.moe_capacity_factor,

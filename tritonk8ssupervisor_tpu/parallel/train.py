@@ -60,6 +60,16 @@ class TrainState:
     opt_state: Any
 
 
+def _moe_aux_total(sown: dict) -> jax.Array | float:
+    """Sum of every router loss the MoE layers sowed into "moe_losses"
+    (models/moe.py) — 0 for dense models. Shared by both step factories
+    so the fold can never silently diverge between them."""
+    return sum(
+        jnp.sum(leaf)
+        for leaf in jax.tree_util.tree_leaves(sown.get("moe_losses", {}))
+    )
+
+
 def _default_metrics_fn() -> Callable:
     """(logits, labels) -> (losses, correct) policy for both step
     factories: the fused pair kernel on TPU — one pass over the logits
@@ -238,12 +248,7 @@ def make_train_step(
             mutable=["batch_stats", "moe_losses"],
         )
         losses, correct = loss_and_correct(logits, labels)
-        aux = sum(
-            jnp.sum(leaf)
-            for leaf in jax.tree_util.tree_leaves(
-                updates.get("moe_losses", {})
-            )
-        )
+        aux = _moe_aux_total(updates)
         loss = jnp.mean(losses)
         return loss + aux, (loss, updates.get("batch_stats", {}), correct)
 
@@ -376,10 +381,7 @@ def make_lm_train_step(
         denom = tokens.shape[0] * (s - 1)
         loss = jnp.where(mask[None, :], losses, 0.0).sum() / denom
         accuracy = jnp.where(mask[None, :], correct, False).sum() / denom
-        aux = sum(
-            jnp.sum(leaf)
-            for leaf in jax.tree_util.tree_leaves(sown.get("moe_losses", {}))
-        )
+        aux = _moe_aux_total(sown)
         return loss + aux, (loss, accuracy)
 
     def step(state: TrainState, tokens):

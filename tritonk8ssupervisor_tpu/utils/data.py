@@ -1,0 +1,86 @@
+"""Input pipeline utilities: host -> sharded device arrays, prefetched.
+
+The benchmarks generate data on device by design (they measure the
+training computation, not a host loader — docs/benchmarks.md), but a
+framework user training on real data needs the two pieces here:
+
+- `prefetch_to_mesh(it, shardings, size)` — wrap a host iterator of
+  batch pytrees; each batch is `device_put` with its sharding `size`
+  steps ahead of consumption, so the host->device copy (PCIe) overlaps
+  device compute via JAX's async dispatch. This is the standard TPU
+  input pattern: keep the copy OFF the step's critical path; the chip
+  never waits on the host unless the loader itself falls behind.
+- `global_batch_from_local(mesh, ndim, local_batch)` — multi-host
+  assembly: each process contributes only ITS shard of the global batch
+  (what a per-host data loader naturally produces) and the result is
+  one global jax.Array laid out over the mesh's batch axes.
+  Single-process it degrades to a plain sharded device_put, so the same
+  input code runs on a laptop and a pod slice.
+
+The reference framework had no data plane at all (SURVEY.md §2.5);
+these exist so training on real corpora slots into the same mesh/step
+machinery the benchmarks exercise.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Iterable, Iterator
+
+import jax
+
+from tritonk8ssupervisor_tpu.parallel import mesh as mesh_lib
+
+
+def device_put_sharded_tree(batch: Any, shardings: Any) -> Any:
+    """device_put every leaf of `batch` with the matching sharding leaf
+    (a single sharding broadcasts over the whole tree)."""
+    if isinstance(shardings, jax.sharding.Sharding):
+        return jax.device_put(batch, shardings)
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, s), batch, shardings
+    )
+
+
+def prefetch_to_mesh(
+    iterator: Iterable[Any],
+    shardings: Any,
+    size: int = 2,
+) -> Iterator[Any]:
+    """Yield batches from `iterator` as sharded device arrays, keeping up
+    to `size` transfers in flight ahead of the consumer.
+
+    `shardings` is a Sharding (applied to every leaf) or a pytree of
+    Shardings matching each batch's structure (e.g. {"images":
+    batch_sharding(mesh, 4), "labels": batch_sharding(mesh, 1)}).
+    """
+    if size < 1:
+        raise ValueError(f"prefetch size must be >= 1, got {size}")
+    queue: collections.deque = collections.deque()
+    for batch in iterator:
+        queue.append(device_put_sharded_tree(batch, shardings))
+        if len(queue) > size:
+            yield queue.popleft()
+    while queue:
+        yield queue.popleft()
+
+
+def global_batch_from_local(mesh, local_batch: Any) -> Any:
+    """Assemble a global batch-sharded jax.Array from THIS process's
+    shard (leading dim = global_batch / process_count).
+
+    Works on a pytree of mixed-rank leaves (images (B, H, W, C) next to
+    labels (B,)): each leaf gets the batch sharding at its own rank.
+    Multi-host: wraps jax.make_array_from_process_local_data — each host
+    feeds its local slice and the global array spans the mesh without
+    any host ever holding the full batch. Single-process: a plain
+    sharded device_put (identical layout, same calling code).
+    """
+
+    def one(x):
+        sharding = mesh_lib.batch_sharding(mesh, ndim=x.ndim)
+        if jax.process_count() == 1:
+            return jax.device_put(x, sharding)
+        return jax.make_array_from_process_local_data(sharding, x)
+
+    return jax.tree_util.tree_map(one, local_batch)
