@@ -175,3 +175,10 @@ def test_decode_benchmark_smoke():
             vocab_size=128, num_layers=1, num_heads=2, embed_dim=32,
             prompt_len=8, new_tokens=8, batch=3, repeats=1,
         )
+
+
+def test_lm_benchmark_rejects_grad_accum_with_pipeline():
+    from tritonk8ssupervisor_tpu.benchmarks import lm
+
+    with pytest.raises(ValueError, match="grad-accum"):
+        lm.run_benchmark(pipeline_parallelism=4, grad_accum=2)

@@ -178,11 +178,20 @@ TRAIN_WORKER = textwrap.dedent(
     assert np.isfinite(lm_loss), lm_loss
 
     # --- the pipelined LM step with the pipe axis SPANNING the process
-    # boundary (dp=4 x pp=2): stage 0 lives in process 0's devices,
-    # stage 1 in process 1's, activations ppermute across ---
+    # boundary (dp=4 x pp=2). The default device order keeps pipe groups
+    # within a process (the right production layout: hops ride ICI), so
+    # interleave the device list explicitly — each pipe pair is (process
+    # 0 device, process 1 device) and every activation hop crosses the
+    # gap ---
     from tritonk8ssupervisor_tpu.parallel import pipeline as pp_lib
 
-    mesh = make_mesh(pipeline_parallelism=2)
+    devs = jax.devices()
+    interleaved = [devs[i] for i in (0, 4, 1, 5, 2, 6, 3, 7)]
+    mesh = make_mesh(interleaved, pipeline_parallelism=2)
+    pipe_groups = mesh.devices.reshape(-1, 2)
+    assert all(
+        g[0].process_index != g[1].process_index for g in pipe_groups
+    ), "pipe stages must live in different processes for this test"
     pp_model = TransformerLM(
         vocab_size=64, num_layers=4, num_heads=4, embed_dim=32,
         max_seq_len=16,
@@ -204,8 +213,13 @@ TRAIN_WORKER = textwrap.dedent(
     assert np.isfinite(pp_loss), pp_loss
 
     # --- the MoE LM step with experts sharded ACROSS processes
-    # (dp=4 x ep=2): the dispatch all_to_all crosses the boundary ---
-    mesh = make_mesh(expert_parallelism=2)
+    # (dp=4 x ep=2): same interleaving, so each expert pair spans both
+    # processes and the dispatch all_to_all crosses the boundary ---
+    mesh = make_mesh(interleaved, expert_parallelism=2)
+    expert_groups = mesh.devices.reshape(-1, 2)
+    assert all(
+        g[0].process_index != g[1].process_index for g in expert_groups
+    ), "expert pairs must live in different processes for this test"
     moe = TransformerLM(
         vocab_size=64, num_layers=2, num_heads=4, embed_dim=32,
         max_seq_len=16, moe_experts=4, moe_every=2, moe_mesh=mesh,

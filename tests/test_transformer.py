@@ -13,7 +13,7 @@ from tritonk8ssupervisor_tpu.parallel import train as train_lib
 from tritonk8ssupervisor_tpu.parallel.mesh import MODEL_AXIS
 
 
-def tiny_lm(attention_fn=None, vocab=128, dtype=None):
+def tiny_lm(attention_fn=None, vocab=128, dtype=None, **extra):
     kwargs = dict(
         vocab_size=vocab, num_layers=2, num_heads=4, embed_dim=64,
         max_seq_len=64,
@@ -22,6 +22,7 @@ def tiny_lm(attention_fn=None, vocab=128, dtype=None):
         kwargs["attention_fn"] = attention_fn
     if dtype is not None:
         kwargs["dtype"] = dtype
+    kwargs.update(extra)
     return TransformerLM(**kwargs)
 
 
@@ -65,10 +66,13 @@ def test_ring_attention_model_matches_dense_model():
     def ring_fn(q, k, v, causal=True):
         return ring_attention(q, k, v, mesh=mesh, axis_name=MODEL_AXIS, causal=causal)
 
-    # f32 compute isolates the algorithmic comparison from bf16 noise
-    # (in bf16 the two reduction orders drift ~4e-2 over 2 layers)
-    dense = tiny_lm(dtype=jnp.float32)
-    ring = tiny_lm(attention_fn=ring_fn, dtype=jnp.float32)
+    # f32 compute AND f32 logits isolate the algorithmic comparison from
+    # bf16 noise (in bf16 the two reduction orders drift ~4e-2 over 2
+    # layers; the default bf16 head alone rounds ~1 ulp differently per
+    # compilation)
+    dense = tiny_lm(dtype=jnp.float32, logits_dtype=jnp.float32)
+    ring = tiny_lm(attention_fn=ring_fn, dtype=jnp.float32,
+                   logits_dtype=jnp.float32)
     tokens = jax.random.randint(jax.random.key(1), (2, 32), 0, 128)
     variables = dense.init(jax.random.key(0), tokens, train=False)
     out_dense = dense.apply(variables, tokens, train=False)
@@ -105,3 +109,69 @@ def test_sequence_parallel_lm_train_step():
     assert int(state.step) == 5
     assert float(metrics["loss"]) < first
     assert np.isfinite(float(metrics["accuracy"]))
+
+
+@pytest.mark.slow
+def test_grad_accum_matches_full_batch_step():
+    """grad_accum must be mathematically exact for the LM: same loss,
+    same updated params as the one-shot step on the same batch."""
+    import numpy as np
+    from tritonk8ssupervisor_tpu.parallel import batch_sharding, make_mesh
+    from tritonk8ssupervisor_tpu.parallel import train as train_lib
+
+    mesh = make_mesh()
+    model = TransformerLM(
+        vocab_size=64, num_layers=2, num_heads=2, embed_dim=32,
+        max_seq_len=16, dtype=jnp.float32, logits_dtype=jnp.float32,
+    )
+    tx = train_lib.default_optimizer(learning_rate=0.1)
+    sample = jax.ShapeDtypeStruct((8, 16), jnp.int32)
+    tokens = jax.device_put(
+        jax.random.randint(jax.random.key(1), (8, 16), 0, 64),
+        batch_sharding(mesh, 2),
+    )
+
+    results = []
+    for accum in (1, 4):
+        state, shardings = train_lib.create_train_state(
+            model, jax.random.key(0), sample, mesh, tx
+        )
+        step = train_lib.make_lm_train_step(
+            model, tx, mesh, shardings, grad_accum=accum
+        )
+        state, metrics = step(state, tokens)
+        results.append((float(metrics["loss"]),
+                        np.asarray(state.params["Block_0"]["qkv"]["kernel"])))
+
+    (loss1, p1), (loss4, p4) = results
+    np.testing.assert_allclose(loss1, loss4, rtol=1e-5)
+    np.testing.assert_allclose(p1, p4, rtol=1e-4, atol=1e-6)
+
+
+def test_lm_optimizer_recipe_trains():
+    """The AdamW + warmup-cosine + clipping recipe plugs into the same
+    step factory and moves the params."""
+    import numpy as np
+    from tritonk8ssupervisor_tpu.parallel import make_mesh
+    from tritonk8ssupervisor_tpu.parallel import train as train_lib
+
+    mesh = make_mesh(devices=jax.devices()[:1])
+    model = TransformerLM(
+        vocab_size=64, num_layers=1, num_heads=2, embed_dim=32,
+        max_seq_len=16, dtype=jnp.float32, logits_dtype=jnp.float32,
+    )
+    tx = train_lib.lm_optimizer(learning_rate=1e-3, warmup_steps=2,
+                                decay_steps=10)
+    sample = jax.ShapeDtypeStruct((2, 16), jnp.int32)
+    state, shardings = train_lib.create_train_state(
+        model, jax.random.key(0), sample, mesh, tx
+    )
+    step = train_lib.make_lm_train_step(model, tx, mesh, shardings)
+    tokens = jax.random.randint(jax.random.key(1), (2, 16), 0, 64)
+    before = np.asarray(state.params["Block_0"]["qkv"]["kernel"])
+    for _ in range(2):
+        state, metrics = step(state, tokens)
+    assert np.isfinite(float(metrics["loss"]))
+    assert not np.array_equal(
+        before, np.asarray(state.params["Block_0"]["qkv"]["kernel"])
+    )

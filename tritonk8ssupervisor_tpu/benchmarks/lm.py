@@ -45,6 +45,7 @@ def run_benchmark(
     moe_every: int = 2,
     pipeline_parallelism: int = 1,
     num_microbatches: int = 4,
+    grad_accum: int = 1,
     remat: bool = False,
     attention: str = "auto",
     learning_rate: float = 3e-2,
@@ -81,6 +82,13 @@ def run_benchmark(
         raise ValueError(
             "--pipeline-parallelism with --moe-experts is not wired: the "
             "pipeline's stage function runs the dense block"
+        )
+    if pipeline_parallelism > 1 and grad_accum > 1:
+        raise ValueError(
+            "--grad-accum with --pipeline-parallelism is not wired: the "
+            "pipeline already microbatches inside the step "
+            "(--num-microbatches); accumulation on top would need "
+            "make_pp_lm_train_step support"
         )
     if moe_experts and moe_experts % expert_parallelism:
         raise ValueError(
@@ -155,7 +163,8 @@ def run_benchmark(
             model, jax.random.key(0), sample, mesh, tx
         )
         step = train_lib.make_lm_train_step(
-            model, tx, mesh, shardings, seq_axis=seq_axis
+            model, tx, mesh, shardings, seq_axis=seq_axis,
+            grad_accum=grad_accum,
         )
 
     # Checkpoint/resume (SURVEY.md §5), same contract as the flagship:
@@ -287,6 +296,12 @@ def build_parser() -> argparse.ArgumentParser:
         "trades recompute FLOPs for activation bytes at long sequence",
     )
     parser.add_argument(
+        "--grad-accum", type=int, default=1,
+        help="accumulate gradients over this many in-step microbatches "
+        "before the optimizer update (exact for the LM; the activation-"
+        "memory lever for batches that exceed HBM)",
+    )
+    parser.add_argument(
         "--attention",
         choices=("auto", "dense", "flash"),
         default="auto",
@@ -331,6 +346,7 @@ def main(argv: list[str] | None = None) -> int:
         moe_every=args.moe_every,
         pipeline_parallelism=args.pipeline_parallelism,
         num_microbatches=args.num_microbatches,
+        grad_accum=args.grad_accum,
         remat=args.remat,
         attention=args.attention,
         checkpoint_dir=args.checkpoint_dir,

@@ -45,6 +45,16 @@ from tritonk8ssupervisor_tpu.ops.ring_attention import attention_reference
 # unfused backward (separate dq and dkv kernels) beat the fused one by
 # ~25% in the same sweep.
 _BLOCK = 512
+# Backward (dkv/dq) block rows/cols, swept separately once the r04
+# roofline showed the backward kernels at ~15% of either roofline at
+# seq 1024. Measured (seq 1024 b8 full LM step): 512 -> 62.7 ms,
+# 256 -> 73.2, 128 -> 107.3, 1024 -> 63.6 — 512 is the optimum from
+# BOTH directions, so the backward's sub-roofline rate is the kernel's
+# recompute/pipeline structure, not tiling. Overridable for sweeps via
+# TK8S_FLASH_BWD_BLOCK.
+import os
+
+_BWD_BLOCK = int(os.environ.get("TK8S_FLASH_BWD_BLOCK", "512"))
 
 
 def _splash_block(seq: int) -> int | None:
@@ -70,15 +80,24 @@ def _splash_kernel(seq: int, num_heads: int, causal: bool, block: int):
 
     mask_cls = sm.CausalMask if causal else sm.FullMask
     mask = sm.MultiHeadMask([mask_cls((seq, seq)) for _ in range(num_heads)])
+    # same constraints as the forward pick: divide seq AND stay a
+    # 128-lane multiple, else fall back to the forward block
+    bwd = (
+        _BWD_BLOCK
+        if _BWD_BLOCK
+        and seq % _BWD_BLOCK == 0
+        and _BWD_BLOCK % 128 == 0
+        else block
+    )
     block_sizes = sk.BlockSizes(
         block_q=block,
         block_kv=block,
         block_kv_compute=block,
-        block_q_dkv=block,
-        block_kv_dkv=block,
-        block_kv_dkv_compute=block,
-        block_q_dq=block,
-        block_kv_dq=block,
+        block_q_dkv=bwd,
+        block_kv_dkv=bwd,
+        block_kv_dkv_compute=bwd,
+        block_q_dq=bwd,
+        block_kv_dq=bwd,
         use_fused_bwd_kernel=False,
     )
     # The factory turns its mask-partition tables into jnp arrays. A

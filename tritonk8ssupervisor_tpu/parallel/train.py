@@ -121,6 +121,33 @@ def default_optimizer(
     return optax.sgd(learning_rate, momentum=momentum, nesterov=True)
 
 
+def lm_optimizer(
+    learning_rate: float = 3e-4,
+    warmup_steps: int = 1000,
+    decay_steps: int = 100_000,
+    weight_decay: float = 0.1,
+    b1: float = 0.9,
+    b2: float = 0.95,
+    grad_clip_norm: float = 1.0,
+) -> optax.GradientTransformation:
+    """AdamW with linear warmup -> cosine decay and global-norm gradient
+    clipping — the standard transformer-LM training recipe (the
+    benchmark keeps SGD as its default so throughput series stay
+    comparable across rounds; this is the recipe a real training run
+    plugs into the same step factories via their `tx` argument)."""
+    schedule = optax.warmup_cosine_decay_schedule(
+        init_value=0.0,
+        peak_value=learning_rate,
+        warmup_steps=warmup_steps,
+        decay_steps=decay_steps,
+        end_value=learning_rate * 0.1,
+    )
+    return optax.chain(
+        optax.clip_by_global_norm(grad_clip_norm),
+        optax.adamw(schedule, b1=b1, b2=b2, weight_decay=weight_decay),
+    )
+
+
 def create_train_state(
     model,
     rng: jax.Array,
@@ -305,6 +332,7 @@ def make_lm_train_step(
     loss_fn: Callable | None = None,
     metrics_fn: Callable | None = None,
     forward_fn: Callable | None = None,
+    grad_accum: int = 1,
 ):
     """Causal-LM train step: (state, tokens) -> (state, metrics).
 
@@ -327,6 +355,14 @@ def make_lm_train_step(
     replaces the default model.apply — the hook parallel/pipeline.py
     uses to run the block stack through the ppermute pipeline while
     sharing this factory's loss masking, metrics and optimizer step.
+
+    `grad_accum` > 1 splits the batch into that many microbatches inside
+    the step (lax.scan), accumulating gradients before the single
+    optimizer update — the activation-memory lever for batches whose
+    peak footprint exceeds HBM. Mathematically EXACT for this model
+    family (the loss is a mean over equally-sized chunks and the LM has
+    no batch statistics), unlike batch-norm models where microbatching
+    changes the normalisation.
     """
     if loss_fn is not None and metrics_fn is not None:
         raise ValueError("pass loss_fn or metrics_fn, not both")
@@ -386,7 +422,36 @@ def make_lm_train_step(
 
     def step(state: TrainState, tokens):
         grad_fn = jax.value_and_grad(compute_loss, has_aux=True)
-        (_, (loss, accuracy)), grads = grad_fn(state.params, tokens)
+        if grad_accum > 1:
+            b = tokens.shape[0]
+            if b % grad_accum:
+                raise ValueError(
+                    f"global batch {b} not divisible by grad_accum "
+                    f"{grad_accum}"
+                )
+            chunks = tokens.reshape(grad_accum, b // grad_accum, -1)
+
+            def accum(carry, chunk):
+                gsum, lsum, asum = carry
+                (_, (l, a)), g = grad_fn(state.params, chunk)
+                gsum = jax.tree_util.tree_map(jnp.add, gsum, g)
+                return (gsum, lsum + l, asum + a), None
+
+            zeros = jax.tree_util.tree_map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), state.params
+            )
+            (gsum, lsum, asum), _ = jax.lax.scan(
+                accum, (zeros, 0.0, 0.0), chunks
+            )
+            # each chunk's loss is a mean over its (equal-size) slice, so
+            # the mean of chunk means IS the full-batch mean — exact
+            grads = jax.tree_util.tree_map(
+                lambda g: (g / grad_accum).astype(jnp.float32), gsum
+            )
+            loss = lsum / grad_accum
+            accuracy = asum / grad_accum
+        else:
+            (_, (loss, accuracy)), grads = grad_fn(state.params, tokens)
         updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         new_state = TrainState(

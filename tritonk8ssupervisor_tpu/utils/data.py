@@ -10,9 +10,10 @@ framework user training on real data needs the two pieces here:
   device compute via JAX's async dispatch. This is the standard TPU
   input pattern: keep the copy OFF the step's critical path; the chip
   never waits on the host unless the loader itself falls behind.
-- `global_batch_from_local(mesh, ndim, local_batch)` — multi-host
-  assembly: each process contributes only ITS shard of the global batch
-  (what a per-host data loader naturally produces) and the result is
+- `global_batch_from_local(mesh, local_batch)` — multi-host assembly:
+  each process contributes only ITS shard of the global batch (what a
+  per-host data loader naturally produces; mixed-rank pytrees fine —
+  each leaf gets the batch sharding at its own rank) and the result is
   one global jax.Array laid out over the mesh's batch axes.
   Single-process it degrades to a plain sharded device_put, so the same
   input code runs on a laptop and a pod slice.
