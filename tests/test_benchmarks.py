@@ -182,3 +182,10 @@ def test_lm_benchmark_rejects_grad_accum_with_pipeline():
 
     with pytest.raises(ValueError, match="grad-accum"):
         lm.run_benchmark(pipeline_parallelism=4, grad_accum=2)
+
+
+def test_lm_benchmark_rejects_non_positive_grad_accum():
+    from tritonk8ssupervisor_tpu.benchmarks import lm
+
+    with pytest.raises(ValueError, match="grad-accum"):
+        lm.run_benchmark(grad_accum=0)

@@ -250,3 +250,72 @@ def test_moe_dispatch_compiles_to_all_to_all_on_expert_mesh():
             assert "f32[4,64,128]" not in line and "f32[4,128,64]" not in line, (
                 f"expert kernel gathered: {line.strip()[:120]}"
             )
+
+
+# ---------------------------------------------------------- upcycling
+
+
+@pytest.mark.slow
+def test_upcycle_dense_to_moe_preserves_function_at_step0():
+    """Sparse upcycling: with a renormalised top-k of IDENTICAL experts
+    and capacity ample enough to drop nothing, the upcycled model must
+    compute the dense model's function (router mixes copies of the same
+    MLP), and every non-MLP parameter must transfer verbatim."""
+    import jax.numpy as jnp
+    from tritonk8ssupervisor_tpu.models import TransformerLM
+    from tritonk8ssupervisor_tpu.models.moe import upcycle_dense_to_moe
+
+    dense = TransformerLM(
+        vocab_size=64, num_layers=2, num_heads=2, embed_dim=32,
+        max_seq_len=16, dtype=jnp.float32, logits_dtype=jnp.float32,
+    )
+    moe = TransformerLM(
+        vocab_size=64, num_layers=2, num_heads=2, embed_dim=32,
+        max_seq_len=16, dtype=jnp.float32, logits_dtype=jnp.float32,
+        moe_experts=4, moe_every=2, moe_capacity_factor=8.0,
+    )
+    tokens = jax.random.randint(jax.random.key(0), (2, 16), 0, 64)
+    dense_params = dense.init(jax.random.key(1), tokens, train=False)["params"]
+    up = upcycle_dense_to_moe(dense_params, moe, jax.random.key(2))
+
+    want = dense.apply({"params": dense_params}, tokens, train=False)
+    got, _ = moe.apply({"params": up}, tokens, train=False,
+                       mutable=["moe_losses"])
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-5)
+    # attention params transferred verbatim
+    np.testing.assert_array_equal(
+        np.asarray(up["Block_1"]["qkv"]["kernel"]),
+        np.asarray(dense_params["Block_1"]["qkv"]["kernel"]),
+    )
+    # the upcycled tree matches the MoE model's own init structure
+    target = moe.init(jax.random.key(3), tokens, train=False)["params"]
+    assert jax.tree_util.tree_structure(up) == (
+        jax.tree_util.tree_structure(target)
+    )
+
+
+@pytest.mark.slow
+def test_upcycle_dense_to_moe_works_for_vit():
+    """The init-free upcycler serves image models too: a dense ViT
+    converts and computes the same function at step 0."""
+    from tritonk8ssupervisor_tpu.models import ViT
+    from tritonk8ssupervisor_tpu.models.moe import upcycle_dense_to_moe
+
+    common = dict(num_classes=10, patch_size=8, num_layers=2, num_heads=2,
+                  embed_dim=32, dtype=jnp.float32)
+    dense = ViT(**common)
+    moe = ViT(**common, moe_experts=4, moe_every=2, moe_capacity_factor=8.0)
+    x = jax.random.normal(jax.random.key(0), (2, 32, 32, 3))
+    dense_params = dense.init(jax.random.key(1), x, train=False)["params"]
+    up = upcycle_dense_to_moe(dense_params, moe, jax.random.key(2))
+
+    want = dense.apply({"params": dense_params}, x, train=False)
+    got, _ = moe.apply({"params": up}, x, train=False,
+                       mutable=["moe_losses"])
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-5)
+    target = moe.init(jax.random.key(3), x, train=False)["params"]
+    assert jax.tree_util.tree_structure(up) == (
+        jax.tree_util.tree_structure(target)
+    )

@@ -175,3 +175,38 @@ def test_lm_optimizer_recipe_trains():
     assert not np.array_equal(
         before, np.asarray(state.params["Block_0"]["qkv"]["kernel"])
     )
+
+
+@pytest.mark.slow
+def test_lm_eval_step_matches_train_metrics_before_update():
+    """The eval step must report the same loss/accuracy the train step
+    computes for the same params and batch (shared arithmetic), without
+    touching the state."""
+    import numpy as np
+    from tritonk8ssupervisor_tpu.parallel import batch_sharding, make_mesh
+    from tritonk8ssupervisor_tpu.parallel import train as train_lib
+
+    mesh = make_mesh()
+    model = tiny_lm(dtype=jnp.float32, logits_dtype=jnp.float32)
+    tx = train_lib.default_optimizer(learning_rate=0.1)
+    sample = jax.ShapeDtypeStruct((8, 32), jnp.int32)
+    state, shardings = train_lib.create_train_state(
+        model, jax.random.key(0), sample, mesh, tx
+    )
+    tokens = jax.device_put(
+        jax.random.randint(jax.random.key(1), (8, 32), 0, 128),
+        batch_sharding(mesh, 2),
+    )
+    eval_step = train_lib.make_lm_eval_step(model, mesh, shardings)
+    eval_metrics = eval_step(state, tokens)
+
+    train_step = train_lib.make_lm_train_step(model, tx, mesh, shardings)
+    _, train_metrics = train_step(state, tokens)
+    np.testing.assert_allclose(
+        float(eval_metrics["loss"]), float(train_metrics["loss"]),
+        rtol=1e-5,
+    )
+    np.testing.assert_allclose(
+        float(eval_metrics["accuracy"]), float(train_metrics["accuracy"]),
+        atol=1e-6,
+    )

@@ -83,6 +83,10 @@ def run_benchmark(
             "--pipeline-parallelism with --moe-experts is not wired: the "
             "pipeline's stage function runs the dense block"
         )
+    if grad_accum < 1:
+        raise ValueError(
+            f"--grad-accum {grad_accum} must be >= 1 (1 = no accumulation)"
+        )
     if pipeline_parallelism > 1 and grad_accum > 1:
         raise ValueError(
             "--grad-accum with --pipeline-parallelism is not wired: the "

@@ -256,3 +256,70 @@ def moe_mlp_reference(variables: dict, x: jax.Array, k: int) -> jax.Array:
         axis=2,
     )  # (b, s, k, d)
     return jnp.einsum("bskd,bsk->bsd", sel, gates)
+
+
+def upcycle_dense_to_moe(
+    dense_params: dict,
+    moe_model,
+    rng: jax.Array,
+) -> dict:
+    """Sparse upcycling: initialise a MoE TransformerLM/ViT from a dense
+    checkpoint with the same depth/width — every expert starts as a copy
+    of the dense block's MLP, the router starts fresh, and all non-MoE
+    parameters transfer verbatim. The upcycled model computes (near-)
+    the same function at step 0 (top-k of identical experts ≈ the dense
+    MLP), then the experts differentiate as training routes tokens —
+    the standard public recipe for growing capacity from a trained
+    dense model.
+
+    Args:
+      dense_params: params tree of the dense twin (same num_layers,
+        embed_dim, mlp_ratio; dense MLPs in every block).
+      moe_model: the target model config (moe_experts > 0).
+      rng: key for the fresh router kernels.
+
+    Returns the MoE model's params tree.
+    """
+    e = moe_model.moe_experts
+    if not e:
+        raise ValueError("moe_model.moe_experts must be > 0 to upcycle")
+    out = dict(dense_params)
+    # which blocks become MoE is the model config's placement rule —
+    # derived here directly (no init call, so the same code serves the
+    # token-input LM and the image-input ViT)
+    for i in range(moe_model.num_layers):
+        if (i + 1) % moe_model.moe_every:
+            continue
+        name = f"Block_{i}"
+        dense_block = dense_params[name]
+        up_k = dense_block["mlp_up"]["kernel"]  # (d, f)
+        rng, sub = jax.random.split(rng)
+        moe = {
+            # fresh router; everything else copies the dense MLP into
+            # every expert (biases ride along)
+            "router_kernel": nn.initializers.lecun_normal()(
+                sub, (up_k.shape[0], e), jnp.float32
+            ),
+            "expert_up_kernel": jnp.broadcast_to(
+                up_k[None], (e, *up_k.shape)
+            ).copy(),
+            "expert_up_bias": jnp.broadcast_to(
+                dense_block["mlp_up"]["bias"][None],
+                (e, up_k.shape[1]),
+            ).copy(),
+            "expert_down_kernel": jnp.broadcast_to(
+                dense_block["mlp_down"]["kernel"][None],
+                (e, up_k.shape[1], up_k.shape[0]),
+            ).copy(),
+            "expert_down_bias": jnp.broadcast_to(
+                dense_block["mlp_down"]["bias"][None],
+                (e, up_k.shape[0]),
+            ).copy(),
+        }
+        new_block = {
+            k: v for k, v in dense_block.items()
+            if k not in ("mlp_up", "mlp_down")
+        }
+        new_block["moe_mlp"] = moe
+        out[name] = new_block
+    return out

@@ -323,6 +323,48 @@ def _maybe_chain_steps(step: Callable, steps_per_call: int) -> Callable:
     return multi
 
 
+def _lm_token_losses(pair_fn, mesh, seq_axis, pallas: bool) -> Callable:
+    """(logits (b, s, v), targets (b, s)) -> per-token (losses, correct),
+    shard_map'd onto each device's block when the pallas kernel needs
+    pinning — ONE builder shared by the train and eval factories, so
+    held-out numbers are computed by exactly the arithmetic training
+    optimises."""
+    batch = mesh_lib.batch_axes(mesh)
+    shard_the_loss = pallas and (
+        mesh_lib.batch_degree(mesh) > 1
+        or (seq_axis and mesh.shape[seq_axis] > 1)
+    )
+
+    def local_token_losses(logits, targets):
+        b, s, v = logits.shape
+        losses, correct = pair_fn(logits.reshape(b * s, v), targets.reshape(-1))
+        return losses.reshape(b, s), correct.reshape(b, s)
+
+    if not shard_the_loss:
+        return local_token_losses
+    spec3 = P(batch, seq_axis, None)
+    spec2 = P(batch, seq_axis)
+    return shard_map(
+        local_token_losses,
+        mesh=mesh,
+        in_specs=(spec3, spec2),
+        out_specs=(spec2, spec2),
+    )
+
+
+def _next_token_metrics(token_losses: Callable, logits, tokens):
+    """Masked next-token (loss, accuracy): targets are the rolled token
+    grid, the wrapped final position is masked out of both metrics."""
+    targets = jnp.roll(tokens, -1, axis=1)
+    losses, correct = token_losses(logits, targets)
+    s = tokens.shape[1]
+    mask = jnp.arange(s) < s - 1
+    denom = tokens.shape[0] * (s - 1)
+    loss = jnp.where(mask[None, :], losses, 0.0).sum() / denom
+    accuracy = jnp.where(mask[None, :], correct, False).sum() / denom
+    return loss, accuracy
+
+
 def make_lm_train_step(
     model,
     tx: optax.GradientTransformation,
@@ -375,27 +417,7 @@ def make_lm_train_step(
         pair_fn = metrics_fn or _default_metrics_fn()
         pallas = is_pallas_loss(pair_fn)
     batch = mesh_lib.batch_axes(mesh)
-    shard_the_loss = pallas and (
-        mesh_lib.batch_degree(mesh) > 1
-        or (seq_axis and mesh.shape[seq_axis] > 1)
-    )
-
-    def local_token_losses(logits, targets):
-        b, s, v = logits.shape
-        losses, correct = pair_fn(logits.reshape(b * s, v), targets.reshape(-1))
-        return losses.reshape(b, s), correct.reshape(b, s)
-
-    if shard_the_loss:
-        spec3 = P(batch, seq_axis, None)
-        spec2 = P(batch, seq_axis)
-        token_losses = shard_map(
-            local_token_losses,
-            mesh=mesh,
-            in_specs=(spec3, spec2),
-            out_specs=(spec2, spec2),
-        )
-    else:
-        token_losses = local_token_losses
+    token_losses = _lm_token_losses(pair_fn, mesh, seq_axis, pallas)
 
     if forward_fn is None:
         # "moe_losses" collects the router load-balance/z losses MoE
@@ -409,14 +431,7 @@ def make_lm_train_step(
 
     def compute_loss(params, tokens):
         logits, sown = forward_fn(params, tokens)
-        # next-token targets; the wrapped position s-1 is masked out below
-        targets = jnp.roll(tokens, -1, axis=1)
-        losses, correct = token_losses(logits, targets)
-        s = tokens.shape[1]
-        mask = jnp.arange(s) < s - 1
-        denom = tokens.shape[0] * (s - 1)
-        loss = jnp.where(mask[None, :], losses, 0.0).sum() / denom
-        accuracy = jnp.where(mask[None, :], correct, False).sum() / denom
+        loss, accuracy = _next_token_metrics(token_losses, logits, tokens)
         aux = _moe_aux_total(sown)
         return loss + aux, (loss, accuracy)
 
@@ -469,4 +484,38 @@ def make_lm_train_step(
         in_shardings=(state_shardings, token_sh),
         out_shardings=(state_shardings, {"loss": metric_sh, "accuracy": metric_sh}),
         donate_argnums=(0,),
+    )
+
+
+def make_lm_eval_step(
+    model,
+    mesh,
+    state_shardings,
+    seq_axis: str | None = None,
+    metrics_fn: Callable | None = None,
+):
+    """Gradient-free LM evaluation: (state, tokens) -> metrics
+    {loss, accuracy} — same loss masking, sharding, and kernel path as
+    the train step (one factory family, so eval numbers are computed by
+    exactly the arithmetic training optimised), without the backward or
+    the optimizer. Use it for held-out perplexity loops between training
+    windows; exp(loss) is the perplexity.
+    """
+    pair_fn = metrics_fn or _default_metrics_fn()
+    batch = mesh_lib.batch_axes(mesh)
+    token_losses = _lm_token_losses(
+        pair_fn, mesh, seq_axis, is_pallas_loss(pair_fn)
+    )
+
+    def eval_step(state: TrainState, tokens):
+        logits = model.apply({"params": state.params}, tokens, train=False)
+        loss, accuracy = _next_token_metrics(token_losses, logits, tokens)
+        return {"loss": loss, "accuracy": accuracy}
+
+    token_sh = NamedSharding(mesh, P(batch, seq_axis))
+    metric_sh = NamedSharding(mesh, P())
+    return jax.jit(
+        eval_step,
+        in_shardings=(state_shardings, token_sh),
+        out_shardings={"loss": metric_sh, "accuracy": metric_sh},
     )
