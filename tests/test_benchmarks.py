@@ -189,3 +189,12 @@ def test_lm_benchmark_rejects_non_positive_grad_accum():
 
     with pytest.raises(ValueError, match="grad-accum"):
         lm.run_benchmark(grad_accum=0)
+
+
+def test_lm_benchmark_rejects_head_major_with_pipeline_and_ring():
+    from tritonk8ssupervisor_tpu.benchmarks import lm
+
+    with pytest.raises(ValueError, match="head-major"):
+        lm.run_benchmark(head_major=True, pipeline_parallelism=4)
+    with pytest.raises(ValueError, match="head-major"):
+        lm.run_benchmark(head_major=True, sequence_parallelism=4)

@@ -210,3 +210,25 @@ def test_lm_eval_step_matches_train_metrics_before_update():
         float(eval_metrics["accuracy"]), float(train_metrics["accuracy"]),
         atol=1e-6,
     )
+
+
+def test_head_major_block_matches_seq_major():
+    """head_major must be a pure layout change: identical parameter tree
+    AND identical function (same init rngs fold through the same module
+    path/param names)."""
+    import numpy as np
+
+    plain = tiny_lm(dtype=jnp.float32, logits_dtype=jnp.float32)
+    hm = tiny_lm(dtype=jnp.float32, logits_dtype=jnp.float32,
+                 head_major=True)
+    tokens = jax.random.randint(jax.random.key(1), (2, 16), 0, 128)
+    va = plain.init(jax.random.key(0), tokens, train=False)
+    vb = hm.init(jax.random.key(0), tokens, train=False)
+    assert jax.tree_util.tree_structure(va) == jax.tree_util.tree_structure(vb)
+    for a, b in zip(jax.tree_util.tree_leaves(va), jax.tree_util.tree_leaves(vb)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    out_a = plain.apply(va, tokens, train=False)
+    out_b = hm.apply(va, tokens, train=False)
+    # same math, different contraction order: f32 rounding noise only
+    np.testing.assert_allclose(np.asarray(out_a), np.asarray(out_b),
+                               rtol=1e-4, atol=1e-5)

@@ -47,6 +47,7 @@ def run_benchmark(
     num_microbatches: int = 4,
     grad_accum: int = 1,
     remat: bool = False,
+    head_major: bool = False,
     attention: str = "auto",
     learning_rate: float = 3e-2,
     checkpoint_dir: str | None = None,
@@ -82,6 +83,18 @@ def run_benchmark(
         raise ValueError(
             "--pipeline-parallelism with --moe-experts is not wired: the "
             "pipeline's stage function runs the dense block"
+        )
+    if head_major and sequence_parallelism > 1:
+        raise ValueError(
+            "--head-major with --sequence-parallelism is not wired: the "
+            "ring attention path is seq-major (its shard_map specs shard "
+            "the sequence dim)"
+        )
+    if head_major and pipeline_parallelism > 1:
+        raise ValueError(
+            "--head-major with --pipeline-parallelism is not wired: the "
+            "pipeline's stage function runs the seq-major block — a "
+            "silent fall-through would mislabel the A/B measurement"
         )
     if grad_accum < 1:
         raise ValueError(
@@ -148,6 +161,7 @@ def run_benchmark(
         moe_every=moe_every,
         moe_mesh=mesh if moe_experts else None,
         remat_blocks=remat,
+        head_major=head_major,
     )
     tx = train_lib.default_optimizer(learning_rate=learning_rate)
     sample = jax.ShapeDtypeStruct((global_batch, seq_len), jnp.int32)
@@ -300,6 +314,13 @@ def build_parser() -> argparse.ArgumentParser:
         "trades recompute FLOPs for activation bytes at long sequence",
     )
     parser.add_argument(
+        "--head-major",
+        action="store_true",
+        help="produce q/k/v head-major (b, h, s, d) straight from the "
+        "projection — removes the relayout passes around the splash "
+        "kernel (A/B lever; models/transformer.py Block.head_major)",
+    )
+    parser.add_argument(
         "--grad-accum", type=int, default=1,
         help="accumulate gradients over this many in-step microbatches "
         "before the optimizer update (exact for the LM; the activation-"
@@ -352,6 +373,7 @@ def main(argv: list[str] | None = None) -> int:
         num_microbatches=args.num_microbatches,
         grad_accum=args.grad_accum,
         remat=args.remat,
+        head_major=args.head_major,
         attention=args.attention,
         checkpoint_dir=args.checkpoint_dir,
         profile_dir=args.profile,

@@ -23,12 +23,73 @@ import jax.numpy as jnp
 
 from tritonk8ssupervisor_tpu.ops.ring_attention import attention_reference
 
-# attention_fn signature: (q, k, v, causal) -> out, all (B, S, H, D)
+# attention_fn signature: (q, k, v, causal) -> out, all (B, S, H, D).
+# Strategies used with Block.head_major=True must also accept
+# layout="bshd"|"bhsd" and run on (B, H, S, D) when "bhsd"
+# (ops/flash_attention.py and dense_attention do; the ring is
+# seq-major only).
 AttentionFn = Callable[..., Any]
 
 
-def dense_attention(q, k, v, causal: bool = True):
+def dense_attention(q, k, v, causal: bool = True, layout: str = "bshd"):
+    if layout == "bhsd":  # head-major callers; the reference is seq-major
+        q, k, v = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+        out = attention_reference(q, k, v, causal=causal)
+        return out.transpose(0, 2, 1, 3)
     return attention_reference(q, k, v, causal=causal)
+
+
+class _HeadMajorQKV(nn.Module):
+    """The qkv projection producing (b, h, s, d) q/k/v directly: the SAME
+    (embed, 3*embed) kernel and (3*embed,) bias nn.Dense would declare —
+    module path and param names identical, so init values and
+    checkpoints are interchangeable with the seq-major path — consumed
+    reshaped per head, so the head-major layout comes out of the matmul
+    instead of a separate relayout pass over HBM."""
+
+    num_heads: int
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, y):
+        e = y.shape[-1]
+        d = e // self.num_heads
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(), (e, 3 * e),
+            jnp.float32,
+        )
+        bias = self.param(
+            "bias", nn.initializers.zeros_init(), (3 * e,), jnp.float32
+        )
+        w = kernel.reshape(e, 3, self.num_heads, d).astype(self.dtype)
+        b3 = bias.reshape(3, self.num_heads, d).astype(self.dtype)
+        out = jnp.einsum("bse,ekhd->kbhsd", y.astype(self.dtype), w)
+        out = out + b3[:, None, :, None, :]
+        return out[0], out[1], out[2]
+
+
+class _HeadMajorProj(nn.Module):
+    """The attention output projection contracting straight from
+    (b, h, s, d): same (embed, embed) kernel / (embed,) bias as
+    nn.Dense(name="proj"), so the tree is unchanged; the back-relayout
+    folds into the matmul."""
+
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, attn):
+        b, h, s, d = attn.shape
+        e = h * d
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(), (e, e), jnp.float32
+        )
+        bias = self.param(
+            "bias", nn.initializers.zeros_init(), (e,), jnp.float32
+        )
+        w = kernel.reshape(h, d, e).astype(self.dtype)
+        return (
+            jnp.einsum("bhsd,hde->bse", attn, w) + bias.astype(self.dtype)
+        )
 
 
 class Block(nn.Module):
@@ -41,6 +102,19 @@ class Block(nn.Module):
     # attention strategy honors it rather than each consumer wrapping
     # attention_fn to override it
     causal: bool = True
+    # head-major attention layout: q/k/v are produced as (b, h, s, d) by
+    # einsumming the SAME qkv kernel reshaped per head (parameter tree
+    # unchanged, checkpoints interchangeable), and the output projection
+    # contracts straight from (b, h, s, d) — the (b,s,h,d)<->(b,h,s,d)
+    # relayouts around head-major kernels (splash) disappear instead of
+    # costing HBM passes. attention_fn must accept layout="bhsd"
+    # (ops/flash_attention.py does).
+    # MEASURED on v5e (seq 1024 b8 LM step): 67.1 ms vs 62.7 seq-major —
+    # pinning the projection's output layout costs XLA more inside the
+    # dots than the explicit transposes it removes (the r04 roofline's
+    # 4.2 ms "data formatting" was already near-optimal). Kept as an A/B
+    # lever + evidence, default off.
+    head_major: bool = False
     # > 0 replaces this block's dense MLP with a mixture of experts
     # (models/moe.py) — expert parameters shard over the mesh's "expert"
     # axis, dispatch/combine become all_to_alls
@@ -56,13 +130,22 @@ class Block(nn.Module):
         dense = partial(nn.Dense, dtype=self.dtype, param_dtype=jnp.float32)
 
         y = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32)(x)
-        qkv = dense(3 * e, name="qkv")(y)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        q = q.reshape(b, s, self.num_heads, head_dim)
-        k = k.reshape(b, s, self.num_heads, head_dim)
-        v = v.reshape(b, s, self.num_heads, head_dim)
-        attn = self.attention_fn(q, k, v, causal=self.causal)
-        x = x + dense(e, name="proj")(attn.reshape(b, s, e))
+        if self.head_major:
+            q, k, v = _HeadMajorQKV(
+                num_heads=self.num_heads, dtype=self.dtype, name="qkv"
+            )(y)
+            attn = self.attention_fn(
+                q, k, v, causal=self.causal, layout="bhsd"
+            )
+            x = x + _HeadMajorProj(dtype=self.dtype, name="proj")(attn)
+        else:
+            qkv = dense(3 * e, name="qkv")(y)
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            q = q.reshape(b, s, self.num_heads, head_dim)
+            k = k.reshape(b, s, self.num_heads, head_dim)
+            v = v.reshape(b, s, self.num_heads, head_dim)
+            attn = self.attention_fn(q, k, v, causal=self.causal)
+            x = x + dense(e, name="proj")(attn.reshape(b, s, e))
 
         y = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32)(x)
         if self.moe_experts:
@@ -113,6 +196,10 @@ class TransformerLM(nn.Module):
     # recompute FLOPs for activation bytes — the long-context lever when
     # saved per-layer activations dominate HBM
     remat_blocks: bool = False
+    # head-major attention layout (see Block.head_major): q/k/v born
+    # (b, h, s, d) from the projection, no relayout around head-major
+    # kernels; attention_fn must accept layout="bhsd"
+    head_major: bool = False
 
     @nn.compact
     def __call__(self, tokens, train: bool = True):
@@ -146,6 +233,7 @@ class TransformerLM(nn.Module):
                 moe_k=self.moe_k,
                 moe_capacity_factor=self.moe_capacity_factor,
                 moe_mesh=self.moe_mesh,
+                head_major=self.head_major,
                 name=f"Block_{i}",
             )(x)
         x = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32)(x)

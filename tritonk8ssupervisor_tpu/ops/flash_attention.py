@@ -111,16 +111,21 @@ def _splash_kernel(seq: int, num_heads: int, causal: bool, block: int):
         )
 
 
-def _tuned_library_flash(q, k, v, causal: bool):
+def _tuned_library_flash(q, k, v, causal: bool, head_major: bool = False):
     """The older library flash kernel with the sweep's block sizes — the
     fallback for shapes the splash grid can't cover. Still ~1.3-1.7x
-    faster than dense (and far from the pathological defaults)."""
+    faster than dense (and far from the pathological defaults).
+    head_major inputs/outputs are the kernel's NATIVE (b, h, s, d)
+    convention, so that path transposes nothing."""
     from jax.experimental.pallas.ops.tpu.flash_attention import (
         BlockSizes,
         flash_attention as pl_flash,
     )
 
-    b, s, h, d = q.shape
+    if head_major:
+        b, h, s, d = q.shape
+    else:
+        b, s, h, d = q.shape
     # jax's kernel requires blocks to divide the sequence: largest
     # 128-multiple divisor of s up to the tuned 512 (s % 128 == 0 is the
     # caller's guard, so 128 always qualifies — e.g. seq 640 gets 128,
@@ -131,14 +136,21 @@ def _tuned_library_flash(q, k, v, causal: bool):
         block_q_major_dkv=bq, block_k_major_dkv=bk, block_k_dkv=bk,
         block_q_dkv=bq, block_k_major_dq=bk, block_k_dq=bk, block_q_dq=bq,
     )
+    if head_major:
+        return pl_flash(q, k, v, causal=causal, sm_scale=1.0 / (d**0.5),
+                        block_sizes=block_sizes)
     qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
     out = pl_flash(qt, kt, vt, causal=causal, sm_scale=1.0 / (d**0.5),
                    block_sizes=block_sizes)
     return out.transpose(0, 2, 1, 3)
 
 
-def flash_attention(q, k, v, causal: bool = True):
-    """Fused attention over (batch, seq, heads, head_dim) inputs.
+def flash_attention(q, k, v, causal: bool = True, layout: str = "bshd"):
+    """Fused attention over (batch, seq, heads, head_dim) inputs — or,
+    with layout="bhsd", over head-major (batch, heads, seq, head_dim)
+    inputs, which IS the splash kernel's native convention: the
+    head-major Block (models/transformer.py) produces q/k/v that way so
+    no relayout pass touches HBM on either side of the kernel.
 
     TPU: the tuned splash kernel (scores stay in VMEM block by block;
     causal tiles that are fully masked are skipped outright), falling
@@ -147,17 +159,36 @@ def flash_attention(q, k, v, causal: bool = True):
     signature, same numerics contract, so models/tests swap strategies
     without code changes.
     """
+    if layout not in ("bshd", "bhsd"):
+        raise ValueError(f"layout={layout!r}: expected 'bshd' or 'bhsd'")
+    head_major = layout == "bhsd"
     if jax.default_backend() != "tpu":
+        if head_major:
+            q, k, v = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+            out = attention_reference(q, k, v, causal=causal)
+            return out.transpose(0, 2, 1, 3)
         return attention_reference(q, k, v, causal=causal)
-    b, s, h, d = q.shape
+    if head_major:
+        b, h, s, d = q.shape
+    else:
+        b, s, h, d = q.shape
     block = _splash_block(s)
     if block is not None:
         kernel = _splash_kernel(s, h, causal, block)
-        # model convention (b, s, h, d) -> splash convention (b, h, s, d);
-        # splash applies no sm_scale, so fold it into q
+        # splash convention is (b, h, s, d); seq-major inputs pay the
+        # relayout here, head-major inputs pass straight through.
+        # splash applies no sm_scale, so fold it into q.
+        if head_major:
+            return jax.vmap(kernel)(q * (1.0 / d**0.5), k, v)
         qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
         out = jax.vmap(kernel)(qt * (1.0 / d**0.5), kt, vt)
         return out.transpose(0, 2, 1, 3)
     if s % 128 == 0:
-        return _tuned_library_flash(q, k, v, causal)
+        # the library kernel is natively head-major: that path
+        # transposes nothing, the seq-major path pays the usual pair
+        return _tuned_library_flash(q, k, v, causal, head_major=head_major)
+    if head_major:  # dense reference runs seq-major
+        q, k, v = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+        out = attention_reference(q, k, v, causal=causal)
+        return out.transpose(0, 2, 1, 3)
     return attention_reference(q, k, v, causal=causal)
