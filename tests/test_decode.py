@@ -129,3 +129,32 @@ def test_generate_data_parallel_over_mesh_matches_single_device():
     params_sh = jax.device_put(params, replicated(mesh))
     got = dec.generate(model, params_sh, tokens_sh, 5)
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_int8_quantized_decode_quality_and_structure():
+    """Weight-only int8: the quantized tree decodes through the same
+    path, prefill logits stay close to full precision (per-channel
+    symmetric quantization of ~N(0, small) kernels), and the non-dense
+    leaves are untouched."""
+    model = _model()
+    tokens, params = _init(model)
+    qp = dec.quantize_params_int8(params)
+
+    assert qp["Block_0"]["qkv"]["kernel_int8"].dtype == jnp.int8
+    assert qp["lm_head"]["kernel_int8"].dtype == jnp.int8
+    # embeddings / layernorms / positions untouched
+    np.testing.assert_array_equal(
+        np.asarray(qp["tok_embed"]["embedding"]),
+        np.asarray(params["tok_embed"]["embedding"]),
+    )
+    assert "kernel" not in qp["Block_0"]["qkv"]
+
+    _, full = dec.prefill(model, params, tokens, max_len=16)
+    _, quant = dec.prefill(model, qp, tokens, max_len=16)
+    err = np.abs(np.asarray(full) - np.asarray(quant))
+    ref = np.abs(np.asarray(full)).max()
+    assert err.max() / ref < 0.05, f"int8 logit error {err.max()/ref:.3f}"
+
+    out = dec.generate(model, qp, tokens, 5)
+    assert out.shape == (2, 5)
+    assert int(out.min()) >= 0 and int(out.max()) < 97

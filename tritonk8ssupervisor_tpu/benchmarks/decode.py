@@ -40,6 +40,7 @@ def run_benchmark(
     batch: int = 8,
     temperature: float = 0.0,
     repeats: int = 3,
+    int8: bool = False,
 ) -> dict:
     max_len = prompt_len + new_tokens
     model = TransformerLM(
@@ -66,10 +67,12 @@ def run_benchmark(
         ),
         batch_sharding(mesh, 2),
     )
-    params = jax.device_put(
-        model.init(jax.random.key(1), prompt, train=False)["params"],
-        replicated(mesh),
-    )
+    params = model.init(jax.random.key(1), prompt, train=False)["params"]
+    if int8:
+        # weight-only int8 (models/decode.quantize_params_int8): halves
+        # the per-token weight read — the dominant traffic at small batch
+        params = dec.quantize_params_int8(params)
+    params = jax.device_put(params, replicated(mesh))
 
     fn = jax.jit(
         functools.partial(
@@ -107,6 +110,7 @@ def run_benchmark(
         "prompt_len": prompt_len,
         "new_tokens": new_tokens,
         "temperature": temperature,
+        "int8": bool(int8),
         "decode_tokens_per_sec": total_tokens / median,
         "decode_tokens_per_sec_per_chip": total_tokens / median / num_chips,
         "ms_per_token_per_stream": median / new_tokens * 1000,
@@ -127,6 +131,13 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--batch", type=int, default=8)
     parser.add_argument("--temperature", type=float, default=0.0)
     parser.add_argument("--repeats", type=int, default=3)
+    parser.add_argument(
+        "--int8",
+        action="store_true",
+        help="weight-only int8 kernels (per-output-channel scales) — "
+        "halves the per-token weight read that dominates small-batch "
+        "decode",
+    )
     parser.add_argument("--json", action="store_true")
     return parser
 
@@ -148,6 +159,7 @@ def main(argv: list[str] | None = None) -> int:
         batch=args.batch,
         temperature=args.temperature,
         repeats=args.repeats,
+        int8=args.int8,
     )
     if args.json:
         print(json.dumps(result, sort_keys=True))

@@ -32,11 +32,11 @@ AttentionFn = Callable[..., Any]
 
 
 def dense_attention(q, k, v, causal: bool = True, layout: str = "bshd"):
-    if layout == "bhsd":  # head-major callers; the reference is seq-major
-        q, k, v = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
-        out = attention_reference(q, k, v, causal=causal)
-        return out.transpose(0, 2, 1, 3)
-    return attention_reference(q, k, v, causal=causal)
+    from tritonk8ssupervisor_tpu.ops.ring_attention import (
+        attention_reference_layout,
+    )
+
+    return attention_reference_layout(q, k, v, causal, layout)
 
 
 class _HeadMajorQKV(nn.Module):

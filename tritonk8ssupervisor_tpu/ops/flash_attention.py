@@ -38,7 +38,10 @@ import functools
 
 import jax
 
-from tritonk8ssupervisor_tpu.ops.ring_attention import attention_reference
+from tritonk8ssupervisor_tpu.ops.ring_attention import (
+    attention_reference,
+    attention_reference_layout,
+)
 
 # The sweep's winner for LM-class shapes (head_dim 64, seq >= 512).
 # 512-row/column tiles keep the kv-block resident while q streams; the
@@ -163,11 +166,7 @@ def flash_attention(q, k, v, causal: bool = True, layout: str = "bshd"):
         raise ValueError(f"layout={layout!r}: expected 'bshd' or 'bhsd'")
     head_major = layout == "bhsd"
     if jax.default_backend() != "tpu":
-        if head_major:
-            q, k, v = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
-            out = attention_reference(q, k, v, causal=causal)
-            return out.transpose(0, 2, 1, 3)
-        return attention_reference(q, k, v, causal=causal)
+        return attention_reference_layout(q, k, v, causal, layout)
     if head_major:
         b, h, s, d = q.shape
     else:
@@ -187,8 +186,4 @@ def flash_attention(q, k, v, causal: bool = True, layout: str = "bshd"):
         # the library kernel is natively head-major: that path
         # transposes nothing, the seq-major path pays the usual pair
         return _tuned_library_flash(q, k, v, causal, head_major=head_major)
-    if head_major:  # dense reference runs seq-major
-        q, k, v = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
-        out = attention_reference(q, k, v, causal=causal)
-        return out.transpose(0, 2, 1, 3)
-    return attention_reference(q, k, v, causal=causal)
+    return attention_reference_layout(q, k, v, causal, layout)

@@ -70,6 +70,20 @@ def _mark_varying(x, axis_names):
     return jax.lax.pvary(x, missing)  # pragma: no cover - interim versions
 
 
+def attention_reference_layout(q, k, v, causal: bool, layout: str):
+    """attention_reference for either convention: validates `layout` and
+    pays the transpose pair for head-major callers — the ONE fallback
+    path every layout-aware strategy shares (flash_attention's non-TPU
+    and non-tiling branches, dense_attention)."""
+    if layout not in ("bshd", "bhsd"):
+        raise ValueError(f"layout={layout!r}: expected 'bshd' or 'bhsd'")
+    if layout == "bhsd":
+        q, k, v = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+        out = attention_reference(q, k, v, causal=causal)
+        return out.transpose(0, 2, 1, 3)
+    return attention_reference(q, k, v, causal=causal)
+
+
 def attention_reference(q, k, v, causal: bool = False):
     """Dense single-device attention — ground truth for the ring tests.
 
