@@ -340,3 +340,23 @@ def test_pair_kernel_invalid_labels_read_incorrect():
     )
     _, vp_correct = fn(logits, labels)
     assert not np.asarray(vp_correct)[invalid].any()
+
+
+def test_flash_bwd_block_env_read_per_call(monkeypatch):
+    """r4 advisor: TK8S_FLASH_BWD_BLOCK must take effect when set AFTER
+    import (it is read per call and keyed into the kernel cache), and
+    invalid values fall back to the forward block."""
+    from tritonk8ssupervisor_tpu.ops.flash_attention import _bwd_block
+
+    monkeypatch.delenv("TK8S_FLASH_BWD_BLOCK", raising=False)
+    assert _bwd_block(1024, 512) == 512          # default
+    monkeypatch.setenv("TK8S_FLASH_BWD_BLOCK", "256")
+    assert _bwd_block(1024, 512) == 256          # post-import mutation works
+    monkeypatch.setenv("TK8S_FLASH_BWD_BLOCK", "384")
+    assert _bwd_block(1024, 512) == 512          # 384 !| 1024 -> fwd block
+    monkeypatch.setenv("TK8S_FLASH_BWD_BLOCK", "100")
+    assert _bwd_block(1024, 512) == 512          # not a 128-multiple
+    monkeypatch.setenv("TK8S_FLASH_BWD_BLOCK", "-512")
+    assert _bwd_block(1024, 512) == 512          # negative -> fwd block
+    monkeypatch.setenv("TK8S_FLASH_BWD_BLOCK", "auto")
+    assert _bwd_block(1024, 512) == 512          # non-numeric -> fwd block

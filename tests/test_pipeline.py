@@ -206,3 +206,27 @@ def test_lm_benchmark_rejects_non_dividing_experts():
 
     with pytest.raises(ValueError, match="divisible by"):
         lm.run_benchmark(moe_experts=6, expert_parallelism=4)
+
+
+def test_pp_rejects_moe_model_with_clear_error():
+    """r4 advisor: an MoE LM must fail at the library surface with a
+    clear message, not an opaque tree-structure mismatch inside
+    stack_block_params."""
+    mesh = make_mesh(pipeline_parallelism=4)
+    model = _tiny_lm(moe_experts=4)
+    tokens = jax.random.randint(jax.random.key(0), (4, 16), 0, 64)
+    params = model.init(jax.random.key(1), tokens, train=False)["params"]
+    with pytest.raises(ValueError, match="dense TransformerLM only"):
+        pp.pipelined_lm_params(model, params, mesh)
+    with pytest.raises(ValueError, match="dense TransformerLM only"):
+        pp.make_pp_lm_forward(model, mesh, num_microbatches=2)
+
+
+def test_pp_rejects_head_major_model():
+    """head_major changes the Block's layout; the pp stage Block is
+    seq-major, so the combination must be rejected, not silently run the
+    wrong layout."""
+    mesh = make_mesh(pipeline_parallelism=4)
+    model = _tiny_lm(head_major=True)
+    with pytest.raises(ValueError, match="head_major"):
+        pp.make_pp_lm_forward(model, mesh, num_microbatches=2)

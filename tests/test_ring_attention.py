@@ -195,3 +195,27 @@ def test_causal_no_longer_pays_the_noncausal_cost():
     # ~53% of the matmuls; CPU overheads (ppermute, selects) eat some of
     # it, so assert a conservative bound that still rules out "full cost"
     assert best[True] < 0.9 * best[False], best
+
+
+def test_batch_axis_falls_back_to_data_when_expert_does_not_divide():
+    """r4 advisor: with an expert axis >1, a batch divisible by data but
+    not by data*expert must keep dp sharding over data alone — not drop
+    batch-axis sharding entirely."""
+    from tritonk8ssupervisor_tpu.ops.ring_attention import _resolve_batch_axis
+    from tritonk8ssupervisor_tpu.parallel.mesh import DATA_AXIS, EXPERT_AXIS
+
+    mesh = make_mesh(model_parallelism=2, expert_parallelism=2)  # data=2
+    # joint degree 4 divides 8 -> both axes
+    assert _resolve_batch_axis(mesh, MODEL_AXIS, "auto", 8) == (
+        DATA_AXIS, EXPERT_AXIS,
+    )
+    # 2 % (2*2) != 0 but 2 % 2 == 0 -> data alone (the fallback)
+    assert _resolve_batch_axis(mesh, MODEL_AXIS, "auto", 2) == DATA_AXIS
+    # 3 divides neither -> replicated
+    assert _resolve_batch_axis(mesh, MODEL_AXIS, "auto", 3) is None
+    # end-to-end: the fallback path still computes exact attention
+    q, k, v = qkv(batch=2, seq=32)
+    got = ring_attention(q, k, v, mesh=mesh, axis_name=MODEL_AXIS, causal=True)
+    want = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)

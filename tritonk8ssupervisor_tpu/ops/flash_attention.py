@@ -35,6 +35,7 @@ fallback so CPU tests exercise the same call sites.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 
@@ -48,16 +49,26 @@ from tritonk8ssupervisor_tpu.ops.ring_attention import (
 # unfused backward (separate dq and dkv kernels) beat the fused one by
 # ~25% in the same sweep.
 _BLOCK = 512
-# Backward (dkv/dq) block rows/cols, swept separately once the r04
-# roofline showed the backward kernels at ~15% of either roofline at
-# seq 1024. Measured (seq 1024 b8 full LM step): 512 -> 62.7 ms,
-# 256 -> 73.2, 128 -> 107.3, 1024 -> 63.6 — 512 is the optimum from
-# BOTH directions, so the backward's sub-roofline rate is the kernel's
-# recompute/pipeline structure, not tiling. Overridable for sweeps via
-# TK8S_FLASH_BWD_BLOCK.
-import os
 
-_BWD_BLOCK = int(os.environ.get("TK8S_FLASH_BWD_BLOCK", "512"))
+def _bwd_block(seq: int, block: int) -> int:
+    """Backward (dkv/dq) block rows/cols, swept separately once the r04
+    roofline showed the backward kernels at ~15% of either roofline at
+    seq 1024. Measured (seq 1024 b8 full LM step): 512 -> 62.7 ms,
+    256 -> 73.2, 128 -> 107.3, 1024 -> 63.6 — 512 is the optimum from
+    BOTH directions, so the backward's sub-roofline rate is the kernel's
+    recompute/pipeline structure, not tiling. TK8S_FLASH_BWD_BLOCK
+    overrides for sweeps — read per call (not at import), so an
+    in-process sweep that mutates os.environ takes effect; the value is
+    part of _splash_kernel's cache key. Same validity constraints as
+    the forward pick (divide seq, 128-lane multiple), else the forward
+    block."""
+    try:
+        bwd = int(os.environ.get("TK8S_FLASH_BWD_BLOCK", "512"))
+    except ValueError:
+        return block
+    if bwd > 0 and seq % bwd == 0 and bwd % 128 == 0:
+        return bwd
+    return block
 
 
 def _splash_block(seq: int) -> int | None:
@@ -72,10 +83,11 @@ def _splash_block(seq: int) -> int | None:
 
 
 @functools.lru_cache(maxsize=32)
-def _splash_kernel(seq: int, num_heads: int, causal: bool, block: int):
+def _splash_kernel(seq: int, num_heads: int, causal: bool, block: int,
+                   bwd: int):
     """Mask-partitioned splash kernel, cached per (seq, heads, causal,
-    block): building the mask partition info costs O((seq/block)^2) host
-    work that must not rerun on every trace."""
+    fwd block, bwd block): building the mask partition info costs
+    O((seq/block)^2) host work that must not rerun on every trace."""
     from jax.experimental.pallas.ops.tpu.splash_attention import (
         splash_attention_kernel as sk,
         splash_attention_mask as sm,
@@ -83,15 +95,6 @@ def _splash_kernel(seq: int, num_heads: int, causal: bool, block: int):
 
     mask_cls = sm.CausalMask if causal else sm.FullMask
     mask = sm.MultiHeadMask([mask_cls((seq, seq)) for _ in range(num_heads)])
-    # same constraints as the forward pick: divide seq AND stay a
-    # 128-lane multiple, else fall back to the forward block
-    bwd = (
-        _BWD_BLOCK
-        if _BWD_BLOCK
-        and seq % _BWD_BLOCK == 0
-        and _BWD_BLOCK % 128 == 0
-        else block
-    )
     block_sizes = sk.BlockSizes(
         block_q=block,
         block_kv=block,
@@ -173,7 +176,7 @@ def flash_attention(q, k, v, causal: bool = True, layout: str = "bshd"):
         b, s, h, d = q.shape
     block = _splash_block(s)
     if block is not None:
-        kernel = _splash_kernel(s, h, causal, block)
+        kernel = _splash_kernel(s, h, causal, block, _bwd_block(s, block))
         # splash convention is (b, h, s, d); seq-major inputs pay the
         # relayout here, head-major inputs pass straight through.
         # splash applies no sm_scale, so fold it into q.

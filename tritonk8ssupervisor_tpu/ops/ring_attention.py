@@ -319,6 +319,12 @@ def _resolve_batch_axis(
         degree *= mesh.shape[a]
     if batch is None or batch % degree == 0:
         return cands if len(cands) > 1 else cands[0]
+    # joint data*expert degree doesn't divide the batch: fall back to
+    # sharding over data alone rather than dropping batch-axis sharding
+    # entirely (a batch divisible by data but not data*expert keeps the
+    # dp sharding it would have had on a no-expert mesh)
+    if batch % mesh.shape[DATA_AXIS] == 0:
+        return DATA_AXIS
     return None
 
 

@@ -126,6 +126,35 @@ def pipeline_apply(
 # ----------------------------------------------------- LM over the pipeline
 
 
+def _check_pp_model(model) -> None:
+    """Reject model configs the pipeline helpers can't stage, at the
+    library surface (benchmarks/lm.py has its own guards, but the API
+    must fail clearly, not with a tree-structure mismatch deep in
+    stack_block_params or a silently-wrong seq-major block):
+
+    - MoE blocks give alternating layers a different parameter
+      structure, so the homogeneous (P, L/P, ...) stage stack cannot
+      represent them.
+    - head_major changes the Block's attention layout; the stage Block
+      built by make_pp_lm_forward is seq-major, so a head-major
+      checkpoint would silently compute through the wrong layout.
+    """
+    if getattr(model, "moe_experts", 0):
+        raise ValueError(
+            "pipeline parallelism supports dense TransformerLM only: "
+            f"moe_experts={model.moe_experts} makes MoE layers' parameter "
+            "trees differ from dense layers', which the homogeneous stage "
+            "stack cannot hold (compose ep with dp/tp instead; see "
+            "docs/parallelism.md)"
+        )
+    if getattr(model, "head_major", False):
+        raise ValueError(
+            "pipeline parallelism's stage Block is seq-major: "
+            "head_major=True would silently run the wrong attention "
+            "layout — build the model with head_major=False for pp"
+        )
+
+
 def stack_block_params(params: dict, num_layers: int) -> Any:
     """TransformerLM's per-layer Block_i subtrees stacked into one tree
     with a leading (num_layers,) dim — the layout pipeline stages slice.
@@ -174,6 +203,7 @@ def pipelined_lm_params(model, params: dict, mesh, axis: str = PIPE_AXIS):
     """
     num_stages = mesh.shape[axis]
     n = model.num_layers
+    _check_pp_model(model)
     if n % num_stages:
         raise ValueError(
             f"num_layers={n} not divisible by pipeline stages {num_stages}"
@@ -209,6 +239,7 @@ def make_pp_lm_forward(
     """
     from tritonk8ssupervisor_tpu.models.transformer import Block
 
+    _check_pp_model(model)
     block = Block(
         num_heads=model.num_heads,
         attention_fn=model.attention_fn,

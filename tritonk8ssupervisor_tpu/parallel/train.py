@@ -401,10 +401,15 @@ def make_lm_train_step(
     `grad_accum` > 1 splits the batch into that many microbatches inside
     the step (lax.scan), accumulating gradients before the single
     optimizer update — the activation-memory lever for batches whose
-    peak footprint exceeds HBM. Mathematically EXACT for this model
-    family (the loss is a mean over equally-sized chunks and the LM has
-    no batch statistics), unlike batch-norm models where microbatching
-    changes the normalisation.
+    peak footprint exceeds HBM. Mathematically EXACT for DENSE LMs (the
+    loss is a mean over equally-sized chunks and the dense LM has no
+    batch statistics), unlike batch-norm models where microbatching
+    changes the normalisation. MoE LMs are the in-family caveat: the
+    router load-balance/z aux losses are batch statistics (fraction of
+    tokens per expert), so the mean of per-microbatch aux differs from
+    the full-batch aux — the main loss term stays exact, the aux
+    regulariser becomes a per-chunk average (tested:
+    tests/test_transformer.py::test_grad_accum_moe_token_loss_exact).
     """
     if loss_fn is not None and metrics_fn is not None:
         raise ValueError("pass loss_fn or metrics_fn, not both")
