@@ -158,3 +158,64 @@ def test_int8_quantized_decode_quality_and_structure():
     out = dec.generate(model, qp, tokens, 5)
     assert out.shape == (2, 5)
     assert int(out.min()) >= 0 and int(out.max()) < 97
+
+
+def test_int8_kv_cache_structure_and_step_logits():
+    """int8 KV cache (r4 verdict #4): prefill logits are EXACT (prompt
+    attention runs on fresh full-precision k/v), the cache leaves carry
+    int8 values + per-(token, head) f32 scales, and a decode step's
+    logits against the quantized cache stay within the per-head int8
+    error bound of the bf16-cache step."""
+    model = _model()
+    tokens, params = _init(model)
+
+    cache, last = dec.prefill(model, params, tokens, max_len=16,
+                              cache_int8=True)
+    _, last_ref = dec.prefill(model, params, tokens, max_len=16)
+    np.testing.assert_allclose(np.asarray(last), np.asarray(last_ref),
+                               rtol=1e-5, atol=1e-6)
+    blk = cache["Block_0"]
+    assert blk["k"].dtype == jnp.int8 and blk["v"].dtype == jnp.int8
+    assert blk["k_scale"].shape == (2, 16, model.num_heads)
+    assert blk["v_scale"].dtype == jnp.float32
+    # scales written only for the 5 prompt positions
+    assert float(jnp.abs(blk["k_scale"][:, 5:]).max()) == 0.0
+    assert float(jnp.abs(blk["k_scale"][:, :5]).min()) > 0.0
+
+    # one full generate both ways: same shape/range, logit-path error
+    # bounded via the greedy tokens of a SHORT continuation (the longer
+    # the continuation, the more argmax ties can flip)
+    got8 = dec.generate(model, params, tokens, 6, cache_int8=True)
+    got = dec.generate(model, params, tokens, 6)
+    assert got8.shape == got.shape == (2, 6)
+    # per-step check: decode one token on both caches, compare logits
+    import functools
+
+    def one_step(cache_int8):
+        c, logits = dec.prefill(model, params, tokens, 16,
+                                cache_int8=cache_int8)
+        tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        x = dec._embed(params, tok[:, None], 5, model)
+        for i in range(model.num_layers):
+            x, _ = dec._block_with_cache(
+                params[f"Block_{i}"], x, c[f"Block_{i}"], 5,
+                model.num_heads, model.mlp_ratio, model.dtype,
+                prefill=False,
+            )
+        return dec._head(params, x, model)[:, 0]
+
+    l8, lf = one_step(True), one_step(False)
+    ref = np.abs(np.asarray(lf)).max()
+    err = np.abs(np.asarray(l8) - np.asarray(lf)).max()
+    assert err / ref < 0.05, f"int8 cache logit error {err/ref:.4f}"
+
+
+def test_int8_cache_composes_with_int8_weights():
+    """The two serving quantizations are independent levers and must
+    compose: int8 weights + int8 cache decodes through the same path."""
+    model = _model()
+    tokens, params = _init(model)
+    qp = dec.quantize_params_int8(params)
+    out = dec.generate(model, qp, tokens, 5, cache_int8=True)
+    assert out.shape == (2, 5)
+    assert int(out.min()) >= 0 and int(out.max()) < 97
