@@ -208,7 +208,19 @@ def decode_records(on_tpu: bool) -> list[dict]:
         ]
     records = []
     for name, baseline, kw in configs:
-        result = run_benchmark(**kw)
+        # per-config isolation: one config's failure (e.g. batch 1 not
+        # dividing a multi-chip mesh) must not erase the other's row —
+        # same failed-vs-never-ran contract as the family loop in main()
+        try:
+            result = run_benchmark(**kw)
+        except Exception as exc:  # noqa: BLE001 - stub this row only
+            print(f"{name} failed ({exc!r}); emitting stub",
+                  file=sys.stderr)
+            records.append({
+                "metric": f"{name}_tokens_per_sec_per_chip",
+                "error": repr(exc),
+            })
+            continue
         value = result["decode_tokens_per_sec_per_chip"]
         records.append({
             "metric": f"{name}_tokens_per_sec_per_chip",

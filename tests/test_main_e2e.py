@@ -284,8 +284,8 @@ def test_missing_terraform_binary_is_friendly(fake_world, capsys):
 
 def test_checkpoint_dir_flows_into_manifests(fake_world, capsys):
     """--checkpoint-dir (round-2 VERDICT missing #4): the CLI flag must
-    reach the generated Job command as a per-slice gs:// path with the
-    GCS backend added to the self-install line."""
+    reach the generated Job command as a gs:// path with the GCS backend
+    added to the self-install line (single slice: no slice suffix)."""
     import yaml
 
     work, _ = fake_world
@@ -301,7 +301,8 @@ def test_checkpoint_dir_flows_into_manifests(fake_world, capsys):
         (RunPaths(work).manifests_dir / "bench-job-0.yaml").read_text()
     )
     script = job["spec"]["template"]["spec"]["containers"][0]["command"][-1]
-    assert "--checkpoint-dir gs://bkt/ckpt/slice-0" in script
+    assert "--checkpoint-dir gs://bkt/ckpt" in script
+    assert "slice-0" not in script
     assert "gcsfs" in script
 
 

@@ -123,6 +123,133 @@ def test_cluster_env_partial_is_error(tmp_path):
         )
 
 
+# ---------------------------------------------------- cross-slice (r5)
+
+
+def _xslice_environ(slice_id, local_id, slices=2, per_slice=2):
+    return {
+        "JAX_COORDINATOR_ADDRESS": "10.0.0.1:8476",
+        "JAX_NUM_PROCESSES": str(slices * per_slice),
+        "JAX_PROCESS_ID": str(local_id),
+        "TK8S_NUM_SLICES": str(slices),
+        "TK8S_SLICE_ID": str(slice_id),
+        "TK8S_PROCS_PER_SLICE": str(per_slice),
+    }
+
+
+def test_cluster_env_cross_slice_global_ids(tmp_path):
+    """The slice arithmetic the manifests cannot do: global process id =
+    slice_id * procs_per_slice + local id, slice-major over the full
+    host set (r4 verdict missing #1)."""
+    absent = tmp_path / "absent"
+    seen = []
+    for s in range(2):
+        for p in range(2):
+            env = cluster_env(_xslice_environ(s, p), env_file=absent)
+            assert env.is_multi_slice and env.is_multi_host
+            assert env.num_processes == 4
+            seen.append(env.global_process_id)
+    assert seen == [0, 1, 2, 3]
+    # single-slice env: global id IS the local id, no slice fields needed
+    env = cluster_env(
+        {"JAX_COORDINATOR_ADDRESS": "x:1", "JAX_NUM_PROCESSES": "2",
+         "JAX_PROCESS_ID": "1"},
+        env_file=absent,
+    )
+    assert not env.is_multi_slice and env.global_process_id == 1
+
+
+def test_cluster_env_cross_slice_validation(tmp_path):
+    absent = tmp_path / "absent"
+    bad = _xslice_environ(0, 0)
+    bad["JAX_NUM_PROCESSES"] = "2"  # != 2 slices x 2 procs
+    with pytest.raises(RuntimeError, match="must equal"):
+        cluster_env(bad, env_file=absent)
+    bad = _xslice_environ(5, 0)
+    with pytest.raises(RuntimeError, match="out of range"):
+        cluster_env(bad, env_file=absent)
+    incomplete = _xslice_environ(0, 0)
+    del incomplete["TK8S_PROCS_PER_SLICE"]
+    with pytest.raises(RuntimeError, match="incomplete"):
+        cluster_env(incomplete, env_file=absent)
+
+
+def test_initialize_from_env_exports_megascale(tmp_path, monkeypatch):
+    """Cross-slice initialize must export libtpu's MEGASCALE_* DCN
+    transport vars before forming the process group (inert on CPU, the
+    contract on real multislice TPU)."""
+    from tritonk8ssupervisor_tpu.parallel import distributed
+
+    for var in ("MEGASCALE_COORDINATOR_ADDRESS", "MEGASCALE_NUM_SLICES",
+                "MEGASCALE_SLICE_ID", "MEGASCALE_PORT"):
+        monkeypatch.delenv(var, raising=False)
+    captured = {}
+
+    def fake_init(**kw):
+        captured.update(kw)
+
+    monkeypatch.setattr(jax.distributed, "initialize", fake_init)
+    env = distributed.initialize_from_env(
+        _xslice_environ(1, 1), env_file=tmp_path / "absent"
+    )
+    assert captured == {
+        "coordinator_address": "10.0.0.1:8476",
+        "num_processes": 4,
+        "process_id": 3,  # slice 1, local 1 -> global 3
+    }
+    import os
+
+    assert os.environ["MEGASCALE_COORDINATOR_ADDRESS"] == "10.0.0.1"
+    assert os.environ["MEGASCALE_NUM_SLICES"] == "2"
+    assert os.environ["MEGASCALE_SLICE_ID"] == "1"
+    assert env.global_process_id == 3
+
+
+def test_cross_slice_mesh_layout():
+    """make_cross_slice_mesh: slices land slice-major in the data axis's
+    major positions — dp crosses DCN exactly once; model/expert/pipe
+    index within a slice."""
+    from tritonk8ssupervisor_tpu.parallel import make_cross_slice_mesh
+
+    devs = jax.devices()
+    mesh = make_cross_slice_mesh(num_slices=2, model_parallelism=2)
+    assert mesh.shape == {
+        DATA_AXIS: 4, EXPERT_AXIS: 1, PIPE_AXIS: 1, MODEL_AXIS: 2,
+    }
+    grid = mesh.devices.reshape(4, 2)
+    # data rows 0-1 are slice 0's devices, rows 2-3 slice 1's
+    assert [d.id for d in grid[:2].ravel()] == [d.id for d in devs[:4]]
+    assert [d.id for d in grid[2:].ravel()] == [d.id for d in devs[4:]]
+    # per-slice divisibility: model axis may not straddle a slice
+    with pytest.raises(ValueError, match="straddle"):
+        make_cross_slice_mesh(num_slices=2, model_parallelism=8)
+    with pytest.raises(ValueError, match="equal slices"):
+        make_cross_slice_mesh(num_slices=3)
+    with pytest.raises(ValueError, match="pass num_slices"):
+        make_cross_slice_mesh()
+
+
+def test_cross_slice_dp_gradients_reduce_across_slices():
+    """The actual cross-slice promise: a dp train step on the 2-slice
+    mesh computes THE SAME update as the single-surface mesh — the
+    gradient psum spans the slice boundary (modeled on the CPU mesh; the
+    process-group form is tests/test_multiprocess.py)."""
+    from tritonk8ssupervisor_tpu.parallel import make_cross_slice_mesh
+
+    results = []
+    for m in (make_cross_slice_mesh(num_slices=2), make_mesh()):
+        state, step, images, labels = small_setup(m)
+        im = jax.device_put(images, batch_sharding(m))
+        lb = jax.device_put(labels, batch_sharding(m, ndim=1))
+        state, metrics = step(state, im, lb)
+        results.append((float(metrics["loss"]),
+                        np.asarray(jax.device_get(
+                            jax.tree_util.tree_leaves(state.params)[0]))))
+    (l_x, p_x), (l_1, p_1) = results
+    np.testing.assert_allclose(l_x, l_1, rtol=1e-6)
+    np.testing.assert_allclose(p_x, p_1, rtol=1e-5, atol=1e-6)
+
+
 # --------------------------------------------------------------- train step
 
 

@@ -80,4 +80,4 @@ def test_benchmark_job_budgets_gang_restarts():
     # retries only help if each one resumes: the generated command must
     # carry the checkpoint dir
     command = " ".join(job["spec"]["template"]["spec"]["containers"][0]["command"])
-    assert "--checkpoint-dir gs://b/ck/slice-0" in command
+    assert "--checkpoint-dir gs://b/ck" in command

@@ -25,7 +25,7 @@ import jax.numpy as jnp
 
 from tritonk8ssupervisor_tpu.models import TransformerLM
 from tritonk8ssupervisor_tpu.models import decode as dec
-from tritonk8ssupervisor_tpu.parallel import batch_sharding, make_mesh
+from tritonk8ssupervisor_tpu.parallel import batch_sharding, make_workload_mesh
 from tritonk8ssupervisor_tpu.parallel import mesh as mesh_lib
 from tritonk8ssupervisor_tpu.parallel.mesh import replicated
 
@@ -55,7 +55,7 @@ def run_benchmark(
     # params replicate, the batch (and with it the KV cache, by
     # propagation) shards over the mesh's batch axes — so a slice-wide
     # Job measures the slice, not chip 0 with the rest idle
-    mesh = make_mesh()
+    mesh = make_workload_mesh()
     num_chips = int(mesh.devices.size)
     if batch % mesh_lib.batch_degree(mesh):
         raise ValueError(

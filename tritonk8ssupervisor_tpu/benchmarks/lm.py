@@ -23,7 +23,10 @@ from tritonk8ssupervisor_tpu.utils import perf
 
 from tritonk8ssupervisor_tpu.models import TransformerLM
 from tritonk8ssupervisor_tpu.ops.ring_attention import ring_attention
-from tritonk8ssupervisor_tpu.parallel import initialize_from_env, make_mesh
+from tritonk8ssupervisor_tpu.parallel import (
+    initialize_from_env,
+    make_workload_mesh,
+)
 from tritonk8ssupervisor_tpu.parallel import train as train_lib
 from tritonk8ssupervisor_tpu.parallel import mesh as mesh_lib
 from tritonk8ssupervisor_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
@@ -115,7 +118,10 @@ def run_benchmark(
             "(mesh.param_shardings only shards evenly-dividing leading "
             "dims) while the run reports itself expert-parallel"
         )
-    mesh = make_mesh(
+    # slice-aware: on a cross-slice deployment the data axis spans the
+    # slices over DCN while sp/ep/pp stay within a slice (mesh.py
+    # make_workload_mesh); single-slice runs get the plain mesh
+    mesh = make_workload_mesh(
         model_parallelism=sequence_parallelism,
         expert_parallelism=expert_parallelism,
         pipeline_parallelism=pipeline_parallelism,

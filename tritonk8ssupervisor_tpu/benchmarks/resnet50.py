@@ -30,7 +30,7 @@ from tritonk8ssupervisor_tpu.models import ResNet18, ResNet50, ViT
 from tritonk8ssupervisor_tpu.parallel import (
     batch_sharding,
     initialize_from_env,
-    make_mesh,
+    make_workload_mesh,
 )
 from tritonk8ssupervisor_tpu.parallel import train as train_lib
 from tritonk8ssupervisor_tpu.parallel import mesh as mesh_lib
@@ -67,7 +67,7 @@ def run_benchmark(
 
     Returns a metrics dict; bench.py turns it into the driver JSON line.
     """
-    mesh = make_mesh(model_parallelism=model_parallelism)
+    mesh = make_workload_mesh(model_parallelism=model_parallelism)
     num_chips = mesh.devices.size
     data_degree = mesh_lib.batch_degree(mesh)
     global_batch = batch_per_chip * data_degree

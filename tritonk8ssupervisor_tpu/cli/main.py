@@ -94,7 +94,8 @@ def build_parser() -> argparse.ArgumentParser:
         default=os.environ.get("TK8S_CHECKPOINT_DIR") or None,
         metavar="DIR",
         help="checkpoint directory for the generated benchmark Job — use a "
-        "gs:// bucket so checkpoints survive pod restarts (each slice "
+        "gs:// bucket so checkpoints survive pod restarts (cross-slice "
+        "training shares DIR; with --independent-slices each slice "
         "writes DIR/slice-N). Also read from TK8S_CHECKPOINT_DIR.",
     )
     parser.add_argument(
@@ -136,6 +137,14 @@ def build_parser() -> argparse.ArgumentParser:
         default="workload",
         metavar="NAME",
         help="Job/Service name prefix for --workload-image manifests",
+    )
+    parser.add_argument(
+        "--independent-slices",
+        action="store_true",
+        help="with num_slices > 1, compile each slice's Jobs as an "
+        "independent JAX cluster (the pre-r5 behavior) instead of the "
+        "default single cross-slice training surface spanning all "
+        "slices over DCN (docs/parallelism.md)",
     )
     parser.add_argument(
         "--show-config",
@@ -308,6 +317,8 @@ def provision(args, paths: state.RunPaths, prompter: Prompter) -> int:
                 args.workload_command or ""
             )
             job_kwargs["workload_name"] = args.workload_name
+        if args.independent_slices:
+            job_kwargs["cross_slice"] = False
         manifest_paths = compiler.write_manifests(
             config, paths.manifests_dir, **job_kwargs
         )

