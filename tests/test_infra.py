@@ -207,3 +207,31 @@ def test_inventory_carries_global_coordinator():
     for line in host_lines:
         assert "global_coordinator=10.0.0.1" in line
     assert "slice_coordinator=10.0.1.1" in host_lines[2]
+
+
+def test_tpuhost_cluster_rendezvous_acceptance():
+    """The slice/cluster-wide acceptance (r4 verdict weak #4): after the
+    per-host chip smoke, every multi-host or multi-slice deployment must
+    prove the hosts form ONE JAX cluster — initialize_from_env + global
+    device count — before the play (and the ready banner) succeeds."""
+    tasks = load_yaml("ansible/roles/tpuhost/tasks/main.yml")
+    names = [t["name"] for t in tasks]
+    # ordering: per-host smoke first, rendezvous after
+    per_host = next(i for i, n in enumerate(names) if "Verify JAX" in n)
+    cluster = next(i for i, n in enumerate(names) if "rendezvous" in n)
+    assert cluster > per_host
+    task = tasks[cluster]
+    assert task["when"] == "(num_slices | int) > 1 or (hosts_per_slice | int) > 1"
+    assert task["retries"] == 2  # bounded, not unbounded
+    assert "cluster_smoke_cmd" in task["ansible.builtin.shell"]
+    # the command itself: env-file rendezvous + global-count assertion,
+    # expected count matching the deployment shape
+    single = cc.to_ansible_vars(cfg())["cluster_smoke_cmd"]
+    assert "initialize_from_env" in single
+    assert "jax.device_count()" in single and "== 16" in single  # 4x4 v5e
+    cross = cc.to_ansible_vars(cfg(num_slices=3))["cluster_smoke_cmd"]
+    assert "== 48" in cross  # 3 slices x 16 chips
+    assert single.startswith("timeout ")  # a wedged rendezvous can't hang
+    # concurrency precondition: ansible must not hold hosts back
+    cfg_text = (REPO / "ansible" / "ansible.cfg").read_text()
+    assert re.search(r"^forks = \d{2,}", cfg_text, re.MULTILINE)
