@@ -57,8 +57,21 @@ def test_save_restore_round_trip(tmp_path):
 
 
 def test_restore_without_checkpoint_raises(tmp_path):
+    # a toy TrainState: the missing-checkpoint contract doesn't depend
+    # on the model, and skipping the ResNet init keeps this in the
+    # default tier's time budget (r4 verdict weak #1)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
     mesh = make_mesh()
-    state, shardings, *_ = make_state(mesh)
+    state = train_lib.TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params={"w": jnp.zeros((4, 4))},
+        batch_stats={},
+        opt_state=(),
+    )
+    shardings = jax.tree_util.tree_map(
+        lambda _: NamedSharding(mesh, P()), state
+    )
     ckpt = TrainCheckpointer(tmp_path / "empty")
     assert ckpt.latest_step() is None
     try:

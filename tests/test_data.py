@@ -93,6 +93,7 @@ def test_global_batch_from_local_single_process_mixed_ranks():
     np.testing.assert_array_equal(np.asarray(out["labels"]), local["labels"])
 
 
+@pytest.mark.slow
 def test_prefetched_batches_feed_a_train_step():
     """End to end: prefetched real-data batches drive the sharded train
     step (the loader and the step agree on layout)."""

@@ -19,6 +19,7 @@ def test_resnet18_forward_shapes():
     assert "batch_stats" in variables
 
 
+@pytest.mark.slow
 def test_resnet_compute_is_bf16_params_f32():
     model = ResNet18(num_classes=10)
     x = jnp.ones((1, 32, 32, 3))

@@ -234,17 +234,28 @@ def test_cross_slice_dp_gradients_reduce_across_slices():
     mesh computes THE SAME update as the single-surface mesh — the
     gradient psum spans the slice boundary (modeled on the CPU mesh; the
     process-group form is tests/test_multiprocess.py)."""
+    from tritonk8ssupervisor_tpu.models import TransformerLM
     from tritonk8ssupervisor_tpu.parallel import make_cross_slice_mesh
 
+    model = TransformerLM(
+        vocab_size=64, num_layers=1, num_heads=2, embed_dim=32,
+        max_seq_len=16, dtype=jnp.float32, logits_dtype=jnp.float32,
+    )
+    tx = train_lib.default_optimizer(learning_rate=0.1)
+    tokens = jax.random.randint(jax.random.key(1), (8, 16), 0, 64)
     results = []
     for m in (make_cross_slice_mesh(num_slices=2), make_mesh()):
-        state, step, images, labels = small_setup(m)
-        im = jax.device_put(images, batch_sharding(m))
-        lb = jax.device_put(labels, batch_sharding(m, ndim=1))
-        state, metrics = step(state, im, lb)
+        state, shardings = train_lib.create_train_state(
+            model, jax.random.key(0), jax.ShapeDtypeStruct((8, 16), jnp.int32),
+            m, tx,
+        )
+        step = train_lib.make_lm_train_step(model, tx, m, shardings)
+        state, metrics = step(
+            state, jax.device_put(tokens, batch_sharding(m, 2))
+        )
         results.append((float(metrics["loss"]),
                         np.asarray(jax.device_get(
-                            jax.tree_util.tree_leaves(state.params)[0]))))
+                            state.params["Block_0"]["qkv"]["kernel"]))))
     (l_x, p_x), (l_1, p_1) = results
     np.testing.assert_allclose(l_x, l_1, rtol=1e-6)
     np.testing.assert_allclose(p_x, p_1, rtol=1e-5, atol=1e-6)

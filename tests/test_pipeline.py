@@ -63,6 +63,7 @@ def test_pipeline_apply_fewer_microbatches_than_stages():
                                rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_pipeline_apply_gradients_match_sequential():
     """The transpose of the schedule (ppermute reversal + scan transpose
     + the data-axis psum shard_map inserts for replicated-in params)
@@ -214,10 +215,10 @@ def test_pp_rejects_moe_model_with_clear_error():
     stack_block_params."""
     mesh = make_mesh(pipeline_parallelism=4)
     model = _tiny_lm(moe_experts=4)
-    tokens = jax.random.randint(jax.random.key(0), (4, 16), 0, 64)
-    params = model.init(jax.random.key(1), tokens, train=False)["params"]
+    # params never get touched: the guard raises first (no model.init,
+    # which would cost a compile in the default tier)
     with pytest.raises(ValueError, match="dense TransformerLM only"):
-        pp.pipelined_lm_params(model, params, mesh)
+        pp.pipelined_lm_params(model, {}, mesh)
     with pytest.raises(ValueError, match="dense TransformerLM only"):
         pp.make_pp_lm_forward(model, mesh, num_microbatches=2)
 
