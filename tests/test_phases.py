@@ -45,3 +45,68 @@ def test_failed_phase_logged_and_reraised(tmp_path):
     assert last["status"] == "failed"
     assert last["error"] == "boom"
     assert timer.durations["terraform"] == 1.0
+
+
+# ------------------------------------------------- budgets / runlog analysis
+
+
+def test_analyze_runlog_budgets(tmp_path):
+    """The runlog analysis mode (r4 verdict missing #3): per-phase
+    durations vs PHASE_BUDGETS, re-runs summed, failures and overruns
+    flagged, exit code fails the check."""
+    import json as json_mod
+
+    from tritonk8ssupervisor_tpu.utils import phases as ph
+
+    log = tmp_path / "runlog.jsonl"
+    records = [
+        {"phase": "discover-environment", "status": "start"},
+        {"phase": "discover-environment", "status": "done", "seconds": 5.0},
+        {"phase": "terraform-apply", "status": "done", "seconds": 400.0},
+        # re-run converges: second attempt adds on
+        {"phase": "terraform-apply", "status": "done", "seconds": 100.0},
+        {"phase": "host-configuration", "status": "done", "seconds": 300.0},
+        {"phase": "mystery-phase", "status": "done", "seconds": 9.0},
+        {"phase": "probe-job", "status": "failed", "seconds": 10.0,
+         "error": "boom"},
+    ]
+    log.write_text("\n".join(json_mod.dumps(r) for r in records) + "\n")
+
+    rows = {r["phase"]: r for r in ph.analyze_runlog(log)}
+    assert rows["discover-environment"]["over"] is False
+    assert rows["terraform-apply"]["seconds"] == 500.0
+    assert rows["terraform-apply"]["over"] is True  # 500 > 480 budget
+    assert rows["host-configuration"]["over"] is True  # 300 > 180
+    assert rows["mystery-phase"]["budget"] is None
+    assert rows["mystery-phase"]["over"] is False
+    assert rows["probe-job"]["status"] == "failed"
+
+    report = ph.format_runlog_report(ph.analyze_runlog(log))
+    assert "OVER-BUDGET" in report and "FAILED" in report
+    assert "north star" in report
+    assert ph.main([str(log)]) == 1
+
+    # an in-budget run exits 0
+    good = tmp_path / "good.jsonl"
+    good.write_text(json_mod.dumps(
+        {"phase": "terraform-apply", "status": "done", "seconds": 300.0}
+    ) + "\n")
+    assert ph.main([str(good)]) == 0
+
+
+def test_budgets_sum_inside_north_star():
+    """The per-phase budgets must themselves add up inside the 15-minute
+    setup->ready target, or the table promises the impossible."""
+    from tritonk8ssupervisor_tpu.utils import phases as ph
+
+    assert sum(ph.PHASE_BUDGETS.values()) <= ph.TOTAL_BUDGET_SECONDS
+    # every CLI pipeline phase name is budgeted (keep in sync with
+    # cli/main.py timer.phase(...) call sites)
+    import re
+    from pathlib import Path
+
+    main_py = (Path(ph.__file__).resolve().parents[1] / "cli" /
+               "main.py").read_text()
+    used = set(re.findall(r'timer\.phase\("([^"]+)"\)', main_py))
+    unbudgeted = used - set(ph.PHASE_BUDGETS)
+    assert not unbudgeted, f"phases without budgets: {sorted(unbudgeted)}"

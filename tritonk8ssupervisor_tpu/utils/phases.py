@@ -106,3 +106,99 @@ class PhaseTimer:
         for name, seconds in self.durations.items():
             print(f"  {name:<24} {seconds:8.1f}s", file=self._out)
         print(f"  {'TOTAL':<24} {self.total:8.1f}s", file=self._out, flush=True)
+
+
+# Per-phase time budgets (seconds) for the provisioning pipeline — the
+# <15 min setup->ready north star (BASELINE.md) broken into auditable
+# parts. Sourced from typical published GCP latencies rather than a
+# local measurement (no live quota in the dev environment — the first
+# real-quota run is judged against these, not merely logged):
+#   - terraform-apply carries the GKE control-plane creation (typically
+#     5-8 min for a zonal cluster) plus TPU node-pool spin-up;
+#     tpu-vm mode's QueuedResource->READY is usually faster.
+#   - readiness-wait covers node registration + device-plugin
+#     advertisement of google.com/tpu (minutes after nodes boot).
+#   - host-configuration is ansible over SSH: jax[tpu] pip install
+#     dominates (~1 GB of wheels per host, parallel across hosts).
+#   - The budgets sum to 870 s — inside the 900 s target with margin
+#     for the prompts-excluded phases.
+PHASE_BUDGETS: dict[str, float] = {
+    "discover-environment": 20.0,
+    "terraform-apply": 480.0,
+    "host-configuration": 180.0,
+    "readiness-wait": 120.0,
+    "compile-manifests": 20.0,
+    "probe-job": 50.0,
+}
+TOTAL_BUDGET_SECONDS = 900.0  # the BASELINE.md north star
+
+
+def analyze_runlog(path: Path) -> list[dict]:
+    """Per-phase durations from a runlog.jsonl, judged against
+    PHASE_BUDGETS: [{phase, seconds, budget, over, status}] in first-seen
+    order, repeated phases (re-runs) summed the way PhaseTimer.report
+    sums them. Unknown phases get no budget and can't be over."""
+    rows: dict[str, dict] = {}
+    for line in Path(path).read_text().splitlines():
+        if not line.strip():
+            continue
+        record = json.loads(line)
+        if record.get("status") not in ("done", "failed"):
+            continue
+        name = record["phase"]
+        row = rows.setdefault(
+            name, {"phase": name, "seconds": 0.0, "status": "done"}
+        )
+        row["seconds"] += float(record.get("seconds", 0.0))
+        if record["status"] == "failed":
+            row["status"] = "failed"
+    out = []
+    for row in rows.values():
+        budget = PHASE_BUDGETS.get(row["phase"])
+        row["budget"] = budget
+        row["over"] = budget is not None and row["seconds"] > budget
+        out.append(row)
+    return out
+
+
+def format_runlog_report(rows: list[dict]) -> str:
+    """The budget table: one line per phase, OVER-BUDGET/FAILED flags,
+    and the total judged against TOTAL_BUDGET_SECONDS."""
+    lines = [f"{'phase':<24} {'seconds':>9} {'budget':>9}  verdict"]
+    total = 0.0
+    for row in rows:
+        total += row["seconds"]
+        budget = "-" if row["budget"] is None else f"{row['budget']:.0f}"
+        verdict = ("FAILED" if row["status"] == "failed"
+                   else "OVER-BUDGET" if row["over"] else "ok")
+        lines.append(
+            f"{row['phase']:<24} {row['seconds']:>8.1f}s {budget:>8}s"
+            f"  {verdict}"
+        )
+    verdict = "ok" if total <= TOTAL_BUDGET_SECONDS else "OVER-BUDGET"
+    lines.append(
+        f"{'TOTAL':<24} {total:>8.1f}s {TOTAL_BUDGET_SECONDS:>8.0f}s"
+        f"  {verdict} (north star: setup->ready < 15 min)"
+    )
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    """CLI: python -m tritonk8ssupervisor_tpu.utils.phases runlog.jsonl —
+    exit 1 when any phase failed or ran over budget, so the first
+    real-quota run validates the north star instead of just logging it
+    (r4 verdict missing #3)."""
+    import argparse
+
+    parser = argparse.ArgumentParser(description=main.__doc__)
+    parser.add_argument("runlog", type=Path)
+    args = parser.parse_args(argv)
+    rows = analyze_runlog(args.runlog)
+    print(format_runlog_report(rows))
+    bad = any(r["over"] or r["status"] == "failed" for r in rows)
+    total_over = sum(r["seconds"] for r in rows) > TOTAL_BUDGET_SECONDS
+    return 1 if bad or total_over else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
