@@ -343,20 +343,30 @@ def test_pair_kernel_invalid_labels_read_incorrect():
 
 
 def test_flash_bwd_block_env_read_per_call(monkeypatch):
-    """r4 advisor: TK8S_FLASH_BWD_BLOCK must take effect when set AFTER
-    import (it is read per call and keyed into the kernel cache), and
-    invalid values fall back to the forward block."""
-    from tritonk8ssupervisor_tpu.ops.flash_attention import _bwd_block
+    """r4 advisor: the TK8S_FLASH_* sweep overrides must take effect
+    when set AFTER import (read per call and keyed into the kernel
+    cache), and invalid values fall back; r5 adds independent dkv/dq
+    blocks and the fused-backward toggle."""
+    from tritonk8ssupervisor_tpu.ops.flash_attention import _bwd_blocks
 
-    monkeypatch.delenv("TK8S_FLASH_BWD_BLOCK", raising=False)
-    assert _bwd_block(1024, 512) == 512          # default
+    for var in ("TK8S_FLASH_BWD_BLOCK", "TK8S_FLASH_DKV_BLOCK",
+                "TK8S_FLASH_DQ_BLOCK", "TK8S_FLASH_FUSED_BWD"):
+        monkeypatch.delenv(var, raising=False)
+    assert _bwd_blocks(1024, 512) == (512, 512, True)    # fused default
     monkeypatch.setenv("TK8S_FLASH_BWD_BLOCK", "256")
-    assert _bwd_block(1024, 512) == 256          # post-import mutation works
-    monkeypatch.setenv("TK8S_FLASH_BWD_BLOCK", "384")
-    assert _bwd_block(1024, 512) == 512          # 384 !| 1024 -> fwd block
-    monkeypatch.setenv("TK8S_FLASH_BWD_BLOCK", "100")
-    assert _bwd_block(1024, 512) == 512          # not a 128-multiple
+    assert _bwd_blocks(1024, 512)[:2] == (256, 256)      # joint override
+    monkeypatch.setenv("TK8S_FLASH_DQ_BLOCK", "128")
+    assert _bwd_blocks(1024, 512)[:2] == (256, 128)      # dq splits off
+    monkeypatch.setenv("TK8S_FLASH_DKV_BLOCK", "384")    # 384 !| 1024
+    assert _bwd_blocks(1024, 512)[:2] == (256, 128)      # -> joint
+    monkeypatch.setenv("TK8S_FLASH_BWD_BLOCK", "100")    # not 128-mult
+    assert _bwd_blocks(1024, 512)[1] == 128              # dq still 128
+    assert _bwd_blocks(1024, 512)[0] == 512              # joint -> default
     monkeypatch.setenv("TK8S_FLASH_BWD_BLOCK", "-512")
-    assert _bwd_block(1024, 512) == 512          # negative -> fwd block
+    assert _bwd_blocks(1024, 512)[0] == 512              # negative -> dflt
     monkeypatch.setenv("TK8S_FLASH_BWD_BLOCK", "auto")
-    assert _bwd_block(1024, 512) == 512          # non-numeric -> fwd block
+    assert _bwd_blocks(1024, 512)[0] == 512              # non-numeric
+    monkeypatch.setenv("TK8S_FLASH_FUSED_BWD", "0")
+    assert _bwd_blocks(1024, 512)[2] is False            # unfused A/B
+    monkeypatch.setenv("TK8S_FLASH_FUSED_BWD", "1")
+    assert _bwd_blocks(1024, 512)[2] is True

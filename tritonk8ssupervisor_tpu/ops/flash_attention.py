@@ -50,25 +50,53 @@ from tritonk8ssupervisor_tpu.ops.ring_attention import (
 # ~25% in the same sweep.
 _BLOCK = 512
 
-def _bwd_block(seq: int, block: int) -> int:
-    """Backward (dkv/dq) block rows/cols, swept separately once the r04
-    roofline showed the backward kernels at ~15% of either roofline at
-    seq 1024. Measured (seq 1024 b8 full LM step): 512 -> 62.7 ms,
-    256 -> 73.2, 128 -> 107.3, 1024 -> 63.6 — 512 is the optimum from
-    BOTH directions, so the backward's sub-roofline rate is the kernel's
-    recompute/pipeline structure, not tiling. TK8S_FLASH_BWD_BLOCK
-    overrides for sweeps — read per call (not at import), so an
-    in-process sweep that mutates os.environ takes effect; the value is
-    part of _splash_kernel's cache key. Same validity constraints as
-    the forward pick (divide seq, 128-lane multiple), else the forward
-    block."""
+def _env_block(var: str, seq: int, fallback: int) -> int:
+    """A block-size override from the environment, read per call (not
+    at import — an in-process sweep that mutates os.environ must take
+    effect; the values are part of _splash_kernel's cache key) with the
+    forward pick's validity constraints (divide seq, 128-lane multiple,
+    positive); invalid or unset -> `fallback`."""
+    raw = os.environ.get(var)
+    if raw is None:
+        return fallback
     try:
-        bwd = int(os.environ.get("TK8S_FLASH_BWD_BLOCK", "512"))
+        value = int(raw)
     except ValueError:
-        return block
-    if bwd > 0 and seq % bwd == 0 and bwd % 128 == 0:
-        return bwd
-    return block
+        return fallback
+    if value > 0 and seq % value == 0 and value % 128 == 0:
+        return value
+    return fallback
+
+
+def _bwd_blocks(seq: int, block: int) -> tuple[int, int, bool]:
+    """(dkv_block, dq_block, fused) for the backward kernels, swept
+    once the r04 roofline showed the backward at ~15% of either
+    roofline at seq 1024. The r04 JOINT block sweep (seq 1024 b8 full
+    LM step, unfused): 512 -> 62.7 ms, 256 -> 73.2, 128 -> 107.3,
+    1024 -> 63.6 — 512 optimal from both directions, exonerating tile
+    size. The r05 sweep split dkv/dq blocks independently (no help:
+    dkv=256 -> 69.0, dq=256 -> 67.9, dq=1024 -> 1502(!)) and re-tried
+    the FUSED backward at the tuned 512 blocks — the winner:
+
+        seq 1024 b8: unfused 63.4 ms -> fused 58.8-59.3 (139.4k tok/s)
+        seq 4096 b2: unfused 93.5 ms -> fused 87.4      ( 93.7k tok/s)
+        fused 256 -> 67.8, fused 128 -> 88.5 (512 optimal again)
+
+    r04's "unfused beats fused by ~25%" was measured before the block
+    tuning and does not survive it: one fused dkv/dq pass recomputes
+    the attention matrix ONCE per tile pair instead of once per kernel,
+    and at block 512 that recompute saving beats the unfused kernels'
+    smaller working sets. Fused is therefore the default; the sweep
+    hooks remain: TK8S_FLASH_FUSED_BWD=0 restores unfused,
+    TK8S_FLASH_BWD_BLOCK sets both blocks, TK8S_FLASH_DKV_BLOCK /
+    TK8S_FLASH_DQ_BLOCK split them (unfused only — the fused kernel
+    has no separate dq blocks). Full tables: docs/benchmarks.md."""
+    joint = _env_block("TK8S_FLASH_BWD_BLOCK", seq,
+                       512 if seq % 512 == 0 else block)
+    dkv = _env_block("TK8S_FLASH_DKV_BLOCK", seq, joint)
+    dq = _env_block("TK8S_FLASH_DQ_BLOCK", seq, joint)
+    fused = os.environ.get("TK8S_FLASH_FUSED_BWD", "1") == "1"
+    return dkv, dq, fused
 
 
 def _splash_block(seq: int) -> int | None:
@@ -84,10 +112,11 @@ def _splash_block(seq: int) -> int | None:
 
 @functools.lru_cache(maxsize=32)
 def _splash_kernel(seq: int, num_heads: int, causal: bool, block: int,
-                   bwd: int):
+                   dkv: int, dq: int, fused: bool):
     """Mask-partitioned splash kernel, cached per (seq, heads, causal,
-    fwd block, bwd block): building the mask partition info costs
-    O((seq/block)^2) host work that must not rerun on every trace."""
+    fwd block, dkv block, dq block, fused flag): building the mask
+    partition info costs O((seq/block)^2) host work that must not rerun
+    on every trace."""
     from jax.experimental.pallas.ops.tpu.splash_attention import (
         splash_attention_kernel as sk,
         splash_attention_mask as sm,
@@ -99,12 +128,12 @@ def _splash_kernel(seq: int, num_heads: int, causal: bool, block: int,
         block_q=block,
         block_kv=block,
         block_kv_compute=block,
-        block_q_dkv=bwd,
-        block_kv_dkv=bwd,
-        block_kv_dkv_compute=bwd,
-        block_q_dq=bwd,
-        block_kv_dq=bwd,
-        use_fused_bwd_kernel=False,
+        block_q_dkv=dkv,
+        block_kv_dkv=dkv,
+        block_kv_dkv_compute=dkv,
+        block_q_dq=None if fused else dq,
+        block_kv_dq=None if fused else dq,
+        use_fused_bwd_kernel=fused,
     )
     # The factory turns its mask-partition tables into jnp arrays. A
     # first call during an active jit trace would stage those as that
@@ -176,7 +205,7 @@ def flash_attention(q, k, v, causal: bool = True, layout: str = "bshd"):
         b, s, h, d = q.shape
     block = _splash_block(s)
     if block is not None:
-        kernel = _splash_kernel(s, h, causal, block, _bwd_block(s, block))
+        kernel = _splash_kernel(s, h, causal, block, *_bwd_blocks(s, block))
         # splash convention is (b, h, s, d); seq-major inputs pay the
         # relayout here, head-major inputs pass straight through.
         # splash applies no sm_scale, so fold it into q.
