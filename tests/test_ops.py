@@ -370,3 +370,70 @@ def test_flash_bwd_block_env_read_per_call(monkeypatch):
     assert _bwd_blocks(1024, 512)[2] is False            # unfused A/B
     monkeypatch.setenv("TK8S_FLASH_FUSED_BWD", "1")
     assert _bwd_blocks(1024, 512)[2] is True
+
+
+# ------------------------------------------------- mask-based maxpool backward
+
+
+def test_mask_pool_forward_matches_nn_max_pool():
+    """ops/pool_backward.max_pool_3x3_s2: the forward IS reduce_window —
+    bit-identical to the nn.max_pool call it replaces."""
+    import flax.linen as nn
+
+    from tritonk8ssupervisor_tpu.ops.pool_backward import max_pool_3x3_s2
+
+    x = jax.random.normal(jax.random.key(0), (2, 16, 16, 8), jnp.bfloat16)
+    got = max_pool_3x3_s2(x)
+    want = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_mask_pool_backward_unique_max_matches_autodiff():
+    """Where every window's max is unique, the mask backward must equal
+    select-and-scatter autodiff exactly; at ties it splits uniformly
+    (a valid subgradient) — pinned on a constructed tie."""
+    import flax.linen as nn
+
+    from tritonk8ssupervisor_tpu.ops.pool_backward import max_pool_3x3_s2
+
+    # unique maxima: distinct values everywhere (f32, no rounding ties)
+    x = jnp.arange(2 * 8 * 8 * 4, dtype=jnp.float32).reshape(2, 8, 8, 4)
+    x = x * jnp.pi % 7.1  # scramble so maxima aren't always last
+    dy = jax.random.normal(jax.random.key(1), (2, 4, 4, 4), jnp.float32)
+
+    def ref(x):
+        return nn.max_pool(x, (3, 3), strides=(2, 2),
+                           padding=((1, 1), (1, 1)))
+
+    g_mask = jax.vjp(max_pool_3x3_s2, x)[1](dy)[0]
+    g_ref = jax.vjp(ref, x)[1](dy)[0]
+    np.testing.assert_allclose(np.asarray(g_mask), np.asarray(g_ref),
+                               rtol=1e-6, atol=1e-6)
+
+    # constructed tie: an all-equal window splits dy uniformly across
+    # the tied maxima (sum of dx equals dy either way)
+    xt = jnp.zeros((1, 4, 4, 1), jnp.float32)
+    dyt = jnp.ones((1, 2, 2, 1), jnp.float32)
+    g = np.asarray(jax.vjp(max_pool_3x3_s2, xt)[1](dyt)[0])
+    np.testing.assert_allclose(g.sum(), float(np.asarray(dyt).sum()),
+                               rtol=1e-6)
+    assert (g > 0).sum() > 4  # spread across ties, not first-match
+
+
+def test_resnet_fast_pool_bwd_flag_same_tree_and_forward():
+    """The A/B lever (measured-negative r05, kept as evidence): same
+    parameter tree, identical forward."""
+    from tritonk8ssupervisor_tpu.models import ResNet18
+
+    x = jnp.ones((1, 32, 32, 3), jnp.float32)
+    plain = ResNet18(num_classes=10, num_filters=8)
+    fast = ResNet18(num_classes=10, num_filters=8, fast_pool_bwd=True)
+    vp = plain.init(jax.random.key(0), x, train=False)
+    vf = fast.init(jax.random.key(0), x, train=False)
+    assert (jax.tree_util.tree_structure(vp)
+            == jax.tree_util.tree_structure(vf))
+    np.testing.assert_allclose(
+        np.asarray(plain.apply(vp, x, train=False)),
+        np.asarray(fast.apply(vf, x, train=False)),
+        rtol=1e-5, atol=1e-5,
+    )

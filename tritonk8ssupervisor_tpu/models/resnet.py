@@ -221,6 +221,17 @@ class ResNet(nn.Module):
     # tree. A/B lever for the bandwidth-bound backward; measured results
     # in docs/benchmarks.md.
     remat_blocks: bool = False
+    # Mask-based stem max-pool backward (ops/pool_backward.py): same
+    # forward, elementwise backward instead of XLA's select-and-scatter
+    # (measured at ~535 GB/s, the step's one named sub-roofline op).
+    # Measured on v5e (r05, bs 256): 139.8 vs 98.8 ms/step — the
+    # NEGATIVE result that closes this door: select-and-scatter's
+    # traffic is already minimal (x + dy + dx), the tie-count pass adds
+    # a full re-read of x, and the 9 interior-dilated f32 accumulation
+    # terms defeat XLA's fusion into one pass. The ~0.5 ms rate claw
+    # cannot survive a >= 60% byte increase. Kept as the checked-in
+    # evidence + A/B lever (docs/benchmarks.md); off by default.
+    fast_pool_bwd: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -268,7 +279,16 @@ class ResNet(nn.Module):
                      padding=[(3, 3), (3, 3)], name="stem_conv")(x)
         x = norm(name="stem_norm")(x)
         x = nn.relu(x)
-        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        if (self.fast_pool_bwd and x.shape[1] % 2 == 0
+                and x.shape[2] % 2 == 0):
+            from tritonk8ssupervisor_tpu.ops.pool_backward import (
+                max_pool_3x3_s2,
+            )
+
+            x = max_pool_3x3_s2(x)
+        else:
+            x = nn.max_pool(x, (3, 3), strides=(2, 2),
+                            padding=((1, 1), (1, 1)))
         block_cls = (
             nn.remat(self.block_cls) if self.remat_blocks else self.block_cls
         )

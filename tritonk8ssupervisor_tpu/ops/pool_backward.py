@@ -1,0 +1,111 @@
+"""Max-pool with a mask-based backward — the select-and-scatter claw.
+
+The r4/r5 ResNet-50 roofline (utils/roofline.py; docs/benchmarks.md
+"The 99 ms wall") shows the step at 98% of the v5e's HBM peak with one
+named sub-roofline pool: the stem max-pool's backward lowers to XLA's
+`select-and-scatter`, measured at ~535 GB/s — 65% of the rate the
+surrounding elementwise fusions sustain — for 1.7 ms of the 98.8 ms
+step. Its traffic is already minimal (read x, read dy, write dx), so
+the only claw is RATE: re-express the backward as mask arithmetic that
+XLA lowers into ordinary elementwise loop fusions.
+
+`max_pool_3x3_s2` is a drop-in for the ResNet stem's
+`nn.max_pool(x, (3,3), strides=(2,2), padding=((1,1),(1,1)))`:
+
+- forward: exactly `lax.reduce_window` (what nn.max_pool lowers to) —
+  unchanged speed and numerics;
+- backward (custom_vjp): dx[p] = sum over the <=4 windows w containing
+  p of (dy[w] / ties[w]) * [x[p] == y[w]], built from 9 strided window
+  slices, compare-to-max masks, and interior-dilated pads — all
+  elementwise/layout ops, no select-and-scatter.
+
+Gradient semantics at ties: XLA's select-and-scatter routes each
+window's gradient to the FIRST maximal element (an arbitrary
+subgradient choice); this backward divides it uniformly among the tied
+maxima (also a valid subgradient — the uniform convex combination).
+The two differ only where a window's max is attained more than once —
+for the post-ReLU stem activations that means all-zero windows, where
+first-match sends dy to one zero and this sends dy/ties to each. Both
+train; tests pin exact agreement wherever the window max is unique and
+the tie-averaged property at ties.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=())
+def max_pool_3x3_s2(x):
+    """3x3 / stride-2 / pad-1 max pool over NHWC (the ResNet stem pool).
+
+    (B, H, W, C) -> (B, H//2, W//2, C) for even H, W.
+    """
+    return _pool_fwd_raw(x)
+
+
+def _pool_fwd_raw(x):
+    neg = (jnp.finfo(x.dtype).min
+           if jnp.issubdtype(x.dtype, jnp.floating)
+           else jnp.iinfo(x.dtype).min)
+    return jax.lax.reduce_window(
+        x, neg, jax.lax.max,
+        window_dimensions=(1, 3, 3, 1),
+        window_strides=(1, 2, 2, 1),
+        padding=((0, 0), (1, 1), (1, 1), (0, 0)),
+    )
+
+
+def _windows(x):
+    """The 9 strided (di, dj) window slices of padded x, each shaped like
+    the pool output — the building block for max, tie counts and masks."""
+    b, h, w, c = x.shape
+    ho, wo = h // 2, w // 2
+    neg = (jnp.finfo(x.dtype).min
+           if jnp.issubdtype(x.dtype, jnp.floating)
+           else jnp.iinfo(x.dtype).min)
+    xp = jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)), constant_values=neg)
+    wins = []
+    for di in range(3):
+        for dj in range(3):
+            wins.append(jax.lax.slice(
+                xp,
+                (0, di, dj, 0),
+                (b, di + 2 * ho - 1, dj + 2 * wo - 1, c),
+                (1, 2, 2, 1),
+            ))
+    return wins
+
+
+def _pool_fwd(x):
+    return _pool_fwd_raw(x), x
+
+
+def _pool_bwd(x, dy):
+    b, h, w, c = x.shape
+    ho, wo = dy.shape[1], dy.shape[2]
+    wins = _windows(x)
+    y = functools.reduce(jnp.maximum, wins)
+    # uniform subgradient over ties; counts >= 1 by construction
+    ties = sum((win == y).astype(jnp.float32) for win in wins)
+    g = dy.astype(jnp.float32) / ties
+    dxp = jnp.zeros((b, h + 2, w + 2, c), jnp.float32)
+    for di in range(3):
+        for dj in range(3):
+            m = (wins[3 * di + dj] == y).astype(jnp.float32) * g
+            # interior-dilate back onto the stride-2 grid at offset
+            # (di, dj) of the padded input
+            dxp = dxp + jax.lax.pad(
+                m, jnp.float32(0),
+                ((0, 0, 0),
+                 (di, h + 2 - di - (2 * ho - 1), 1),
+                 (dj, w + 2 - dj - (2 * wo - 1), 1),
+                 (0, 0, 0)),
+            )
+    return (dxp[:, 1:h + 1, 1:w + 1].astype(x.dtype),)
+
+
+max_pool_3x3_s2.defvjp(_pool_fwd, _pool_bwd)
