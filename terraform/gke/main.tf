@@ -68,6 +68,18 @@ resource "google_container_node_pool" "tpu_pool" {
   # All hosts of one slice, scheduled together on one physical slice.
   node_count = var.nodes_per_slice
 
+  # Node-level elasticity (SURVEY.md §5 failure recovery): GKE replaces
+  # failed/unhealthy TPU nodes automatically; the benchmark Job's gang
+  # restart budget (config/compile.py backoffLimit) rides on top — the
+  # node comes back via auto_repair, the JAX cluster re-forms via the
+  # Job retry, training resumes from the latest checkpoint.
+  # auto_upgrade stays off: an unsolicited node-pool upgrade mid-run is
+  # a self-inflicted preemption.
+  management {
+    auto_repair  = true
+    auto_upgrade = false
+  }
+
   # GKE rejects compact placement / tpu_topology for single-host slice
   # pools — the chips are already co-located on one machine.
   dynamic "placement_policy" {

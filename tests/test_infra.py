@@ -132,7 +132,8 @@ def test_generated_vars_cover_role_consumption():
         consumed |= set(re.findall(r"when: \((\w+)", text))
         consumed |= set(re.findall(r"until: \((\w+)", text))
     # registered task results are task-local, not vars
-    consumed -= {"jax_installed", "jax_install", "jax_smoke", "tpu_alloc", "n"}
+    consumed -= {"jax_installed", "jax_install", "jax_smoke", "tpu_alloc",
+                 "n", "watch_unit", "cluster_smoke"}
     missing = consumed - provided - defaults
     assert not missing, f"roles consume undeclared vars: {sorted(missing)}"
     # per-cluster values the roles rely on must be generator-supplied
@@ -235,3 +236,35 @@ def test_tpuhost_cluster_rendezvous_acceptance():
     # concurrency precondition: ansible must not hold hosts back
     cfg_text = (REPO / "ansible" / "ansible.cfg").read_text()
     assert re.search(r"^forks = \d{2,}", cfg_text, re.MULTILINE)
+
+
+def test_tpuhost_maintenance_watchdog_tasks():
+    """The preemption story (SURVEY.md §5, r4 'partial'): every tpu-vm
+    host gets the metadata watchdog unit installed + enabled, and every
+    env-file variant carries TK8S_DRAIN_FILE so the training loops can
+    see the drain signal."""
+    tasks = load_yaml("ansible/roles/tpuhost/tasks/main.yml")
+    install = next(t for t in tasks if "watchdog unit" in t["name"])
+    # templates/ (tracked), not files/ (gitignored archive staging,
+    # wiped by teardown) — r5 review finding
+    assert install["ansible.builtin.template"]["src"] == (
+        "tk8s-maintenance-watch.service.j2"
+    )
+    enable = next(t for t in tasks if "Enable maintenance" in t["name"])
+    assert enable["ansible.builtin.systemd"]["enabled"] is True
+    # the unit file runs this package's watchdog module
+    unit = (REPO / "ansible" / "roles" / "tpuhost" / "templates" /
+            "tk8s-maintenance-watch.service.j2").read_text()
+    assert "tritonk8ssupervisor_tpu.provision.maintenance" in unit
+    assert "Restart=always" in unit
+    # all three env variants export the drain file
+    env_tasks = [t for t in tasks if "coordination environment" in t["name"]]
+    assert len(env_tasks) == 3
+    for t in env_tasks:
+        assert "TK8S_DRAIN_FILE={{ drain_file }}" in (
+            t["ansible.builtin.copy"]["content"]
+        ), t["name"]
+    # defaults supply the path the unit writes
+    defaults = load_yaml("ansible/roles/tpuhost/defaults/main.yml")
+    assert defaults["drain_file"] == "/run/tk8s-drain"
+    assert "--drain-file {{ drain_file }}" in unit

@@ -328,3 +328,56 @@ def test_bench_workload_and_flags_reach_manifest(fake_world, capsys):
     script = container["command"][-1]  # bash -c self-install string
     assert "tritonk8ssupervisor_tpu.benchmarks.lm" in script
     assert "--seq-len 8192 --sequence-parallelism 4" in script
+
+
+def test_resize_reconverges_to_new_slice_count(fake_world, capsys):
+    """Elastic resize (SURVEY.md §5, r4 'partial' row): after a 1-slice
+    provision, --resize 2 re-runs the converging pipeline — the saved
+    config updates, terraform re-applies, and the manifests recompile
+    with TWO cross-slice Jobs sharing one coordinator."""
+    import yaml
+
+    work, calls_log = fake_world
+    config_path = saved_config(
+        work, MODE="gke", TOPOLOGY="2x2", CLUSTER_NAME="stub-cluster"
+    )
+    rc = main(["--yes", "--config", str(config_path), "--workdir", str(work)])
+    assert rc == 0, capsys.readouterr().out
+    gen = work / "manifests" / "generated"
+    assert (gen / "bench-job-0.yaml").exists()
+    assert not (gen / "bench-job-1.yaml").exists()
+
+    # --skip-readiness: the stub cluster advertises one 4-chip node, so
+    # the 8-chip readiness poll would (correctly) never pass
+    rc = main(["--yes", "--resize", "2", "--skip-readiness",
+               "--workdir", str(work)])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "Resizing: 1 -> 2" in out
+    # saved config carries the new count (the next plain re-run keeps it)
+    from tritonk8ssupervisor_tpu.config import store
+
+    assert store.load_config_file(RunPaths(work).config_file).num_slices == 2
+    # terraform re-applied (converge), and both slice Jobs exist with the
+    # cross-slice contract
+    assert (gen / "bench-job-1.yaml").exists()
+    job1 = yaml.safe_load((gen / "bench-job-1.yaml").read_text())
+    env = {e["name"]: e.get("value")
+           for e in job1["spec"]["template"]["spec"]["containers"][0]["env"]}
+    assert env["TK8S_NUM_SLICES"] == "2"
+    assert env["TK8S_SLICE_ID"] == "1"
+    assert env["JAX_COORDINATOR_ADDRESS"].startswith("resnet50-bench-0-0.")
+
+    # shrink back down: the stale slice-1 manifest must not survive
+    rc = main(["--yes", "--resize", "1", "--skip-readiness",
+               "--workdir", str(work)])
+    assert rc == 0
+    assert not (gen / "bench-job-1.yaml").exists()
+
+
+def test_resize_without_previous_run_is_an_error(fake_world, capsys):
+    work, _ = fake_world
+    rc = main(["--yes", "--resize", "2", "--workdir", str(work)])
+    assert rc == 1
+    err = capsys.readouterr().err
+    assert "no saved config" in err

@@ -1,0 +1,123 @@
+"""Maintenance-event watchdog + drain contract (SURVEY.md §5 elastic
+recovery, tpu-vm mode): metadata poll -> drain file -> training loops
+stop at a checkpointed window boundary."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tritonk8ssupervisor_tpu.provision import maintenance as mt
+
+
+def test_poll_event_values():
+    assert mt.poll_event(fetch=lambda u, t: "NONE") == "NONE"
+    assert mt.poll_event(
+        fetch=lambda u, t: "TERMINATE_ON_HOST_MAINTENANCE"
+    ) == "TERMINATE_ON_HOST_MAINTENANCE"
+    # unreachable metadata (dev box, CI) must NOT self-drain
+    def boom(u, t):
+        raise OSError("no metadata server")
+    assert mt.poll_event(fetch=boom) == "NONE"
+    assert mt.poll_event(fetch=lambda u, t: "") == "NONE"
+
+
+def test_watch_owns_drain_file_lifecycle(tmp_path):
+    """The watchdog writes the drain file while an event is pending and
+    REMOVES it when the event clears (a completed live migration must
+    not leave a permanent stop signal — r5 review finding)."""
+    drain = tmp_path / "drain"
+    events = iter(["NONE", "MIGRATE_ON_HOST_MAINTENANCE",
+                   "MIGRATE_ON_HOST_MAINTENANCE", "NONE"])
+    log = []
+
+    def sleeper(_):
+        # observe the file state after each poll
+        log.append(drain.exists())
+        if not events_left():
+            raise StopIteration
+
+    remaining = [4]
+    def events_left():
+        remaining[0] -= 1
+        return remaining[0] > 0
+
+    with pytest.raises(StopIteration):
+        mt.watch(drain, interval=1.0, fetch=lambda u, t: next(events),
+                 sleep=sleeper, log=lambda m: None)
+    # NONE -> absent; pending -> present (twice); cleared -> removed
+    assert log == [False, True, True, False]
+    # once mode: no event -> no file, False; event -> file, True
+    assert mt.watch(tmp_path / "d2", once=True,
+                    fetch=lambda u, t: "NONE") is False
+    assert not (tmp_path / "d2").exists()
+    assert mt.watch(tmp_path / "d2", once=True,
+                    fetch=lambda u, t: "TERMINATE") is True
+    assert (tmp_path / "d2").exists()
+
+
+def test_drain_requested_contract(tmp_path, monkeypatch):
+    drain = tmp_path / "drain"
+    monkeypatch.setenv(mt.DRAIN_FILE_VAR, str(drain))
+    assert mt.drain_requested() is None  # var set, file absent
+    mt.request_drain(drain, "maintenance-event: TERMINATE")
+    assert mt.drain_requested() == "maintenance-event: TERMINATE"
+
+
+def test_drain_requested_falls_back_to_host_env_file(tmp_path, monkeypatch):
+    """An ssh'd training command never sources /etc/tpu-cluster.env into
+    its shell; drain_requested must read the path from the env FILE
+    (r5 review finding — without this the watchdog's signal never
+    reaches the training process)."""
+    from tritonk8ssupervisor_tpu.parallel import distributed
+
+    monkeypatch.delenv(mt.DRAIN_FILE_VAR, raising=False)
+    drain = tmp_path / "drain"
+    env_file = tmp_path / "tpu-cluster.env"
+    env_file.write_text(f"TK8S_DRAIN_FILE={drain}\n")
+    monkeypatch.setattr(distributed, "ENV_FILE", env_file)
+    assert mt.drain_requested() is None
+    mt.request_drain(drain, "maintenance-event: TERMINATE")
+    assert mt.drain_requested() == "maintenance-event: TERMINATE"
+    # no env var, no env file -> the watchdog's default path (absent
+    # here, so not draining)
+    monkeypatch.setattr(distributed, "ENV_FILE", tmp_path / "missing")
+    assert mt.drain_requested() is None
+
+
+def test_cli_once_exit_codes(tmp_path, monkeypatch):
+    drain = tmp_path / "drain"
+    monkeypatch.setattr(mt, "_default_fetch", lambda u, t: "NONE")
+    assert mt.main(["--once", "--drain-file", str(drain)]) == 0
+    monkeypatch.setattr(mt, "_default_fetch", lambda u, t: "TERMINATE")
+    assert mt.main(["--once", "--drain-file", str(drain)]) == 3
+    assert drain.exists()
+
+
+def test_timed_windows_stops_at_drained_window(tmp_path, monkeypatch):
+    """The training-loop side: a drain request stops the window loop
+    AFTER the checkpoint hook, and the timing records the reason."""
+    from tritonk8ssupervisor_tpu.utils import perf
+
+    drain = tmp_path / "drain"
+    monkeypatch.setenv(mt.DRAIN_FILE_VAR, str(drain))
+    saves = []
+
+    def run_once(state):
+        return state + 1, {"loss": jnp.float32(1.0)}
+
+    def on_window(state):
+        saves.append(int(state))
+        if len(saves) == 2:  # the "watchdog" fires mid-run
+            mt.request_drain(drain, "maintenance-event: TEST")
+
+    state, timing = perf.timed_windows(
+        run_once, 0, steps=2, warmup=1, windows=5, on_window=on_window,
+    )
+    assert timing["windows"] == 2  # stopped early, not 5
+    assert saves == [3, 5]  # checkpoint ran before the stop
+    assert timing["drained"] == "maintenance-event: TEST"
+    # no drain -> full run, drained None
+    monkeypatch.delenv(mt.DRAIN_FILE_VAR)
+    _, timing = perf.timed_windows(run_once, 0, steps=2, warmup=1, windows=3)
+    assert timing["windows"] == 3 and timing["drained"] is None

@@ -139,6 +139,19 @@ def build_parser() -> argparse.ArgumentParser:
         help="Job/Service name prefix for --workload-image manifests",
     )
     parser.add_argument(
+        "--resize",
+        type=int,
+        default=None,
+        metavar="N",
+        help="change the deployment to N slices and reconverge: terraform "
+        "adds/removes slice node pools (or TPU VMs), ansible reconverges "
+        "hosts, manifests recompile with the new cross-slice topology. "
+        "Requires a previous run (the saved config is updated). With "
+        "cross-slice training and --checkpoint-dir, the re-deployed "
+        "workload resumes from the shared checkpoint at the new "
+        "data-parallel width.",
+    )
+    parser.add_argument(
         "--independent-slices",
         action="store_true",
         help="with num_slices > 1, compile each slice's Jobs as an "
@@ -217,6 +230,20 @@ def provision(args, paths: state.RunPaths, prompter: Prompter) -> int:
     # means a provision is (or was) in flight; converge or clean first. An
     # explicit --config always wins over the saved one.
     resuming = paths.config_file.exists() and args.config is None
+    if args.resize is not None:
+        # Elastic resize (SURVEY.md §5): same converging pipeline, new
+        # slice count — terraform's declarative count adds/destroys
+        # slice pools, ansible reconverges membership, the manifests
+        # recompile with the new cross-slice coordinates. Gated on an
+        # existing run BEFORE the wizard could prompt: resizing nothing
+        # is a typo, not a provision.
+        if not (resuming or args.config is not None):
+            raise ConfigError(
+                "--resize N reconverges an existing deployment; no saved "
+                "config found (provision first, then resize)"
+            )
+        if args.resize < 1:
+            raise ConfigError(f"--resize {args.resize}: need >= 1 slice")
     if resuming:
         prompter.say(
             f"Previous run detected ({paths.config_file} exists); "
@@ -243,6 +270,13 @@ def provision(args, paths: state.RunPaths, prompter: Prompter) -> int:
         config.validate()
     else:
         config = wizard.run_wizard(prompter, env=env)
+
+    if args.resize is not None and args.resize != config.num_slices:
+        prompter.say(
+            f"Resizing: {config.num_slices} -> {args.resize} slice(s)"
+        )
+        config.num_slices = args.resize
+        config.validate()
 
     # Fail preconditions BEFORE any resources are created — the reference
     # validated its key up front too (setup.sh:231-237). Cheapest first.

@@ -744,6 +744,12 @@ def write_manifests(
     slice with the same coordinator/topology wiring (the CLI's
     --workload-image/--workload-command; docs/detailed.md §2b)."""
     manifests_dir.mkdir(parents=True, exist_ok=True)
+    # the generated dir is owned by this compiler: stale files from a
+    # previous (larger) topology must not survive a resize — a leftover
+    # bench-job-2.yaml would `kubectl apply` a Job for a slice that no
+    # longer exists
+    for stale in manifests_dir.glob("*.yaml"):
+        stale.unlink()
     paths = []
     # package ConfigMap first: the Job's self-install mount depends on it
     pkg = manifests_dir / "package-configmap.yaml"

@@ -32,6 +32,7 @@ KNOWN_MODULES = {
     "ansible.builtin.pip",
     "ansible.builtin.slurp",
     "ansible.builtin.wait_for",
+    "ansible.builtin.systemd",  # r5: the maintenance watchdog unit
 }
 
 TASK_KEYWORDS = {

@@ -118,7 +118,10 @@ def timed_windows(
     span; an async save can still contend with the next window's
     dispatches, which is the durability-over-purity trade the GKE Job
     path makes (the driver's bench.py passes no checkpoint_dir, so
-    BENCH numbers never pay it).
+    BENCH numbers never pay it). After each on_window the loop also
+    polls the maintenance drain file (provision/maintenance.py) and
+    stops early — checkpoint already saved — when a host is draining;
+    `timing["drained"]` carries the reason.
 
     Returns (state, timing) where timing carries final_loss, step_ms
     (median), step_ms_min, step_ms_windows, steps, windows, and
@@ -134,6 +137,7 @@ def timed_windows(
 
     calls_per_window = steps // steps_per_call
     window_seconds = []
+    drained = None
     for _ in range(max(1, windows)):
         start = time.monotonic()
         for _ in range(calls_per_window):
@@ -142,6 +146,22 @@ def timed_windows(
         window_seconds.append(time.monotonic() - start)
         if on_window is not None:
             on_window(state)
+        # maintenance drain (provision/maintenance.py): the watchdog's
+        # drain file asks the run to stop at a window boundary — AFTER
+        # on_window saved the checkpoint, so the maintenance window
+        # interrupts a checkpointed run that resumes at this step
+        from tritonk8ssupervisor_tpu.provision.maintenance import (
+            drain_requested,
+        )
+
+        drained = drain_requested()
+        if drained is not None:
+            saved = ("checkpoint saved" if on_window is not None
+                     else "NO checkpoint hook configured")
+            print(f"drain requested ({drained}); stopping after "
+                  f"{len(window_seconds)} window(s), {saved}",
+                  flush=True)
+            break
 
     if profile_dir:
         with maybe_trace(profile_dir):
@@ -157,6 +177,7 @@ def timed_windows(
         "step_ms": statistics.median(step_ms_windows),
         "step_ms_min": min(step_ms_windows),
         "step_ms_windows": [round(w, 3) for w in step_ms_windows],
+        "drained": drained,
     }
 
 
