@@ -42,6 +42,7 @@ def run_benchmark(
     repeats: int = 3,
     int8: bool = False,
     cache_int8: bool = False,
+    unroll: int = 1,
 ) -> dict:
     max_len = prompt_len + new_tokens
     model = TransformerLM(
@@ -83,6 +84,7 @@ def run_benchmark(
             temperature=temperature,
             max_len=max_len,
             cache_int8=cache_int8,
+            unroll=unroll,
         )
     )
     rng = jax.random.key(2)
@@ -114,6 +116,7 @@ def run_benchmark(
         "temperature": temperature,
         "int8": bool(int8),
         "cache_int8": bool(cache_int8),
+        "unroll": unroll,
         "decode_tokens_per_sec": total_tokens / median,
         "decode_tokens_per_sec_per_chip": total_tokens / median / num_chips,
         "ms_per_token_per_stream": median / new_tokens * 1000,
@@ -140,6 +143,15 @@ def build_parser() -> argparse.ArgumentParser:
         help="weight-only int8 kernels (per-output-channel scales) — "
         "halves the per-token weight read that dominates small-batch "
         "decode",
+    )
+    parser.add_argument(
+        "--unroll",
+        type=int,
+        default=1,
+        help="decode tokens per scan iteration (pure restructuring, "
+        "token-identical). Measured NEGATIVE at batch 8 (cache-copy "
+        "overhead beats the amortized loop floor), +4%% at batch 1 — "
+        "kept as an A/B lever; see docs/benchmarks.md",
     )
     parser.add_argument(
         "--cache-int8",
@@ -171,6 +183,7 @@ def main(argv: list[str] | None = None) -> int:
         repeats=args.repeats,
         int8=args.int8,
         cache_int8=args.cache_int8,
+        unroll=args.unroll,
     )
     if args.json:
         print(json.dumps(result, sort_keys=True))

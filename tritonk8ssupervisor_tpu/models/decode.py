@@ -289,6 +289,7 @@ def generate(
     rng: jax.Array | None = None,
     max_len: int | None = None,
     cache_int8: bool = False,
+    unroll: int = 1,
 ) -> jax.Array:
     """Greedy (temperature=0) or sampled continuation of `prompt` (B, S).
 
@@ -301,6 +302,18 @@ def generate(
     logit error vs the bf16 cache is bounded by test
     (tests/test_decode.py); greedy continuations can diverge where
     top-2 logits are closer than that bound, as with any quantization.
+
+    `unroll` decodes that many tokens per scan iteration (pure
+    restructuring — token-for-token identical output, pinned by test;
+    silently 1 when it doesn't divide max_new_tokens). It exists
+    because a lax.scan iteration carries a fixed runtime overhead that
+    r5 measured at ~380 us on the tunneled dev backend REGARDLESS of
+    body size. MEASURED NEGATIVE at batch 8 regardless (8,044 tok/s at
+    unroll 1 vs 6,547 at 4): chaining several cache updates in one
+    body defeats XLA's in-place aliasing of the carried cache, and the
+    resulting copies cost more than the amortized floor; at batch 1
+    it is mildly positive (+4%). Default 1; kept as a measured A/B
+    lever (docs/benchmarks.md decode section).
     """
     b, s = prompt.shape
     max_len = max_len or model.max_seq_len
@@ -326,7 +339,7 @@ def generate(
             return jax.random.categorical(key, logits / temperature, axis=-1)
         return jnp.argmax(logits, axis=-1)
 
-    def step(carry, key):
+    def one_token(carry, key):
         cache, logits, pos = carry
         token = pick(logits, key).astype(jnp.int32)  # (B,)
         x = _embed(params, token[:, None], pos, model)
@@ -339,6 +352,20 @@ def generate(
         logits = _head(params, x, model)[:, 0]
         return (cache, logits, pos + 1), token
 
+    if unroll > 1 and max_new_tokens % unroll == 0:
+        def step(carry, keys_u):
+            toks = []
+            for u in range(unroll):
+                carry, tok = one_token(carry, keys_u[u])
+                toks.append(tok)
+            return carry, jnp.stack(toks)  # (unroll, B)
+
+        keys = jax.random.split(rng, max_new_tokens)
+        keys = keys.reshape(max_new_tokens // unroll, unroll,
+                            *keys.shape[1:])
+        (_, _, _), tokens = jax.lax.scan(step, (cache, logits, s), keys)
+        # (iters, unroll, B) -> (B, max_new_tokens)
+        return tokens.reshape(max_new_tokens, -1).T
     keys = jax.random.split(rng, max_new_tokens)
-    (_, _, _), tokens = jax.lax.scan(step, (cache, logits, s), keys)
+    (_, _, _), tokens = jax.lax.scan(one_token, (cache, logits, s), keys)
     return tokens.T  # (B, max_new_tokens)

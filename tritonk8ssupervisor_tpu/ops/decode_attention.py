@@ -1,0 +1,113 @@
+"""Fused single-token decode attention over the int8 KV cache (pallas).
+
+STATUS: measured NEGATIVE on the v5e — checked-in evidence, not wired
+into models/decode.py. The hypothesis was that collapsing the ~5
+attention ops per decode layer into one kernel would claw back per-op
+overhead. Measurement (b8/h12/L640/d64, 100 calls chained in one scan)
+refuted both halves:
+
+- the kernel's own device time is ~146 us/call vs ~11 us for the XLA
+  einsum chain it replaces: a (B, H) = 96-program grid of ~80 KB DMAs
+  on the v5e's single core leaves the pipeline latency-bound (each
+  program's DMA is too small to hide), and one op that is 13x slower
+  cannot win back 4 op-gaps;
+- the profiler showed the surrounding while-loop's time dominated by a
+  ~380 us PER-ITERATION runtime floor (measured flat from 1 to 50
+  tanh-ops per body — see docs/benchmarks.md), i.e. the "op floor"
+  that motivated fusion was mostly loop-iteration overhead fusion
+  cannot touch.
+
+Kept with its interpret-mode correctness test as the restart point: on
+a multi-core TPU (or with a (B,)-grid restructure streaming whole-head
+blocks) the DMA-pipelining story changes, and the kernel is exact.
+
+The design that was tested — ONE op per layer reading the int8 cache
+natively:
+
+    out[b,h,:] = softmax(mask(q[b,h,:] . k8[b,h,:,:] * ks[b,h,:]))
+                 * vs[b,h,:] . v8[b,h,:,:]
+
+- Cache layout is HEAD-MAJOR (B, H, L, D) int8 with per-(token, head)
+  f32 scales (B, H, L) — each grid program (b, h) streams its own
+  contiguous 2 x L x D int8 bytes from HBM, double-buffered by the
+  pallas pipeline; scales ride outside the contractions exactly as in
+  the XLA path (models/decode.py), so numerics match it.
+- L (the static cache length) is small enough at decode shapes that a
+  whole (L, D) head fits VMEM (L=4096, D=64 int8: 256 KB x2) — no
+  online softmax needed; one pass computes exact softmax in f32.
+- `pos` arrives as a scalar-prefetch argument: positions > pos mask to
+  -inf BEFORE the softmax (the static-shape cache's tail is garbage).
+
+CPU tests run the same kernel in interpret mode
+(tests/test_decode.py); the XLA einsum path in models/decode.py is the
+numerics reference.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _kernel(pos_ref, q_ref, k_ref, ks_ref, v_ref, vs_ref, out_ref):
+    # blocks: q/out (1, 1, 1, D), k/v (1, 1, L, D) int8, ks/vs (1, 1, 1, L)
+    # (the singleton dims keep every block's trailing two dims equal to
+    # the array's — the TPU lowering's tiling constraint)
+    pos = pos_ref[0]
+    q = q_ref[0, 0, 0].astype(jnp.float32)               # (D,)
+    k = k_ref[0, 0].astype(jnp.float32)                  # (L, D)
+    d = q.shape[-1]
+    scores = jnp.sum(k * q[None, :], axis=-1)            # (L,)
+    scores = scores * ks_ref[0, 0, 0] * (1.0 / (d ** 0.5))
+    valid = jax.lax.iota(jnp.int32, scores.shape[0]) <= pos
+    scores = jnp.where(valid, scores, NEG_INF)
+    scores = scores - jnp.max(scores)
+    p = jnp.exp(scores)
+    p = p / jnp.sum(p)
+    p = p * vs_ref[0, 0, 0]                              # fold V scales
+    v = v_ref[0, 0].astype(jnp.float32)                  # (L, D)
+    out_ref[0, 0, 0] = jnp.sum(p[:, None] * v, axis=0).astype(out_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def decode_attention_int8(q, k8, k_scale, v8, v_scale, pos,
+                          interpret: bool = False):
+    """One decode step's attention against the head-major int8 cache.
+
+    q: (B, H, D) — the current token's queries (any float dtype).
+    k8/v8: (B, H, L, D) int8; k_scale/v_scale: (B, H, L) f32.
+    pos: int32 scalar — index of the current token (attends to [0, pos]).
+    Returns (B, H, D) in q's dtype.
+    """
+    b, h, d = q.shape
+    length = k8.shape[2]
+    out = pl.pallas_call(
+        _kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(b, h),
+            in_specs=[
+                pl.BlockSpec((1, 1, 1, d), lambda i, j, pos: (i, j, 0, 0)),
+                pl.BlockSpec((1, 1, length, d),
+                             lambda i, j, pos: (i, j, 0, 0)),
+                pl.BlockSpec((1, 1, 1, length),
+                             lambda i, j, pos: (i, j, 0, 0)),
+                pl.BlockSpec((1, 1, length, d),
+                             lambda i, j, pos: (i, j, 0, 0)),
+                pl.BlockSpec((1, 1, 1, length),
+                             lambda i, j, pos: (i, j, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, 1, 1, d),
+                                   lambda i, j, pos: (i, j, 0, 0)),
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, h, 1, d), q.dtype),
+        interpret=interpret,
+    )(jnp.asarray(pos, jnp.int32).reshape(1), q[:, :, None, :], k8,
+      k_scale[:, :, None, :], v8, v_scale[:, :, None, :])
+    return out[:, :, 0]
