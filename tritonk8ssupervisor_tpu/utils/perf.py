@@ -18,6 +18,8 @@ from typing import Any, Callable, Iterator
 
 import jax
 
+from tritonk8ssupervisor_tpu.provision.maintenance import drain_requested
+
 # Published dense bf16 peak per chip (FLOP/s, 2 per MAC). Sources: Google
 # Cloud TPU system-architecture docs / the public scaling-book tables.
 # Keys are jax Device.device_kind strings.
@@ -150,10 +152,6 @@ def timed_windows(
         # drain file asks the run to stop at a window boundary — AFTER
         # on_window saved the checkpoint, so the maintenance window
         # interrupts a checkpointed run that resumes at this step
-        from tritonk8ssupervisor_tpu.provision.maintenance import (
-            drain_requested,
-        )
-
         drained = drain_requested()
         if drained is not None:
             saved = ("checkpoint saved" if on_window is not None
