@@ -90,9 +90,23 @@ def _bwd_blocks(seq: int, block: int) -> tuple[int, int, bool]:
     hooks remain: TK8S_FLASH_FUSED_BWD=0 restores unfused,
     TK8S_FLASH_BWD_BLOCK sets both blocks, TK8S_FLASH_DKV_BLOCK /
     TK8S_FLASH_DQ_BLOCK split them (unfused only — the fused kernel
-    has no separate dq blocks). Full tables: docs/benchmarks.md."""
-    joint = _env_block("TK8S_FLASH_BWD_BLOCK", seq,
-                       512 if seq % 512 == 0 else block)
+    has no separate dq blocks). Full tables: docs/benchmarks.md.
+
+    The default block SCALES with sequence over the measured-good range
+    (r05 fused sweep, full LM steps): seq 1024 prefers 512 (58.8 vs
+    59.7 ms at 1024); seq 2048-8192 prefer 1024 (2048: 67.8 vs 68.6;
+    4096: 83.1 vs 88.4; 8192: 116.7 vs 129.4 — +6-11%). Outside that
+    range the default stays 512: 2048-wide blocks fail to serve at any
+    length, and 1024 at seq 32768 failed to complete within the
+    measurement budget (the same cliff the unfused dq=1024 sweep hit
+    at seq 1024 — oversized backward tiles fall off a VMEM/pipeline
+    cliff rather than degrading smoothly). Longer sequences amortise
+    the once-per-tile-pair recompute over bigger tiles — but only
+    while the tile still fits."""
+    preferred = 1024 if 2048 <= seq <= 8192 else 512
+    if seq % preferred:
+        preferred = 512 if seq % 512 == 0 else block
+    joint = _env_block("TK8S_FLASH_BWD_BLOCK", seq, preferred)
     dkv = _env_block("TK8S_FLASH_DKV_BLOCK", seq, joint)
     dq = _env_block("TK8S_FLASH_DQ_BLOCK", seq, joint)
     fused = os.environ.get("TK8S_FLASH_FUSED_BWD", "1") == "1"
