@@ -24,7 +24,10 @@ target score.
 
 from __future__ import annotations
 
+import contextlib
 import json
+import os
+import signal
 import sys
 
 # images/sec/chip target for ResNet-50 bf16 on TPU v5e (see BASELINE.md)
@@ -243,11 +246,60 @@ def decode_records(on_tpu: bool) -> list[dict]:
     return records
 
 
+@contextlib.contextmanager
+def family_deadline(seconds: int):
+    """Bound one benchmark family's wall time (SIGALRM -> TimeoutError).
+
+    The tunneled chip can wedge (r5 observed a ~40-minute outage where
+    even a 64x64 matmul never returned); without a bound the driver
+    gets NO json line at all. With it, a hung family raises into the
+    per-family stub handling and the line still reports what ran and
+    what timed out. Honest limits: a signal only interrupts Python
+    bytecode, so a call hard-blocked inside the PJRT C++ runtime won't
+    unwind until it yields (polling-loop hangs do; some RPC blocks
+    don't), and the alarm spans the whole family — a caught in-family
+    timeout leaves later configs of that family unbounded. Override
+    via TK8S_BENCH_FAMILY_TIMEOUT; 0 disables (non-main-thread callers
+    are skipped automatically)."""
+    seconds = int(os.environ.get("TK8S_BENCH_FAMILY_TIMEOUT", seconds))
+    import threading
+
+    if seconds <= 0 or threading.current_thread() is not threading.main_thread():
+        yield
+        return
+
+    def on_alarm(signum, frame):
+        raise TimeoutError(f"benchmark family exceeded {seconds}s "
+                           "(wedged device/tunnel?)")
+
+    previous = signal.signal(signal.SIGALRM, on_alarm)
+    signal.alarm(seconds)
+    try:
+        yield
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, previous)
+
+
 def main() -> int:
     import jax
 
     on_tpu = jax.default_backend() not in ("cpu",)
-    resnet = resnet_record(on_tpu)
+    try:
+        with family_deadline(1200):
+            resnet = resnet_record(on_tpu)
+    except Exception as exc:  # noqa: BLE001 - emit a parseable stub line
+        # even the flagship failing must not leave the driver without a
+        # line: all four driver-read fields present, value 0, error set
+        resnet = {
+            "metric": "resnet50_images_per_sec_per_chip",
+            "value": 0.0,
+            "unit": "images/sec/chip",
+            "vs_baseline": 0.0,
+            "error": repr(exc),
+        }
+        print(f"resnet family failed ({exc!r}); emitting stub",
+              file=sys.stderr)
     families = [resnet]
     # A companion-family failure must not discard the already-measured
     # flagship record — the driver's four-field contract rides on
@@ -262,7 +314,8 @@ def main() -> int:
     ]
     for series, record_fn in companions:
         try:
-            families.append(record_fn(on_tpu))
+            with family_deadline(900):
+                families.append(record_fn(on_tpu))
         except Exception as exc:  # noqa: BLE001 - report, keep the flagship
             print(f"{series} failed ({exc!r}); emitting stub",
                   file=sys.stderr)
@@ -270,7 +323,8 @@ def main() -> int:
     decode_series = ("decode_b1_int8_tokens_per_sec_per_chip"
                      if on_tpu else "decode_smoke_tokens_per_sec_per_chip")
     try:
-        families.extend(decode_records(on_tpu))
+        with family_deadline(900):
+            families.extend(decode_records(on_tpu))
     except Exception as exc:  # noqa: BLE001 - report, keep the flagship
         print(f"{decode_series} failed ({exc!r}); emitting stub",
               file=sys.stderr)

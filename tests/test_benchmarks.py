@@ -245,3 +245,27 @@ def test_lm_benchmark_cross_slice_smoke(monkeypatch):
     )
     assert result["num_chips"] == 8
     assert result["tokens_per_sec_per_chip"] > 0
+
+
+def test_bench_family_deadline():
+    """bench.py family_deadline: a hung family converts to TimeoutError
+    (feeding the stub path) instead of leaving the driver with no JSON
+    line; env-disable works (r5: the tunnel wedged for ~40 minutes)."""
+    import time
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+    import bench
+
+    with pytest.raises(TimeoutError, match="exceeded 1s"):
+        with bench.family_deadline(1):
+            time.sleep(3)
+    # a fast family passes through untouched
+    with bench.family_deadline(5):
+        assert 1 + 1 == 2
+    # env override disables
+    os.environ["TK8S_BENCH_FAMILY_TIMEOUT"] = "0"
+    try:
+        with bench.family_deadline(1):
+            time.sleep(1.2)
+    finally:
+        del os.environ["TK8S_BENCH_FAMILY_TIMEOUT"]
