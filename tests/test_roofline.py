@@ -207,3 +207,43 @@ def test_benchcompare_cli(tmp_path):
     )
     assert proc.returncode == 0, proc.stderr
     assert "| b.json | x | 2.00 | u |" in proc.stdout
+
+
+def test_benchcompare_guard_flags_regressions_and_failures(tmp_path):
+    """--guard: consecutive-file drops beyond tolerance and FAILED
+    families exit 1 with named problems; improvements and within-noise
+    wiggle pass (r5 — the BENCH series becomes a failable check)."""
+    import json
+
+    from tritonk8ssupervisor_tpu.utils import benchcompare as bc
+
+    def bench_file(name, lm, resnet_err=None):
+        families = [{"metric": "lm_tok_s", "value": lm,
+                     "unit": "tok/s", "vs_baseline": 1.0}]
+        if resnet_err:
+            families.append({"metric": "resnet_img_s", "error": resnet_err})
+        else:
+            families.append({"metric": "resnet_img_s", "value": 2500.0,
+                             "unit": "img/s", "vs_baseline": 2.5})
+        p = tmp_path / name
+        p.write_text(json.dumps({
+            "metric": "resnet_img_s", "value": 2500.0, "unit": "img/s",
+            "vs_baseline": 2.5, "benchmarks": families,
+        }))
+        return p
+
+    a = bench_file("BENCH_r01.json", lm=100_000.0)
+    b = bench_file("BENCH_r02.json", lm=98_000.0)    # -2%: inside 5%
+    c = bench_file("BENCH_r03.json", lm=80_000.0)    # -18%: regression
+    rows = bc.comparison_rows([a, b, c])
+    problems = bc.guard_regressions(rows)
+    assert len(problems) == 1 and "lm_tok_s" in problems[0]
+    assert "-18" in problems[0]
+    assert bc.main([str(a), str(b)] + ["--guard"]) == 0
+    assert bc.main([str(a), str(c)] + ["--guard"]) == 1
+    # failed family always flags
+    d = bench_file("BENCH_r04.json", lm=100_000.0, resnet_err="boom")
+    assert any("FAILED" in p
+               for p in bc.guard_regressions(bc.comparison_rows([d])))
+    # custom tolerance: the -18% drop passes at 25%
+    assert bc.main([str(a), str(c), "--guard", "--tolerance", "0.25"]) == 0
