@@ -159,9 +159,12 @@ def run_wizard(
     config.topology = spec.topologies[topo_idx]
 
     # Slice count keeps the reference's 1-9 guard-rail (setup.sh:297-307).
+    # Multiple slices form ONE cross-slice training surface by default
+    # (data parallel over DCN, docs/parallelism.md; --independent-slices
+    # restores per-slice clusters).
     config.num_slices = int(
         prompter.ask_validated(
-            "Number of slices",
+            "Number of slices (several = one cross-slice training surface)",
             str(config.num_slices),
             _int_range_validator(1, MAX_SLICES, "no HA support"),
         )
