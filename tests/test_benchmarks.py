@@ -269,3 +269,20 @@ def test_bench_family_deadline():
             time.sleep(1.2)
     finally:
         del os.environ["TK8S_BENCH_FAMILY_TIMEOUT"]
+
+
+def test_bench_probe_device_paths(monkeypatch):
+    """bench.probe_device: healthy subprocess -> None; timeout/crash ->
+    a description feeding the all-stub line (validated live against the
+    r5 tunnel outage, where the in-process deadline could not unwind a
+    PJRT C-block but the killed subprocess could)."""
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+    import bench
+
+    monkeypatch.setenv("TK8S_BENCH_PROBE_TIMEOUT", "0")
+    assert bench.probe_device() is None  # disabled
+    monkeypatch.delenv("TK8S_BENCH_PROBE_TIMEOUT")
+    # a crashing probe reports rc + stderr tail
+    monkeypatch.setattr(bench.sys, "executable", "/bin/false")
+    err = bench.probe_device(timeout_s=30)
+    assert err is not None and "rc=1" in err
