@@ -68,6 +68,13 @@ def pytest_addoption(parser):
         default=False,
         help="also run tests marked slow (the full pre-merge suite)",
     )
+    parser.addoption(
+        "--chaos",
+        action="store_true",
+        default=False,
+        help="also run chaos drills (fault-injection tests with real "
+        "sleeps/backoff; never part of tier-1)",
+    )
 
 
 def pytest_configure(config):
@@ -75,12 +82,20 @@ def pytest_configure(config):
         "markers",
         "slow: multi-second jit-compilation tests; skipped unless --runslow",
     )
+    config.addinivalue_line(
+        "markers",
+        "chaos: fault-injection drills exercising real sleeps/timeouts; "
+        "skipped unless --chaos",
+    )
 
 
 def pytest_collection_modifyitems(config, items):
-    if config.getoption("--runslow"):
-        return
+    run_slow = config.getoption("--runslow")
+    run_chaos = config.getoption("--chaos")
     skip_slow = pytest.mark.skip(reason="slow tier: run with --runslow")
+    skip_chaos = pytest.mark.skip(reason="chaos drill: run with --chaos")
     for item in items:
-        if "slow" in item.keywords:
+        if not run_slow and "slow" in item.keywords:
             item.add_marker(skip_slow)
+        if not run_chaos and "chaos" in item.keywords:
+            item.add_marker(skip_chaos)

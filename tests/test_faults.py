@@ -1,0 +1,341 @@
+"""Fault-injection harness: plan parsing, Nth-invocation semantics, and
+the acceptance e2e — a fault plan drives a full fake `provision` run
+through fail→retry→converge (runlog showing per-phase attempt counts)
+and fail→fatal→clean abort (no retry on the first attempt)."""
+
+import json
+import os
+import stat
+import textwrap
+
+import pytest
+
+from tritonk8ssupervisor_tpu.cli.main import main
+from tritonk8ssupervisor_tpu.provision.runner import CommandError
+from tritonk8ssupervisor_tpu.provision.state import RunPaths
+from tritonk8ssupervisor_tpu.testing import faults
+
+
+# ------------------------------------------------------------ plan parsing
+
+
+def test_plan_accepts_list_or_wrapper_object():
+    for text in (
+        '[{"match": "terraform"}]',
+        '{"faults": [{"match": "terraform"}]}',
+    ):
+        plan = faults.FaultPlan.from_json(text)
+        assert [r.match for r in plan.rules] == ["terraform"]
+
+
+@pytest.mark.parametrize(
+    "text,complaint",
+    [
+        ("not json", "not valid JSON"),
+        ('{"faults": 3}', "list of rules"),
+        ('[{"times": 1}]', "needs a 'match'"),
+        ('[{"match": "x", "typo_key": 1}]', "unknown key"),
+        ('[{"match": "(unclosed"}]', "bad 'match' regex"),
+    ],
+)
+def test_plan_rejects_malformed_specs(text, complaint):
+    with pytest.raises(faults.FaultPlanError, match=complaint):
+        faults.FaultPlan.from_json(text)
+
+
+def test_load_fault_plan_inline_path_env(tmp_path, monkeypatch):
+    monkeypatch.delenv(faults.ENV_VAR, raising=False)
+    assert faults.load_fault_plan(None) is None
+    # inline JSON
+    assert faults.load_fault_plan('[{"match": "x"}]').rules[0].match == "x"
+    # file path
+    plan_file = tmp_path / "plan.json"
+    plan_file.write_text('[{"match": "from-file"}]')
+    assert faults.load_fault_plan(str(plan_file)).rules[0].match == "from-file"
+    # env var fallback; explicit spec wins over it
+    monkeypatch.setenv(faults.ENV_VAR, '[{"match": "from-env"}]')
+    assert faults.load_fault_plan(None).rules[0].match == "from-env"
+    assert faults.load_fault_plan('[{"match": "cli"}]').rules[0].match == "cli"
+    with pytest.raises(faults.FaultPlanError, match="cannot read"):
+        faults.load_fault_plan(str(tmp_path / "missing.json"))
+
+
+# ------------------------------------------------------- wrapper semantics
+
+
+def ok_run(args, **kwargs):
+    return "real"
+
+
+def test_nth_matching_invocation_fails(capsys):
+    plan = faults.FaultPlan.from_json(
+        '[{"match": "kubectl get nodes", "after": 1, "times": 2, '
+        '"rc": 7, "output": "connection reset"}]'
+    )
+    run = plan.wrap(ok_run)
+    assert run(["kubectl", "get", "nodes"]) == "real"  # 0th passes
+    for nth in (1, 2):  # the window [after, after+times)
+        with pytest.raises(CommandError) as exc:
+            run(["kubectl", "get", "nodes"])
+        assert exc.value.returncode == 7
+        assert exc.value.tail == "connection reset"
+    assert run(["kubectl", "get", "nodes"]) == "real"  # window exhausted
+    assert run(["kubectl", "get", "pods"]) == "real"  # no match, untouched
+    assert [f["nth"] for f in plan.injected] == [1, 2]
+    assert "FAULT-INJECT" in capsys.readouterr().err
+
+
+def test_first_matching_rule_owns_the_call():
+    plan = faults.FaultPlan.from_json(
+        '[{"match": "terraform", "times": 1, "output": "first"},'
+        ' {"match": "terraform apply", "times": 9, "output": "second"}]'
+    )
+    run = plan.wrap(ok_run)
+    with pytest.raises(CommandError, match="first"):
+        run(["terraform", "apply"])
+    # rule 1 owns every terraform call; rule 2 never fires
+    assert run(["terraform", "apply"]) == "real"
+    assert plan.rules[1].seen == 0
+
+
+def test_hang_consumes_timeout_budget_then_rc_124():
+    slept = []
+    plan = faults.FaultPlan.from_json(
+        '[{"match": "ansible", "hang": true}]', sleep=slept.append,
+        echo=lambda line: None,
+    )
+    run = plan.wrap(ok_run)
+    with pytest.raises(CommandError) as exc:
+        run(["ansible-playbook", "x.yml"], timeout=30.0)
+    assert exc.value.returncode == 124
+    assert slept == [30.0]
+    # without a timeout budget the rule's own hang_seconds applies
+    plan2 = faults.FaultPlan.from_json(
+        '[{"match": "ansible", "hang": true, "hang_seconds": 5}]',
+        sleep=slept.append, echo=lambda line: None,
+    )
+    with pytest.raises(CommandError):
+        plan2.wrap(ok_run)(["ansible-playbook", "x.yml"])
+    assert slept[-1] == 5
+
+
+# ------------------------------------------------------------ e2e pipeline
+
+
+def write_stub(bin_dir, name, script):
+    path = bin_dir / name
+    path.write_text("#!/usr/bin/env bash\n" + textwrap.dedent(script))
+    path.chmod(path.stat().st_mode | stat.S_IEXEC)
+    return path
+
+
+@pytest.fixture
+def gke_world(tmp_path, monkeypatch):
+    """A gke-mode workdir with stub binaries, zeroed backoff delays, and
+    a saved config — the fake-cluster harness the fault plans drive."""
+    work = tmp_path / "repo"
+    for sub in ("terraform/tpu-vm", "terraform/gke", "ansible"):
+        (work / sub).mkdir(parents=True)
+    (work / "ansible" / "ansible.cfg").write_text(
+        "[defaults]\nhost_key_checking = False\nprivate_key_file =\n"
+    )
+    (work / "ansible" / "clusterUp.yml").write_text("[]\n")
+
+    bin_dir = tmp_path / "bin"
+    bin_dir.mkdir()
+    calls_log = tmp_path / "calls.log"
+    monkeypatch.setenv("CALLS_LOG", str(calls_log))
+    monkeypatch.setenv("PATH", f"{bin_dir}:{os.environ['PATH']}")
+    # deterministic, instant retries: the engine's loop runs for real,
+    # only the sleeps are zeroed
+    monkeypatch.setenv("TK8S_RETRY_BASE_DELAY", "0")
+    monkeypatch.setenv("TK8S_RETRY_MAX_DELAY", "0")
+    monkeypatch.delenv("TK8S_FAULT_PLAN", raising=False)
+
+    write_stub(
+        bin_dir,
+        "terraform",
+        """
+        echo "terraform $*" >> "$CALLS_LOG"
+        case "$1" in
+          apply) echo '{"resources": [{"type": "container_cluster"}]}' > terraform.tfstate ;;
+          output) echo '{"endpoint": {"value": "34.1.2.3"}}' ;;
+        esac
+        """,
+    )
+    write_stub(
+        bin_dir,
+        "ansible-playbook",
+        'echo "ansible-playbook $*" >> "$CALLS_LOG"\n',
+    )
+    write_stub(
+        bin_dir,
+        "gcloud",
+        """
+        echo "gcloud $*" >> "$CALLS_LOG"
+        case "$*" in
+          "config get-value project") echo stub-proj ;;
+          "config get-value account") echo me@stub.test ;;
+          *) echo "" ;;
+        esac
+        """,
+    )
+    write_stub(
+        bin_dir,
+        "kubectl",
+        """
+        echo "kubectl $*" >> "$CALLS_LOG"
+        echo '{"items": [
+          {"metadata": {"name": "n1"},
+           "status": {"allocatable": {"google.com/tpu": "4"},
+                      "conditions": [{"type": "Ready", "status": "True"}]}}]}'
+        """,
+    )
+
+    config = work / "given.config"
+    config.write_text(
+        "PROJECT=file-proj\nZONE=us-west4-a\nMODE=gke\nGENERATION=v5e\n"
+        "TOPOLOGY=2x2\nNUM_SLICES=1\nCLUSTER_NAME=stub-cluster\n"
+    )
+    return work, config, calls_log
+
+
+def provision_args(work, config, plan):
+    args = ["--yes", "--config", str(config), "--workdir", str(work)]
+    if plan is not None:
+        args += ["--fault-plan", json.dumps(plan)]
+    return args
+
+
+def runlog_rows(work):
+    rows = {}
+    for line in RunPaths(work).runlog.read_text().splitlines():
+        record = json.loads(line)
+        if record.get("status") in ("done", "failed"):
+            rows[record["phase"]] = record
+    return rows
+
+
+def test_transient_faults_converge_to_ready(gke_world, capsys):
+    """The acceptance e2e: 2 transient terraform failures + 1 transient
+    kubectl probe failure, and the run still converges to ready — with
+    the runlog carrying per-phase attempt counts."""
+    work, config, calls_log = gke_world
+    plan = [
+        {"match": "terraform apply", "times": 2, "rc": 1,
+         "output": "Error: googleapi: Error 429: Too Many Requests"},
+        {"match": "kubectl get nodes", "times": 1, "rc": 1,
+         "output": "Unable to connect to the server: connection reset by peer"},
+    ]
+    rc = main(provision_args(work, config, plan))
+    assert rc == 0, capsys.readouterr().out
+
+    calls = calls_log.read_text().splitlines()
+    # the injected failures never reach the stubs: exactly the one
+    # CONVERGED attempt of each command shows up binary-side
+    assert sum(1 for c in calls if c.startswith("terraform apply")) == 1
+    assert sum(1 for c in calls if c.startswith("kubectl get nodes")) == 1
+
+    rows = runlog_rows(work)
+    assert rows["terraform-apply"]["status"] == "done"
+    assert rows["terraform-apply"]["attempts"] == 3
+    assert rows["terraform-apply"]["retry_causes"] == [
+        "rate-limited", "rate-limited"
+    ]
+    assert rows["readiness-wait"]["attempts"] == 2
+    assert rows["readiness-wait"]["retry_causes"] == ["connection"]
+    assert "Cluster is ready" in capsys.readouterr().out
+
+
+def test_fatal_fault_aborts_without_retry(gke_world, capsys):
+    work, config, calls_log = gke_world
+    plan = [{"match": "terraform apply", "times": 9, "rc": 1,
+             "output": "Error 403: Quota exceeded for resource"}]
+    rc = main(provision_args(work, config, plan))
+    assert rc == 1
+    assert "Quota exceeded" in capsys.readouterr().err
+    calls = calls_log.read_text().splitlines()
+    # the single attempt was the injected one; fatal means no retry
+    # burned, so the real binary never ran at all
+    assert sum(1 for c in calls if c.startswith("terraform apply")) == 0
+    rows = runlog_rows(work)
+    assert rows["terraform-apply"]["status"] == "failed"
+    assert rows["terraform-apply"]["attempts"] == 1
+
+
+def test_exhausted_transient_fault_fails_run(gke_world, capsys):
+    """More injected transients than max_attempts: the run fails with
+    the original error after the full retry budget."""
+    work, config, calls_log = gke_world
+    plan = [{"match": "terraform apply", "times": 99, "rc": 1,
+             "output": "Error: googleapi: Error 502: Bad Gateway"}]
+    rc = main(provision_args(work, config, plan))
+    assert rc == 1
+    calls = calls_log.read_text().splitlines()
+    assert sum(1 for c in calls if c.startswith("terraform apply")) == 0
+    rows = runlog_rows(work)
+    assert rows["terraform-apply"]["status"] == "failed"
+    assert rows["terraform-apply"]["attempts"] == 4  # the default budget
+
+
+def test_fault_plan_from_env_file(gke_world, tmp_path, monkeypatch, capsys):
+    """TK8S_FAULT_PLAN as a file path — the no-CLI-change drill hook."""
+    work, config, calls_log = gke_world
+    plan_file = tmp_path / "drill.json"
+    plan_file.write_text(json.dumps({"faults": [
+        {"match": "terraform init", "times": 1, "rc": 1,
+         "output": "connection reset by peer"},
+    ]}))
+    monkeypatch.setenv("TK8S_FAULT_PLAN", str(plan_file))
+    rc = main(["--yes", "--config", str(config), "--workdir", str(work)])
+    assert rc == 0, capsys.readouterr().out
+    # first init was injected away, the retried one reached the stub
+    calls = calls_log.read_text().splitlines()
+    assert sum(1 for c in calls if c.startswith("terraform init")) == 1
+    assert runlog_rows(work)["terraform-apply"]["attempts"] == 2
+
+
+def test_bad_fault_plan_is_friendly_error(gke_world, capsys):
+    work, config, _ = gke_world
+    rc = main(provision_args(work, config, [{"oops": 1}]))
+    assert rc == 1
+    err = capsys.readouterr().err
+    assert "ERROR:" in err and "match" in err
+
+
+def test_teardown_honors_fault_plan(gke_world, capsys):
+    """Chaos covers the destroy path too: a transient terraform destroy
+    failure retries and the teardown still completes."""
+    work, config, calls_log = gke_world
+    assert main(provision_args(work, config, None)) == 0
+    capsys.readouterr()
+    plan = [{"match": "terraform destroy", "times": 1, "rc": 1,
+             "output": "Error: googleapi: Error 503: Service Unavailable"}]
+    rc = main(["-c", "--yes", "--workdir", str(work),
+               "--fault-plan", json.dumps(plan)])
+    assert rc == 0
+    calls = calls_log.read_text().splitlines()
+    assert sum(1 for c in calls if c.startswith("terraform destroy")) == 1
+    assert not RunPaths(work).config_file.exists()
+
+
+@pytest.mark.chaos
+def test_chaos_hang_drill_killed_by_attempt_timeout(
+    gke_world, monkeypatch, capsys
+):
+    """Chaos drill with real time: a hanging terraform apply is killed
+    by TK8S_ATTEMPT_TIMEOUT (rc 124 -> transient), the retry converges.
+    The injected hang honors the per-attempt budget for real."""
+    import time
+
+    work, config, calls_log = gke_world
+    monkeypatch.setenv("TK8S_ATTEMPT_TIMEOUT", "0.3")
+    plan = [{"match": "terraform apply", "times": 1, "hang": True}]
+    t0 = time.monotonic()
+    rc = main(provision_args(work, config, plan))
+    elapsed = time.monotonic() - t0
+    assert rc == 0, capsys.readouterr().out
+    assert elapsed >= 0.3  # the hang really consumed the attempt budget
+    rows = runlog_rows(work)
+    assert rows["terraform-apply"]["attempts"] == 2
+    assert rows["terraform-apply"]["retry_causes"] == ["hang-timeout"]

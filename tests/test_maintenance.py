@@ -56,6 +56,51 @@ def test_watch_owns_drain_file_lifecycle(tmp_path):
     assert (tmp_path / "d2").exists()
 
 
+def test_watch_backs_off_on_repeated_fetch_errors(tmp_path):
+    """Metadata-server flapping must not be hot-polled at full cadence:
+    consecutive fetch errors back off exponentially (capped), an errored
+    poll leaves the drain file untouched (unknown != cleared), and a
+    recovered fetch resets the backoff."""
+    drain = tmp_path / "drain"
+    mt.request_drain(drain, "maintenance-event: TERMINATE")  # pre-existing
+
+    outcomes = iter([OSError("conn refused"), OSError("conn refused"),
+                     OSError("conn refused"), "NONE"])
+
+    def fetch(url, timeout):
+        value = next(outcomes)
+        if isinstance(value, Exception):
+            raise value
+        return value
+
+    sleeps = []
+
+    def sleeper(s):
+        sleeps.append(s)
+        if drain.exists():
+            saw_drain_survive.append(True)
+        if len(sleeps) == 4:
+            raise StopIteration
+
+    saw_drain_survive = []
+    with pytest.raises(StopIteration):
+        mt.watch(drain, interval=10.0, fetch=fetch, sleep=sleeper,
+                 log=lambda m: None, max_backoff=35.0)
+    # 3 errors: 20, 40->35 (capped), 35; then the good NONE poll resets
+    # to the normal cadence (and, being a real NONE, clears the drain)
+    assert sleeps == [20.0, 35.0, 35.0, 10.0]
+    assert saw_drain_survive == [True, True, True]  # errors never cleared it
+    assert not drain.exists()  # the genuine NONE did
+
+    # once mode: an errored poll reports "no drain" without writing
+    def boom(url, timeout):
+        raise OSError("no metadata server")
+
+    assert mt.watch(tmp_path / "d3", once=True, fetch=boom,
+                    log=lambda m: None) is False
+    assert not (tmp_path / "d3").exists()
+
+
 def test_drain_requested_contract(tmp_path, monkeypatch):
     drain = tmp_path / "drain"
     monkeypatch.setenv(mt.DRAIN_FILE_VAR, str(drain))

@@ -47,6 +47,43 @@ def test_failed_phase_logged_and_reraised(tmp_path):
     assert timer.durations["terraform"] == 1.0
 
 
+def test_note_retry_lands_in_phase_records(tmp_path):
+    """The retry engine's record hook: retried attempts are counted into
+    the open phase's runlog record with their causes, visible in both
+    the done and failed records, and reset between phases."""
+    clock = FakeClock()
+    log = tmp_path / "runlog.jsonl"
+    out = io.StringIO()
+    timer = PhaseTimer(out=out, logfile=log, clock=clock, wall=lambda: 0.0)
+    with timer.phase("terraform-apply"):
+        timer.note_retry("rate-limited")
+        timer.note_retry("connection")
+        clock.t += 5.0
+    with timer.phase("host-configuration"):
+        clock.t += 1.0
+    with pytest.raises(RuntimeError):
+        with timer.phase("readiness-wait"):
+            timer.note_retry("apiserver")
+            raise RuntimeError("still down")
+    records = {
+        (r["phase"], r["status"]): r
+        for r in map(json.loads, log.read_text().splitlines())
+    }
+    done = records[("terraform-apply", "done")]
+    assert done["attempts"] == 3
+    assert done["retry_causes"] == ["rate-limited", "connection"]
+    # a clean phase carries attempts=1 and no retry_causes noise
+    clean = records[("host-configuration", "done")]
+    assert clean["attempts"] == 1 and "retry_causes" not in clean
+    failed = records[("readiness-wait", "failed")]
+    assert failed["attempts"] == 2
+    assert failed["retry_causes"] == ["apiserver"]
+    # the human line surfaces the attempt count too
+    assert "(3 attempts)" in out.getvalue()
+    # outside any phase the hook is a no-op (teardown has no timer)
+    timer.note_retry("ignored")
+
+
 # ------------------------------------------------- budgets / runlog analysis
 
 
@@ -68,7 +105,7 @@ def test_analyze_runlog_budgets(tmp_path):
         {"phase": "host-configuration", "status": "done", "seconds": 300.0},
         {"phase": "mystery-phase", "status": "done", "seconds": 9.0},
         {"phase": "probe-job", "status": "failed", "seconds": 10.0,
-         "error": "boom"},
+         "error": "boom", "attempts": 3},
     ]
     log.write_text("\n".join(json_mod.dumps(r) for r in records) + "\n")
 
@@ -80,9 +117,13 @@ def test_analyze_runlog_budgets(tmp_path):
     assert rows["mystery-phase"]["budget"] is None
     assert rows["mystery-phase"]["over"] is False
     assert rows["probe-job"]["status"] == "failed"
+    # attempt counts: pre-retry-engine records read as 1 attempt
+    assert rows["probe-job"]["retries"] == 2
+    assert rows["terraform-apply"]["retries"] == 0
 
     report = ph.format_runlog_report(ph.analyze_runlog(log))
     assert "OVER-BUDGET" in report and "FAILED" in report
+    assert "retries" in report
     assert "north star" in report
     assert ph.main([str(log)]) == 1
 

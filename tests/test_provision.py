@@ -291,6 +291,77 @@ def test_poll_until_ready_and_timeout():
                        sleep=lambda s: None, echo=lambda line: None)
 
 
+def test_poll_clamps_final_sleep_to_deadline():
+    """The deadline must not overshoot by a full interval: every sleep
+    is min(interval, time-left), and the last probe fires AT the
+    deadline (one genuine final chance) before the timeout verdict."""
+    clock = {"t": 0.0}
+    sleeps = []
+
+    def sleep(s):
+        sleeps.append(s)
+        clock["t"] += s
+
+    with pytest.raises(readiness.NotReadyError, match="timed out"):
+        readiness.poll(
+            lambda: "booting", interval=15.0, timeout=40.0,
+            sleep=sleep, echo=lambda line: None, clock=lambda: clock["t"],
+        )
+    # 15 + 15 + clamped 10 = exactly the 40s budget; never a 55s overrun
+    assert sleeps == [15.0, 15.0, 10.0]
+    assert clock["t"] == 40.0
+
+    # a probe that turns ready exactly at the deadline still wins
+    clock["t"] = 0.0
+    ready_at = 40.0
+    readiness.poll(
+        lambda: "" if clock["t"] >= ready_at else "booting",
+        interval=15.0, timeout=40.0,
+        sleep=sleep, echo=lambda line: None, clock=lambda: clock["t"],
+    )
+
+
+def test_run_streaming_timeout_kills_child_process_group():
+    """A wedged child is killed (whole process group) and surfaces as
+    rc 124 — the bench.py subprocess-probe lesson applied to
+    terraform/ansible/kubectl children."""
+    import sys
+    import time
+
+    t0 = time.monotonic()
+    with pytest.raises(run_mod.CommandError) as exc:
+        run_mod.run_streaming(
+            [sys.executable, "-c",
+             "print('hanging', flush=True); import time; time.sleep(60)"],
+            echo=lambda line: None,
+            timeout=0.3,
+        )
+    assert exc.value.returncode == 124
+    assert "timeout" in exc.value.tail
+    assert "hanging" in exc.value.tail  # pre-hang output preserved
+    assert time.monotonic() - t0 < 30  # killed, not waited out
+
+
+def test_run_streaming_no_timeout_unchanged():
+    import sys
+
+    out = run_mod.run_streaming(
+        [sys.executable, "-c", "print('ok')"], echo=lambda line: None
+    )
+    assert out == "ok"
+
+
+def test_run_capture_timeout_raises_124():
+    import sys
+
+    with pytest.raises(run_mod.CommandError) as exc:
+        run_mod.run_capture(
+            [sys.executable, "-c", "import time; time.sleep(60)"],
+            timeout=0.3,
+        )
+    assert exc.value.returncode == 124
+
+
 def test_jax_smoke_command_asserts_device_count():
     cmd = readiness.jax_smoke_command(8)
     assert "jax.local_device_count()" in cmd and "== 8" in cmd

@@ -1,0 +1,220 @@
+"""Retry/backoff engine units: classifier table, decorrelated-jitter
+bounds, deadline budget, and the retrying_runner wrapper semantics
+(fatal = no retry, transient = backoff, exhaustion = original error)."""
+
+import time
+
+import pytest
+
+from tritonk8ssupervisor_tpu.provision import retry
+from tritonk8ssupervisor_tpu.provision.runner import CommandError
+
+
+def err(tail="", rc=1, args=("tool", "sub")):
+    return CommandError(list(args), rc, tail=tail)
+
+
+# ------------------------------------------------------------- classifier
+
+
+@pytest.mark.parametrize(
+    "tail,rc,verdict,cause",
+    [
+        # terraform / GCP API transients
+        ("Error: googleapi: Error 429: Too Many Requests", 1,
+         retry.TRANSIENT, "rate-limited"),
+        ("googleapi: got HTTP response code 503 with body", 1,
+         retry.TRANSIENT, "server-5xx"),
+        ("Error: Plugin did not respond... connection reset by peer", 1,
+         retry.TRANSIENT, "connection"),
+        ("read tcp 10.0.0.2:443: i/o timeout", 1,
+         retry.TRANSIENT, "timeout"),
+        # ansible's banner for a host that is not up yet
+        ("fatal: [10.0.0.1]: UNREACHABLE! => ssh: connect to host", 4,
+         retry.TRANSIENT, "host-unreachable"),
+        # kubectl against a control plane mid-boot
+        ("Unable to connect to the server: net/http: TLS handshake timeout",
+         1, retry.TRANSIENT, "tls"),
+        ("Unable to connect to the server: EOF", 1,
+         retry.TRANSIENT, "apiserver"),
+        # fatal: quota / auth / usage
+        ("Error 403: Quota exceeded for quota metric 'TPUV5sLitePodPerProjectPerZone'",
+         1, retry.FATAL, "quota-exceeded"),
+        ("ERROR: (gcloud) PERMISSION_DENIED: Permission denied on resource",
+         1, retry.FATAL, "auth"),
+        ("error: You must be logged in to the server (the server has asked "
+         "for the client to provide credentials); 401 Unauthorized", 1,
+         retry.FATAL, "auth"),
+        ("Error: Unsupported argument\n  on main.tf line 7", 1,
+         retry.FATAL, "usage"),
+        ("ERROR! Syntax Error while loading YAML", 4, retry.FATAL, "usage"),
+        # rc-based fallbacks when the output names nothing
+        ("", 124, retry.TRANSIENT, "hang-timeout"),
+        ("", 255, retry.TRANSIENT, "ssh-connect"),
+        ("", 127, retry.FATAL, "missing-binary"),
+        ("something entirely novel", 2, retry.FATAL, "rc-2"),
+    ],
+)
+def test_classifier_table(tail, rc, verdict, cause):
+    got = retry.classify(err(tail, rc))
+    assert (got.verdict, got.cause) == (verdict, cause)
+
+
+def test_fatal_patterns_beat_transient_mentions():
+    """A quota error that also mentions a retryable-looking code must
+    abort: retrying cannot mint quota."""
+    got = retry.classify(err("Error 403: Quota exceeded (http 503 from backend)"))
+    assert got.verdict == retry.FATAL
+
+
+def test_classifier_reads_tail_not_command_line():
+    """`-o ConnectTimeout=5` in the command must not read as a timeout."""
+    e = CommandError(["ssh", "-o", "ConnectTimeout=5", "h", "true"], 2, tail="")
+    assert retry.classify(e).cause == "rc-2"
+
+
+# ----------------------------------------------------------------- jitter
+
+
+def test_decorrelated_jitter_bounds():
+    policy = retry.RetryPolicy(base_delay=2.0, max_delay=60.0)
+    # rng=1.0 drives the upper envelope: min(cap, 3*prev)
+    prev = policy.base_delay
+    uppers = []
+    for _ in range(6):
+        prev = policy.next_delay(prev, lambda: 1.0)
+        uppers.append(prev)
+    assert uppers == [6.0, 18.0, 54.0, 60.0, 60.0, 60.0]  # capped
+    # rng=0.0 floors at base_delay, never below
+    assert policy.next_delay(54.0, lambda: 0.0) == policy.base_delay
+    # any rng value stays inside [base, min(cap, 3*prev)]
+    for r in (0.0, 0.25, 0.5, 0.99):
+        d = policy.next_delay(10.0, lambda: r)
+        assert policy.base_delay <= d <= 30.0
+
+
+# ---------------------------------------------------------------- wrapper
+
+
+class Script:
+    """A RunFn failing per a script of CommandErrors, then succeeding."""
+
+    def __init__(self, failures):
+        self.failures = list(failures)
+        self.calls = []
+
+    def __call__(self, args, **kwargs):
+        self.calls.append((tuple(args), kwargs))
+        if self.failures:
+            raise self.failures.pop(0)
+        return "converged"
+
+
+def test_transient_failures_retry_to_success():
+    script = Script([err("connection reset"), err("Too Many Requests")])
+    causes = []
+    run = retry.retrying_runner(
+        script, retry.RetryPolicy(base_delay=0.0, max_delay=0.0),
+        record=causes.append, sleep=lambda s: None, echo=lambda l: None,
+    )
+    assert run(["terraform", "apply"]) == "converged"
+    assert len(script.calls) == 3
+    assert causes == ["connection", "rate-limited"]
+
+
+def test_fatal_failure_aborts_on_first_attempt():
+    script = Script([err("Error 403: Quota exceeded")])
+    run = retry.retrying_runner(
+        script, retry.RetryPolicy(base_delay=0.0),
+        sleep=lambda s: None, echo=lambda l: None,
+    )
+    with pytest.raises(CommandError, match="Quota exceeded"):
+        run(["terraform", "apply"])
+    assert len(script.calls) == 1  # no retry burned on a hopeless fault
+
+
+def test_exhausted_attempts_reraise_last_error():
+    script = Script([err(f"connection reset #{i}") for i in range(9)])
+    run = retry.retrying_runner(
+        script, retry.RetryPolicy(max_attempts=3, base_delay=0.0,
+                                  max_delay=0.0),
+        sleep=lambda s: None, echo=lambda l: None,
+    )
+    with pytest.raises(CommandError, match="connection reset #2"):
+        run(["kubectl", "get", "nodes"])
+    assert len(script.calls) == 3
+
+
+def test_deadline_budget_stops_retrying():
+    """The sleep that would cross the per-phase deadline is never taken:
+    the loop re-raises instead of eating the phase budget."""
+    clock = {"t": 0.0}
+
+    def fake_sleep(s):
+        clock["t"] += s
+
+    script = Script([err("connection reset") for _ in range(9)])
+    run = retry.retrying_runner(
+        script,
+        retry.RetryPolicy(max_attempts=9, base_delay=10.0, max_delay=10.0,
+                          deadline=25.0),
+        sleep=fake_sleep, clock=lambda: clock["t"],
+        rng=lambda: 0.0, echo=lambda l: None,
+    )
+    with pytest.raises(CommandError, match="connection reset"):
+        run(["terraform", "apply"])
+    # 10s + 10s spent; a third 10s sleep would cross 25s -> abandoned
+    assert len(script.calls) == 3
+    assert clock["t"] == 20.0
+
+
+def test_attempt_timeout_forwarded_to_runner():
+    script = Script([])
+    run = retry.retrying_runner(
+        script, retry.RetryPolicy(attempt_timeout=42.0),
+        sleep=lambda s: None, echo=lambda l: None,
+    )
+    run(["terraform", "apply"])
+    assert script.calls[0][1]["timeout"] == 42.0
+    # an explicit caller timeout wins over the policy's
+    run(["terraform", "apply"], timeout=7.0)
+    assert script.calls[1][1]["timeout"] == 7.0
+
+
+def test_policy_from_env():
+    policy = retry.RetryPolicy.from_env(
+        {
+            "TK8S_RETRY_MAX_ATTEMPTS": "7",
+            "TK8S_RETRY_BASE_DELAY": "0.5",
+            "TK8S_RETRY_MAX_DELAY": "9",
+            "TK8S_RETRY_DEADLINE": "120",
+            "TK8S_ATTEMPT_TIMEOUT": "300",
+        }
+    )
+    assert policy == retry.RetryPolicy(
+        max_attempts=7, base_delay=0.5, max_delay=9.0, deadline=120.0,
+        attempt_timeout=300.0,
+    )
+    # defaults: bounded attempts, no deadline, no per-child timeout
+    default = retry.RetryPolicy.from_env({})
+    assert default.max_attempts == 4
+    assert default.deadline is None and default.attempt_timeout is None
+    # zero/negative disables the optional limits rather than making
+    # every call instantly over budget
+    off = retry.RetryPolicy.from_env({"TK8S_RETRY_DEADLINE": "0",
+                                      "TK8S_ATTEMPT_TIMEOUT": "-1"})
+    assert off.deadline is None and off.attempt_timeout is None
+
+
+@pytest.mark.chaos
+def test_backoff_sleeps_real_time():
+    """Chaos drill: the default wiring really does wait between attempts
+    (no injected sleep), at the policy's decorrelated-jitter pace."""
+    script = Script([err("connection reset"), err("connection reset")])
+    run = retry.retrying_runner(
+        script, retry.RetryPolicy(base_delay=0.05, max_delay=0.1),
+        echo=lambda l: None,
+    )
+    t0 = time.monotonic()
+    assert run(["x"]) == "converged"
+    assert time.monotonic() - t0 >= 0.1  # two sleeps of >= base_delay

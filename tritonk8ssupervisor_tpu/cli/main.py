@@ -27,11 +27,13 @@ from tritonk8ssupervisor_tpu.config.schema import ClusterConfig, ConfigError
 from tritonk8ssupervisor_tpu.provision import (
     ansible as ansible_mod,
     readiness,
+    retry,
     runner as run_mod,
     state,
     teardown,
     terraform as terraform_mod,
 )
+from tritonk8ssupervisor_tpu.testing import faults
 from tritonk8ssupervisor_tpu.utils.phases import PhaseTimer
 
 
@@ -63,6 +65,15 @@ def build_parser() -> argparse.ArgumentParser:
         "--skip-readiness",
         action="store_true",
         help="do not wait for the cluster to become ready",
+    )
+    parser.add_argument(
+        "--fault-plan",
+        default=None,
+        metavar="PLAN",
+        help="deterministic fault-injection plan (inline JSON or a file "
+        "path; also read from TK8S_FAULT_PLAN): fail the Nth child "
+        "command matching a pattern with a chosen exit code/output/hang "
+        "— chaos drills and retry-path tests (docs/failure-modes.md)",
     )
     parser.add_argument(
         "--readiness-timeout", type=float, default=900.0, metavar="SECONDS"
@@ -183,6 +194,7 @@ def main(argv: list[str] | None = None, prompter: Prompter | None = None) -> int
         state.MissingStateError,
         readiness.NotReadyError,
         run_mod.CommandError,
+        faults.FaultPlanError,
         EndOfInput,
     ) as e:
         print(f"ERROR: {e}", file=sys.stderr)
@@ -210,6 +222,29 @@ def show_config(args, paths: state.RunPaths, prompter: Prompter) -> int:
     return 0
 
 
+def build_runners(
+    fault_plan_spec: str | None,
+    timer: PhaseTimer | None = None,
+) -> tuple[run_mod.RunFn, run_mod.RunFn]:
+    """Compose the shared (streaming, quiet) RunFn stack for a run:
+    fault injection innermost — so injected faults exercise exactly the
+    classify/backoff path real ones take — then the retry engine, which
+    reports retried attempts into the open phase's runlog record. The
+    policy comes from TK8S_RETRY_* / TK8S_ATTEMPT_TIMEOUT env knobs
+    (docs/failure-modes.md lists the defaults)."""
+    stream: run_mod.RunFn = run_mod.run_streaming
+    quiet: run_mod.RunFn = run_mod.run_capture
+    plan = faults.load_fault_plan(fault_plan_spec)
+    if plan is not None:
+        stream, quiet = plan.wrap(stream), plan.wrap(quiet)
+    policy = retry.RetryPolicy.from_env()
+    record = timer.note_retry if timer is not None else None
+    return (
+        retry.retrying_runner(stream, policy, record=record),
+        retry.retrying_runner(quiet, policy, record=record),
+    )
+
+
 def clean(args, paths: state.RunPaths, prompter: Prompter) -> int:
     if paths.config_file.exists():
         config = store.load_config_file(paths.config_file)
@@ -221,7 +256,8 @@ def clean(args, paths: state.RunPaths, prompter: Prompter) -> int:
     else:
         prompter.say("No config or terraform state found — nothing to clean.")
         return 0
-    ok = teardown.clean(config, paths, prompter, assume_yes=args.yes)
+    run, _ = build_runners(args.fault_plan)
+    ok = teardown.clean(config, paths, prompter, run=run, assume_yes=args.yes)
     return 0 if ok else 1
 
 
@@ -255,6 +291,9 @@ def provision(args, paths: state.RunPaths, prompter: Prompter) -> int:
         )
 
     timer = PhaseTimer(logfile=paths.runlog)
+    # one composed runner pair (fault injection -> retry/backoff) shared
+    # by every phase, so transient-fault handling is uniform end to end
+    run, run_quiet = build_runners(args.fault_plan, timer)
 
     with timer.phase("discover-environment"):
         env = discovery.discover()
@@ -302,7 +341,7 @@ def provision(args, paths: state.RunPaths, prompter: Prompter) -> int:
     with timer.phase("terraform-apply"):
         if terraform_mod.already_applied(config, paths):
             prompter.say("terraform state present; converging existing deployment")
-        hosts = terraform_mod.apply(config, paths)
+        hosts = terraform_mod.apply(config, paths, run=run, run_quiet=run_quiet)
 
     # tpu-vm mode: readiness comes BEFORE host configuration — ansible
     # needs live sshd on every host (TPU state READY + SSH banner; the
@@ -315,13 +354,14 @@ def provision(args, paths: state.RunPaths, prompter: Prompter) -> int:
             # one shared budget for both polls — the user's timeout caps
             # the whole phase, not each poll
             poll_start = time.monotonic()
-            wait_ready(config, args.readiness_timeout)
+            wait_ready(config, args.readiness_timeout, run_quiet=run_quiet)
             remaining = max(
                 0.0, args.readiness_timeout - (time.monotonic() - poll_start)
             )
             readiness.poll(
                 lambda: readiness.ssh_ready_probe(
-                    hosts.flat_ips, ssh_user=ssh_user, ssh_key=str(ssh_key)
+                    hosts.flat_ips, ssh_user=ssh_user, ssh_key=str(ssh_key),
+                    run_quiet=run_quiet,
                 ),
                 interval=5.0,
                 timeout=remaining,
@@ -331,11 +371,11 @@ def provision(args, paths: state.RunPaths, prompter: Prompter) -> int:
         ansible_mod.write_runtime_configs(
             config, hosts, paths, ssh_key=ssh_key, ansible_user=ssh_user
         )
-        ansible_mod.run_playbook(paths)
+        ansible_mod.run_playbook(paths, run=run)
 
     if config.mode == "gke" and not args.skip_readiness:
         with timer.phase("readiness-wait"):
-            wait_ready(config, args.readiness_timeout)
+            wait_ready(config, args.readiness_timeout, run_quiet=run_quiet)
 
     with timer.phase("compile-manifests"):
         job_kwargs = {"image": args.bench_image} if args.bench_image else {}
@@ -362,6 +402,8 @@ def provision(args, paths: state.RunPaths, prompter: Prompter) -> int:
             readiness.run_probe_job(
                 config,
                 paths.probe_dir,
+                run=run,
+                run_quiet=run_quiet,
                 timeout_seconds=args.readiness_timeout,
                 image=args.probe_image,
             )
@@ -371,17 +413,23 @@ def provision(args, paths: state.RunPaths, prompter: Prompter) -> int:
     return 0
 
 
-def wait_ready(config: ClusterConfig, timeout: float) -> None:
+def wait_ready(
+    config: ClusterConfig,
+    timeout: float,
+    run_quiet: run_mod.RunFn = run_mod.run_capture,
+) -> None:
     if config.mode == "gke":
         readiness.poll(
-            lambda: readiness.gke_tpu_probe(config), timeout=timeout
+            lambda: readiness.gke_tpu_probe(config, run_quiet),
+            timeout=timeout,
         )
     else:
         names = [
             f"{config.node_prefix}-{i}" for i in range(config.num_slices)
         ]
         readiness.poll(
-            lambda: readiness.tpu_vm_probe(config, names), timeout=timeout
+            lambda: readiness.tpu_vm_probe(config, names, run_quiet),
+            timeout=timeout,
         )
 
 

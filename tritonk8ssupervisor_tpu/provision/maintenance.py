@@ -62,15 +62,20 @@ def poll_event(
     fetch: Callable[[str, float], str] | None = None,
     url: str = METADATA_URL,
     timeout: float = 2.0,
+    errors: str = "ignore",
 ) -> str:
     """The current maintenance-event value; "NONE" when nothing is
     scheduled or the metadata server is unreachable (off-GCP dev boxes
-    must not self-drain because metadata.google.internal is absent)."""
+    must not self-drain because metadata.google.internal is absent).
+    errors="raise" propagates fetch failures instead — what watch() uses
+    to tell "no event" apart from "cannot ask" and back off."""
     if fetch is None:
         fetch = _default_fetch  # resolved at call time (testable)
     try:
         value = fetch(url, timeout)
     except Exception:  # noqa: BLE001 - unreachable metadata == no event
+        if errors == "raise":
+            raise
         return "NONE"
     return value or "NONE"
 
@@ -116,17 +121,35 @@ def watch(
     fetch: Callable[[str, float], str] | None = None,
     sleep: Callable[[float], None] = time.sleep,
     log: Callable[[str], None] = print,
+    max_backoff: float = 300.0,
 ) -> bool:
     """Poll the metadata server, owning the drain file's lifecycle:
     write it while an event is pending, REMOVE it once the event clears
     (a live migration completes without a reboot; /run survives until
     reboot — a stale drain file would stop every later run after one
     window). once=True polls a single time and returns whether a drain
-    was requested; the continuous mode never returns."""
+    was requested; the continuous mode never returns.
+
+    Repeated fetch failures back off exponentially (doubling from
+    `interval` up to `max_backoff`) instead of hammering a struggling
+    metadata server at full cadence, and an errored poll leaves the
+    drain file untouched — "cannot ask" must not clear a pending drain
+    the way a genuine NONE does."""
     drain_file = Path(drain_file)
     fired = False
+    consecutive_errors = 0
     while True:
-        event = poll_event(fetch=fetch)
+        try:
+            event = poll_event(fetch=fetch, errors="raise")
+        except Exception as e:  # noqa: BLE001 - metadata server flapping
+            if once:
+                return fired
+            consecutive_errors += 1
+            delay = min(max_backoff, interval * (2.0 ** consecutive_errors))
+            log(f"metadata fetch failed ({e}); backing off {delay:.0f}s")
+            sleep(delay)
+            continue
+        consecutive_errors = 0
         if event != "NONE":
             if not fired or not drain_file.exists():
                 log(f"maintenance event pending: {event}; requesting drain")

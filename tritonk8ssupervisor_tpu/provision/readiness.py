@@ -30,6 +30,7 @@ def poll(
     timeout: float = 900.0,
     sleep: Callable[[float], None] = time.sleep,
     echo: Callable[[str], None] = lambda line: print(line, flush=True),
+    clock: Callable[[], float] = time.monotonic,
 ) -> None:
     """Run `probe` until it returns "" (ready) or the timeout lapses.
 
@@ -37,8 +38,11 @@ def poll(
     the reference's progress ticker (setup.sh:62,80) but with content.
     Probe exceptions count as "not yet" (transient API errors mid-boot).
     The 15 s cadence matches the reference's dashboard poll (setup.sh:66).
+    The final sleep is clamped to the time left so the deadline cannot
+    overshoot by a full interval; the last probe fires AT the deadline
+    (one genuine last chance) and its verdict decides.
     """
-    deadline = time.monotonic() + timeout
+    deadline = clock() + timeout
     while True:
         try:
             why_not = probe()
@@ -48,10 +52,11 @@ def poll(
             why_not = f"probe error: {e}"
         if not why_not:
             return
-        if time.monotonic() >= deadline:
+        now = clock()
+        if now >= deadline:
             raise NotReadyError(f"timed out after {timeout:.0f}s: {why_not}")
         echo(f"  ... {why_not}")
-        sleep(interval)
+        sleep(min(interval, deadline - now))
 
 
 # ------------------------------------------------------------------ GKE mode

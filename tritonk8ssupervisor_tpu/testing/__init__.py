@@ -1,0 +1,7 @@
+"""Deterministic test/chaos instrumentation shipped WITH the framework.
+
+Unlike tests/, this package installs with the wheel: the fault-injection
+harness (testing/faults.py) must be loadable by a production `setup.sh`
+run so operators can run chaos drills against a live cluster with the
+same plans CI uses against stub binaries.
+"""

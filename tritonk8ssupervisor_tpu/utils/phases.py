@@ -42,50 +42,59 @@ class PhaseTimer:
         self._wall = wall
         self.durations: dict[str, float] = {}
         self._t0 = clock()
+        self._retries: list[str] | None = None  # open phase's retry causes
 
     def _emit(self, record: dict) -> None:
         phase = record["phase"]
         status = record["status"]
+        retried = f" ({record['attempts']} attempts)" \
+            if record.get("attempts", 1) > 1 else ""
         if status == "start":
             line = f"==> {phase}"
         elif status == "done":
-            line = f"==> {phase} done in {record['seconds']:.1f}s"
+            line = f"==> {phase} done in {record['seconds']:.1f}s{retried}"
         else:
-            line = f"==> {phase} FAILED after {record['seconds']:.1f}s: {record.get('error', '')}"
+            line = f"==> {phase} FAILED after {record['seconds']:.1f}s{retried}: {record.get('error', '')}"
         print(line, file=self._out, flush=True)
         if self._logfile is not None:
             with self._logfile.open("a") as f:
                 f.write(json.dumps(record, sort_keys=True) + "\n")
 
+    def note_retry(self, cause: str) -> None:
+        """Record one retried attempt against the currently open phase —
+        the retry engine's `record` hook (provision/retry.py), which is
+        how per-phase attempt counts reach the runlog. A retry outside
+        any phase (e.g. teardown) is silently dropped."""
+        if self._retries is not None:
+            self._retries.append(cause)
+
+    def _close(self, name: str, start: float, extra: dict) -> dict:
+        seconds = self._clock() - start
+        self.durations[name] = self.durations.get(name, 0.0) + seconds
+        retries, self._retries = self._retries or [], None
+        record = {
+            "ts": self._wall(),
+            "phase": name,
+            "seconds": round(seconds, 3),
+            "attempts": 1 + len(retries),
+            **extra,
+        }
+        if retries:
+            record["retry_causes"] = retries
+        return record
+
     @contextlib.contextmanager
     def phase(self, name: str):
         start = self._clock()
+        self._retries = []
         self._emit({"ts": self._wall(), "phase": name, "status": "start"})
         try:
             yield
         except BaseException as e:
-            seconds = self._clock() - start
-            self.durations[name] = self.durations.get(name, 0.0) + seconds
-            self._emit(
-                {
-                    "ts": self._wall(),
-                    "phase": name,
-                    "status": "failed",
-                    "seconds": round(seconds, 3),
-                    "error": str(e),
-                }
-            )
+            self._emit(self._close(name, start,
+                                   {"status": "failed", "error": str(e)}))
             raise
-        seconds = self._clock() - start
-        self.durations[name] = self.durations.get(name, 0.0) + seconds
-        self._emit(
-            {
-                "ts": self._wall(),
-                "phase": name,
-                "status": "done",
-                "seconds": round(seconds, 3),
-            }
-        )
+        self._emit(self._close(name, start, {"status": "done"}))
 
     @property
     def total(self) -> float:
@@ -135,9 +144,12 @@ TOTAL_BUDGET_SECONDS = 900.0  # the BASELINE.md north star
 
 def analyze_runlog(path: Path) -> list[dict]:
     """Per-phase durations from a runlog.jsonl, judged against
-    PHASE_BUDGETS: [{phase, seconds, budget, over, status}] in first-seen
-    order, repeated phases (re-runs) summed the way PhaseTimer.report
-    sums them. Unknown phases get no budget and can't be over."""
+    PHASE_BUDGETS: [{phase, seconds, budget, over, status, retries}] in
+    first-seen order, repeated phases (re-runs) summed the way
+    PhaseTimer.report sums them. Unknown phases get no budget and can't
+    be over. `retries` sums the retried attempts the retry engine
+    recorded (attempts - 1 per record) — how many transient faults the
+    phase absorbed on the way to its verdict."""
     rows: dict[str, dict] = {}
     for line in Path(path).read_text().splitlines():
         if not line.strip():
@@ -147,9 +159,11 @@ def analyze_runlog(path: Path) -> list[dict]:
             continue
         name = record["phase"]
         row = rows.setdefault(
-            name, {"phase": name, "seconds": 0.0, "status": "done"}
+            name, {"phase": name, "seconds": 0.0, "status": "done",
+                   "retries": 0}
         )
         row["seconds"] += float(record.get("seconds", 0.0))
+        row["retries"] += max(0, int(record.get("attempts", 1)) - 1)
         if record["status"] == "failed":
             row["status"] = "failed"
     out = []
@@ -163,17 +177,18 @@ def analyze_runlog(path: Path) -> list[dict]:
 
 def format_runlog_report(rows: list[dict]) -> str:
     """The budget table: one line per phase, OVER-BUDGET/FAILED flags,
-    and the total judged against TOTAL_BUDGET_SECONDS."""
-    lines = [f"{'phase':<24} {'seconds':>9} {'budget':>9}  verdict"]
+    retry counts, and the total judged against TOTAL_BUDGET_SECONDS."""
+    lines = [f"{'phase':<24} {'seconds':>9} {'budget':>9} {'retries':>8}  verdict"]
     total = 0.0
     for row in rows:
         total += row["seconds"]
         budget = "-" if row["budget"] is None else f"{row['budget']:.0f}"
         verdict = ("FAILED" if row["status"] == "failed"
                    else "OVER-BUDGET" if row["over"] else "ok")
+        retries = row.get("retries", 0)
         lines.append(
             f"{row['phase']:<24} {row['seconds']:>8.1f}s {budget:>8}s"
-            f"  {verdict}"
+            f" {retries:>8}  {verdict}"
         )
     verdict = "ok" if total <= TOTAL_BUDGET_SECONDS else "OVER-BUDGET"
     lines.append(
