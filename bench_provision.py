@@ -1,0 +1,185 @@
+#!/usr/bin/env python3
+"""Provisioning-pipeline benchmark: sequential vs. DAG wall-clock on a
+simulated multi-slice cluster. ONE JSON document, no cloud, no sleeps.
+
+The north-star metric is `setup.sh`→ready wall-clock (<15 min,
+BASELINE.md), but until real TPU quota exists that number cannot be
+measured live — and the pipeline's SHAPE (what overlaps what) can.
+This benchmark replays the provision DAG (cli/main.py
+build_provision_dag's edges, with readiness fanned out per slice the
+way the concurrent probes fan out per host) on a virtual clock
+(testing/simclock.py) against a strictly-sequential baseline — the
+reference's bash `main` shape — and reports the makespan ratio. The
+phase durations are a MODEL (scaled from utils/phases.py
+PHASE_BUDGETS, not a measurement); what the benchmark proves is the
+schedule: how much of the sequential wall-clock the DAG's overlap
+removes, and that the measured win equals the critical-path prediction
+exactly. The first real-quota run replaces the model with measured
+runlog spans (docs/performance.md).
+
+Usage::
+
+    python bench_provision.py [--slices 4] [--out BENCH_provision.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import io
+import json
+import sys
+
+from tritonk8ssupervisor_tpu.provision.scheduler import (
+    Task,
+    critical_path,
+    run_dag,
+    validate,
+)
+from tritonk8ssupervisor_tpu.testing.simclock import SimClock
+from tritonk8ssupervisor_tpu.utils.phases import PhaseTimer
+
+# Simulated phase durations (seconds) for ONE provision of a tpu-vm
+# cluster — the per-phase budgets of utils/phases.py with readiness
+# split into its per-slice constituents (TPU state poll, then the
+# authenticated-SSH gate), which is where the concurrency lives:
+# terraform's count fan-out creates slices in parallel, so their
+# readiness clocks tick together, but the sequential pipeline PROBED
+# them one after another and paid the sum.
+SIM_SECONDS = {
+    "terraform-apply": 300.0,
+    "compile-manifests": 20.0,
+    "tpu-state-slice": 75.0,  # per slice: QueuedResource -> READY poll
+    "ssh-ready-slice": 45.0,  # per slice: sshd accepting auth sessions
+    "host-configuration": 150.0,
+}
+
+
+def build_sim_tasks(
+    clock: SimClock, num_slices: int
+) -> tuple[list[Task], dict[str, float]]:
+    """The provision DAG with per-slice readiness tasks. Returns the
+    tasks plus {name: simulated seconds} for the critical-path check."""
+
+    durations: dict[str, float] = {}
+
+    def sim(name: str, seconds: float):
+        durations[name] = seconds
+
+        def fn(results: dict) -> float:
+            clock.begin()
+            clock.sleep(seconds)
+            return seconds
+
+        return fn
+
+    tasks = [
+        Task("terraform-apply",
+             sim("terraform-apply", SIM_SECONDS["terraform-apply"])),
+        Task("compile-manifests",
+             sim("compile-manifests", SIM_SECONDS["compile-manifests"])),
+    ]
+    ssh_names = []
+    for i in range(num_slices):
+        tpu = f"tpu-state-slice-{i}"
+        ssh = f"ssh-ready-slice-{i}"
+        tasks.append(
+            Task(tpu, sim(tpu, SIM_SECONDS["tpu-state-slice"]),
+                 after=("terraform-apply",))
+        )
+        tasks.append(Task(ssh, sim(ssh, SIM_SECONDS["ssh-ready-slice"]),
+                          after=(tpu,)))
+        ssh_names.append(ssh)
+    tasks.append(
+        Task("host-configuration",
+             sim("host-configuration", SIM_SECONDS["host-configuration"]),
+             after=tuple(ssh_names))
+    )
+    return tasks, durations
+
+
+def linearize(tasks: list[Task]) -> list[Task]:
+    """The sequential baseline: the same tasks chained end to end in
+    topological order — exactly the reference's bash `main` shape, where
+    nothing starts until everything before it finished."""
+    chained: list[Task] = []
+    prev: str | None = None
+    for task in validate(tasks):
+        chained.append(
+            Task(task.name, task.fn,
+                 after=(prev,) if prev is not None else ())
+        )
+        prev = task.name
+    return chained
+
+
+def simulate(tasks: list[Task], clock: SimClock, max_workers: int) -> dict:
+    """Run the graph on the virtual clock; return makespan + work sum."""
+    timer = PhaseTimer(out=io.StringIO(), clock=clock.time, wall=clock.time)
+    run_dag(
+        tasks,
+        max_workers=max_workers,
+        timer=timer,
+        on_submit=clock.launch,
+        on_settled=clock.release,
+    )
+    return {"wall_s": timer.wall, "work_s": timer.total,
+            "phases": dict(timer.durations)}
+
+
+def run_benchmark(num_slices: int = 4) -> dict:
+    """Sequential vs. DAG provision of `num_slices` slices, plus the
+    critical-path prediction the DAG makespan must equal."""
+    # pool must cover the widest antichain: all slices' probes + the
+    # manifest compile riding along terraform
+    width = 2 * num_slices + 2
+
+    seq_clock = SimClock()
+    seq_tasks, _ = build_sim_tasks(seq_clock, num_slices)
+    sequential = simulate(linearize(seq_tasks), seq_clock, max_workers=2)
+
+    dag_clock = SimClock()
+    dag_tasks, durations = build_sim_tasks(dag_clock, num_slices)
+    dag = simulate(dag_tasks, dag_clock, max_workers=width)
+
+    crit = critical_path(dag_tasks, durations)
+    crit_seconds = sum(durations[name] for name in crit)
+    return {
+        "benchmark": "provision_sim",
+        "metric": "provision_wall_clock_speedup",
+        "unit": "x (sequential/dag makespan, simulated)",
+        "num_slices": num_slices,
+        "model_seconds": dict(SIM_SECONDS),
+        "sequential": sequential,
+        "dag": dag,
+        "critical_path": crit,
+        "critical_path_s": crit_seconds,
+        "value": round(sequential["wall_s"] / dag["wall_s"], 3),
+        "dag_matches_critical_path": abs(dag["wall_s"] - crit_seconds) < 1e-6,
+    }
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--slices", type=int, default=4)
+    parser.add_argument("--out", default=None, metavar="FILE",
+                        help="also write the JSON document to FILE")
+    args = parser.parse_args(argv)
+    result = run_benchmark(args.slices)
+    doc = json.dumps(result, indent=2, sort_keys=True)
+    print(doc)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(doc + "\n")
+    print(
+        f"\n{args.slices}-slice provision (simulated): "
+        f"sequential {result['sequential']['wall_s']:.0f}s -> "
+        f"DAG {result['dag']['wall_s']:.0f}s "
+        f"({result['value']:.2f}x; critical path "
+        f"{' -> '.join(result['critical_path'])})",
+        file=sys.stderr,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

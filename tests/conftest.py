@@ -87,6 +87,12 @@ def pytest_configure(config):
         "chaos: fault-injection drills exercising real sleeps/timeouts; "
         "skipped unless --chaos",
     )
+    config.addinivalue_line(
+        "markers",
+        "perf: scheduler/pipeline performance smoke tests on the virtual "
+        "clock (no real sleeps) — tier-1 by default, selectable with "
+        "-m perf",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

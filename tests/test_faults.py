@@ -119,6 +119,42 @@ def test_hang_consumes_timeout_budget_then_rc_124():
     assert slept[-1] == 5
 
 
+def test_match_counters_are_thread_safe():
+    """Under the DAG scheduler many worker threads drive one wrapped
+    runner at once; the Nth-match window must fire EXACTLY `times`
+    injections — a racy counter would over- or under-inject and turn a
+    deterministic drill into a flake. 16 threads x 25 calls, window
+    [after=10, +times=5)."""
+    import threading
+
+    plan = faults.FaultPlan.from_json(
+        '[{"match": "probe", "after": 10, "times": 5, "rc": 7}]',
+        echo=lambda line: None,
+    )
+    run = plan.wrap(ok_run)
+    outcomes = []
+    lock = threading.Lock()
+    barrier = threading.Barrier(16)
+
+    def hammer():
+        barrier.wait()
+        for _ in range(25):
+            try:
+                run(["probe", "host"])
+            except CommandError:
+                with lock:
+                    outcomes.append("fault")
+
+    threads = [threading.Thread(target=hammer) for _ in range(16)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert outcomes == ["fault"] * 5
+    assert plan.rules[0].seen == 16 * 25
+    assert sorted(f["nth"] for f in plan.injected) == [10, 11, 12, 13, 14]
+
+
 # ------------------------------------------------------------ e2e pipeline
 
 

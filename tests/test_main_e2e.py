@@ -70,6 +70,9 @@ def fake_world(tmp_path, monkeypatch):
           "config get-value project") echo stub-proj ;;
           "config get-value account") echo me@stub.test ;;
           "config get-value compute/zone") echo "" ;;
+          *"tpu-vm list"*)
+            # the batched readiness probe: every slice READY
+            for i in 0 1 2 3; do printf 'tpunode-%s\\tREADY\\n' "$i"; done ;;
           *describe*) echo READY ;;
         esac
         """,
@@ -117,22 +120,34 @@ def test_provision_then_clean_tpu_vm(fake_world, capsys):
     calls = calls_log.read_text()
     assert "terraform init" in calls and "terraform apply" in calls
     assert "ansible-playbook -i hosts clusterUp.yml" in calls
-    assert "describe" in calls  # readiness probed the TPU state
+    # readiness probed the TPU state via ONE batched list call, not
+    # per-slice describes
+    assert "tpu-vm list" in calls and "describe" not in calls
     # tpu-vm order: readiness (TPU state + authenticated SSH) runs BEFORE
-    # ansible — the reference's sleep-30 bootstrap replacement
+    # ansible — the reference's sleep-30 bootstrap replacement. The DAG
+    # scheduler preserves the edge even though phases may interleave.
     lines = calls.splitlines()
     first_ssh = next(i for i, l in enumerate(lines) if l.startswith("ssh -o BatchMode"))
-    first_describe = next(i for i, l in enumerate(lines) if "describe" in l)
+    first_list = next(i for i, l in enumerate(lines) if "tpu-vm list" in l)
     ansible_at = next(i for i, l in enumerate(lines) if l.startswith("ansible-playbook"))
-    assert first_describe < ansible_at and first_ssh < ansible_at
+    assert first_list < ansible_at and first_ssh < ansible_at
     assert paths.config_file.exists()
     assert json.loads(paths.hosts_file.read_text())["coordinator_ip"] == "10.0.0.1"
     assert "10.0.0.1" in paths.inventory.read_text()
     assert (paths.manifests_dir / "bench-service.yaml").exists()
     assert "private_key_file = " in paths.ansible_cfg.read_text()
     # phase timing recorded (north-star wall-clock, SURVEY.md §5)
-    phases = [json.loads(l)["phase"] for l in paths.runlog.read_text().splitlines()]
+    records = [json.loads(l) for l in paths.runlog.read_text().splitlines()]
+    phases = [r["phase"] for r in records]
     assert "terraform-apply" in phases and "readiness-wait" in phases
+    # DAG metadata: spans + dependency edges land in the runlog so
+    # `python -m ...utils.phases runlog.jsonl` can compute the critical
+    # path (docs/performance.md)
+    done = {r["phase"]: r for r in records if r.get("status") == "done"}
+    assert done["readiness-wait"]["after"] == ["terraform-apply"]
+    assert done["host-configuration"]["after"] == ["readiness-wait"]
+    assert "after" not in done["compile-manifests"]  # free to overlap
+    assert all("t_start" in r and "t_end" in r for r in done.values())
 
     out = capsys.readouterr().out
     assert "Cluster is ready" in out

@@ -135,6 +135,93 @@ def test_analyze_runlog_budgets(tmp_path):
     assert ph.main([str(good)]) == 0
 
 
+def test_overlapping_phases_wall_and_critical_path(tmp_path):
+    """DAG-era runlogs: records carry span offsets + dependency edges;
+    the analysis reconstructs the critical path, the report judges the
+    WALL makespan (not the double-counting sum), and crit-column stars
+    mark the chain that bounds the run."""
+    import json as json_mod
+
+    from tritonk8ssupervisor_tpu.utils import phases as ph
+
+    log = tmp_path / "runlog.jsonl"
+    records = [
+        {"phase": "terraform-apply", "status": "done", "seconds": 300.0,
+         "t_start": 0.0, "t_end": 300.0},
+        # compile-manifests rode along terraform — off the critical path
+        {"phase": "compile-manifests", "status": "done", "seconds": 20.0,
+         "t_start": 0.0, "t_end": 20.0},
+        {"phase": "readiness-wait", "status": "done", "seconds": 100.0,
+         "t_start": 300.0, "t_end": 400.0, "after": ["terraform-apply"]},
+        {"phase": "host-configuration", "status": "done", "seconds": 150.0,
+         "t_start": 400.0, "t_end": 550.0, "after": ["readiness-wait"]},
+    ]
+    log.write_text("\n".join(json_mod.dumps(r) for r in records) + "\n")
+
+    rows = {r["phase"]: r for r in ph.analyze_runlog(log)}
+    assert rows["terraform-apply"]["crit"] is True
+    assert rows["readiness-wait"]["crit"] is True
+    assert rows["host-configuration"]["crit"] is True
+    assert rows["compile-manifests"]["crit"] is False
+    assert ph.wall_seconds(list(rows.values())) == 550.0
+
+    report = ph.format_runlog_report(ph.analyze_runlog(log))
+    assert "WALL" in report and "550.0s" in report
+    # sum is 570 but wall is 550 and under budget -> run is ok
+    assert ph.main([str(log)]) == 0
+
+    # a pre-DAG runlog (no offsets/edges) gets no fabricated path
+    legacy = tmp_path / "legacy.jsonl"
+    legacy.write_text(json_mod.dumps(
+        {"phase": "terraform-apply", "status": "done", "seconds": 10.0}
+    ) + "\n")
+    legacy_rows = ph.analyze_runlog(legacy)
+    assert all(r["crit"] is False for r in legacy_rows)
+    assert ph.wall_seconds(legacy_rows) is None
+    assert "WALL" not in ph.format_runlog_report(legacy_rows)
+
+
+def test_phase_timer_overlap_report_and_thread_safety():
+    """Phases opened from concurrent threads: durations/spans all land,
+    note_retry attributes to the phase open in the CALLING thread, and
+    the report adds a WALL line when phases overlapped."""
+    import io
+    import threading
+
+    from tritonk8ssupervisor_tpu.utils.phases import PhaseTimer
+
+    clock = FakeClock()
+    out = io.StringIO()
+    timer = PhaseTimer(out=out, clock=clock, wall=lambda: 0.0)
+    start_b = threading.Event()
+    done_b = threading.Event()
+
+    def phase_b():
+        with timer.phase("b", after=("seed",)):
+            timer.note_retry("connection")
+            start_b.wait(timeout=5)
+        done_b.set()
+
+    with timer.phase("seed"):
+        clock.t += 1.0
+    t = threading.Thread(target=phase_b)
+    t.start()
+    with timer.phase("a"):
+        timer.note_retry("rate-limited")
+        clock.t += 10.0
+        start_b.set()  # b closes somewhere inside a's window
+        done_b.wait(timeout=5)
+    t.join(timeout=5)
+
+    assert timer.durations["a"] == 10.0
+    assert set(timer.durations) == {"seed", "a", "b"}
+    assert timer.wall <= timer.total  # overlap never inflates the wall
+    timer.report()
+    text = out.getvalue()
+    assert "(3 attempts)" not in text  # retries did not cross threads
+    assert "(2 attempts)" in text  # each phase saw exactly its own retry
+
+
 def test_budgets_sum_inside_north_star():
     """The per-phase budgets must themselves add up inside the 15-minute
     setup->ready target, or the table promises the impossible."""
@@ -149,5 +236,10 @@ def test_budgets_sum_inside_north_star():
     main_py = (Path(ph.__file__).resolve().parents[1] / "cli" /
                "main.py").read_text()
     used = set(re.findall(r'timer\.phase\("([^"]+)"\)', main_py))
+    # DAG tasks ARE phases now (scheduler wraps each in timer.phase)
+    used |= set(re.findall(r'Task\(\s*"([^"]+)"', main_py))
+    # regex-rot guard: the DAG names must actually be found
+    assert {"terraform-apply", "compile-manifests",
+            "host-configuration"} <= used
     unbudgeted = used - set(ph.PHASE_BUDGETS)
     assert not unbudgeted, f"phases without budgets: {sorted(unbudgeted)}"

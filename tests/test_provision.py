@@ -137,6 +137,67 @@ def test_terraform_gke_outputs(tmp_path):
     assert hosts.flat_ips == []
 
 
+def test_terraform_init_skipped_when_module_initialized(tmp_path, capsys):
+    """Re-runs skip `terraform init` once .terraform/ exists — a network
+    round-trip shaved off every converge. A fresh module still inits."""
+    paths = make_paths(tmp_path)
+    quiet = RecordingRunner(
+        responses={("terraform", "output", "-json"): json.dumps(
+            {"host_ips": {"value": [["34.1.1.1"]]},
+             "internal_ips": {"value": [["10.0.0.1"]]}}
+        )}
+    )
+    run = RecordingRunner()
+    terraform_mod.apply(cfg(), paths, run=run, run_quiet=quiet)
+    assert any("terraform init" in c for c in run.commands())
+
+    (paths.terraform_module("tpu-vm") / ".terraform").mkdir()
+    run2 = RecordingRunner()
+    terraform_mod.apply(cfg(), paths, run=run2, run_quiet=quiet)
+    assert not any("terraform init" in c for c in run2.commands())
+    assert any("terraform apply" in c for c in run2.commands())
+    assert "skipping init" in capsys.readouterr().out
+
+
+def test_terraform_env_plugin_cache(tmp_path, monkeypatch):
+    """Terraform children get TF_PLUGIN_CACHE_DIR under terraform/ so the
+    google provider downloads once per checkout; an operator's own
+    setting wins."""
+    paths = state.RunPaths(tmp_path)
+    paths.terraform_dir.mkdir()
+    monkeypatch.delenv("TF_PLUGIN_CACHE_DIR", raising=False)
+    env = terraform_mod.terraform_env(paths)
+    cache = paths.terraform_dir / ".plugin-cache"
+    assert env["TF_PLUGIN_CACHE_DIR"] == str(cache)
+    assert cache.is_dir()
+    assert env["PATH"]  # full inherited environment, not a bare dict
+
+    monkeypatch.setenv("TF_PLUGIN_CACHE_DIR", "/operator/cache")
+    assert terraform_mod.terraform_env(paths)["TF_PLUGIN_CACHE_DIR"] == (
+        "/operator/cache"
+    )
+
+
+def test_terraform_apply_passes_env_to_children(tmp_path):
+    paths = make_paths(tmp_path)
+    seen_env = []
+
+    def run(args, cwd=None, env=None, **kwargs):
+        seen_env.append(env)
+        return ""
+
+    quiet = RecordingRunner(
+        responses={("terraform", "output", "-json"): json.dumps(
+            {"host_ips": {"value": [["34.1.1.1"]]},
+             "internal_ips": {"value": [["10.0.0.1"]]}}
+        )}
+    )
+    terraform_mod.apply(cfg(), paths, run=run, run_quiet=quiet)
+    assert seen_env and all(
+        e is not None and "TF_PLUGIN_CACHE_DIR" in e for e in seen_env
+    )
+
+
 def test_already_applied_idempotency(tmp_path):
     paths = make_paths(tmp_path)
     config = cfg()
@@ -236,11 +297,31 @@ def test_gke_probe_counts_nodes_and_chips():
 
 
 def test_tpu_vm_probe_states():
+    """ONE `tpu-vm list` call covers every slice; the verdict names every
+    slice still in flight, and a slice missing from the listing reads
+    CREATING (QueuedResource not materialised), not an error."""
     config = cfg()
-    quiet = RecordingRunner(responses={("gcloud",): "CREATING\n"})
-    assert "CREATING" in readiness.tpu_vm_probe(config, ["n-0"], quiet)
-    quiet = RecordingRunner(responses={("gcloud",): "READY\n"})
+    quiet = RecordingRunner(
+        responses={("gcloud",): "n-0\tCREATING\nn-1\tREADY\n"}
+    )
+    why = readiness.tpu_vm_probe(config, ["n-0", "n-1", "n-2"], quiet)
+    assert "n-0 is CREATING" in why
+    assert "n-2 is CREATING" in why  # absent from listing
+    assert "n-1" not in why  # ready slices are not noise
+    # one round-trip regardless of slice count
+    assert len(quiet.calls) == 1
+    assert "list" in quiet.commands()[0]
+    assert "--format=value(name,state)" in quiet.commands()[0]
+
+    quiet = RecordingRunner(responses={("gcloud",): "n-0\tREADY\nn-1\tREADY\n"})
     assert readiness.tpu_vm_probe(config, ["n-0", "n-1"], quiet) == ""
+
+    # full resource paths (some gcloud versions) are tolerated
+    quiet = RecordingRunner(
+        responses={("gcloud",):
+                   "projects/p/locations/z/nodes/n-0\tREADY\n"}
+    )
+    assert readiness.tpu_vm_probe(config, ["n-0"], quiet) == ""
 
 
 def test_ssh_ready_probe_uses_ansible_credentials():
@@ -263,6 +344,58 @@ def test_ssh_ready_probe_reports_unreachable_host():
 
     why = readiness.ssh_ready_probe(["10.0.0.9"], run_quiet=failing)
     assert "10.0.0.9" in why and "255" in why
+
+
+def test_ssh_ready_probe_names_every_unready_host():
+    """The aggregate verdict lists ALL unready hosts (with their rc), not
+    just the first — the operator sees the whole set per poll cycle."""
+    bad = {"10.0.0.2": 255, "10.0.0.4": 124}
+
+    def run_quiet(args, cwd=None, **kwargs):
+        ip = args[-2]
+        if ip in bad:
+            raise run_mod.CommandError(args, bad[ip])
+        return ""
+
+    why = readiness.ssh_ready_probe(
+        ["10.0.0.1", "10.0.0.2", "10.0.0.3", "10.0.0.4"], run_quiet=run_quiet
+    )
+    assert "2/4" in why
+    assert "10.0.0.2 (rc 255)" in why and "10.0.0.4 (rc 124)" in why
+    assert "10.0.0.1" not in why and "10.0.0.3" not in why
+
+
+def test_ssh_probe_hung_host_costs_one_timeout_not_n():
+    """Satellite acceptance: 8-host probe where one host hangs — every
+    other host is still probed, the verdict names the hung host, and
+    wall-clock is ~one timeout, not eight of them (the probes really
+    ran concurrently)."""
+    import time
+
+    hang_s = 0.25
+    probed = []
+    lock = __import__("threading").Lock()
+
+    def run_quiet(args, cwd=None, **kwargs):
+        ip = args[-2]
+        with lock:
+            probed.append(ip)
+        if ip == "10.0.0.5":
+            time.sleep(hang_s)  # a wedged sshd: killed by timeout, rc 124
+            raise run_mod.CommandError(args, 124)
+        return ""
+
+    ips = [f"10.0.0.{i}" for i in range(8)]
+    t0 = time.monotonic()
+    why = readiness.ssh_ready_probe(ips, run_quiet=run_quiet)
+    elapsed = time.monotonic() - t0
+    assert sorted(probed) == sorted(ips)  # the hang blocked nobody else
+    assert "10.0.0.5 (rc 124)" in why and "1/8" in why
+    assert elapsed < hang_s * 4  # ~one timeout; serial would be ~8x
+
+
+def test_ssh_ready_probe_empty_host_list_is_ready():
+    assert readiness.ssh_ready_probe([], run_quiet=None) == ""
 
 
 def test_modes_with_state(tmp_path):

@@ -1,0 +1,293 @@
+"""DAG scheduler + virtual-clock simulation harness: graph validation,
+overlap, fail-fast semantics under the PR-1 retry classifier, and the
+sequential-vs-DAG provisioning benchmark (the wall-clock-to-ready
+north-star finally has a provisioning datapoint; docs/performance.md)."""
+
+import io
+import json
+import threading
+
+import pytest
+
+import bench_provision
+from tritonk8ssupervisor_tpu.provision import retry
+from tritonk8ssupervisor_tpu.provision.runner import CommandError
+from tritonk8ssupervisor_tpu.provision.scheduler import (
+    SchedulerError,
+    Task,
+    critical_path,
+    run_dag,
+    validate,
+)
+from tritonk8ssupervisor_tpu.testing import faults
+from tritonk8ssupervisor_tpu.testing.simclock import SimClock, SimClockStalled
+from tritonk8ssupervisor_tpu.utils.phases import PhaseTimer
+
+
+def quiet_dag(tasks, **kwargs):
+    kwargs.setdefault("echo", lambda line: None)
+    return run_dag(tasks, **kwargs)
+
+
+# ------------------------------------------------------------- graph shape
+
+
+def test_results_flow_and_dependency_order():
+    log = []
+    lock = threading.Lock()
+
+    def note(name, value):
+        def fn(results):
+            with lock:
+                log.append(name)
+            return value
+
+        return fn
+
+    results = quiet_dag(
+        [
+            Task("c", lambda r: r["a"] + r["b"], after=("a", "b")),
+            Task("a", note("a", 1)),
+            Task("b", note("b", 2)),
+        ]
+    )
+    assert results == {"a": 1, "b": 2, "c": 3}
+    assert set(log) == {"a", "b"}  # c's fn used results, not the log
+
+
+def test_graph_validation_errors():
+    with pytest.raises(SchedulerError, match="duplicate"):
+        validate([Task("a", lambda r: None), Task("a", lambda r: None)])
+    with pytest.raises(SchedulerError, match="unknown task"):
+        validate([Task("a", lambda r: None, after=("ghost",))])
+    with pytest.raises(SchedulerError, match="cycle"):
+        validate(
+            [
+                Task("a", lambda r: None, after=("b",)),
+                Task("b", lambda r: None, after=("a",)),
+            ]
+        )
+    assert quiet_dag([]) == {}
+
+
+def test_validate_is_stable_topological_order():
+    tasks = [
+        Task("z", lambda r: None),
+        Task("m", lambda r: None, after=("z",)),
+        Task("a", lambda r: None),
+    ]
+    assert [t.name for t in validate(tasks)] == ["z", "a", "m"]
+
+
+def test_critical_path_longest_chain():
+    tasks = [
+        Task("tf", lambda r: None),
+        Task("manifests", lambda r: None),
+        Task("ready", lambda r: None, after=("tf",)),
+        Task("ansible", lambda r: None, after=("ready",)),
+    ]
+    durations = {"tf": 300.0, "manifests": 600.0, "ready": 75.0,
+                 "ansible": 150.0}
+    # a single heavy task with no chain outweighs the tf chain (525s)
+    assert critical_path(tasks, durations) == ["manifests"]
+    durations["manifests"] = 20.0
+    assert critical_path(tasks, durations) == ["tf", "ready", "ansible"]
+
+
+# ---------------------------------------------------------- fail-fast + drain
+
+
+def test_failure_skips_dependents_and_reraises_original():
+    ran = []
+    lock = threading.Lock()
+
+    def mark(name):
+        def fn(results):
+            with lock:
+                ran.append(name)
+
+        return fn
+
+    def boom(results):
+        raise CommandError(["terraform", "apply"], 1, tail="Error 403")
+
+    echoes = []
+    with pytest.raises(CommandError) as exc:
+        run_dag(
+            [
+                Task("tf", boom),
+                Task("ready", mark("ready"), after=("tf",)),
+                Task("ansible", mark("ansible"), after=("ready",)),
+                Task("manifests", mark("manifests")),
+            ],
+            echo=echoes.append,
+        )
+    assert exc.value.returncode == 1  # the ORIGINAL CommandError, unwrapped
+    assert "ready" not in ran and "ansible" not in ran
+    # the independent branch still ran (it was submitted before the fault)
+    assert "manifests" in ran
+    assert any("skipped" in line for line in echoes)
+
+
+def test_in_flight_tasks_drain_no_orphans():
+    """A failure must not abandon running tasks: the slow branch finishes
+    (its side effects land) before the scheduler re-raises."""
+    slow_done = threading.Event()
+    gate = threading.Event()
+
+    def slow(results):
+        gate.wait(timeout=10)
+        slow_done.set()
+        return "finished"
+
+    def fail_fast(results):
+        gate.set()  # fail only once the slow task is certainly running
+        raise RuntimeError("boom")
+
+    with pytest.raises(RuntimeError, match="boom"):
+        quiet_dag(
+            [Task("slow", slow), Task("fail", fail_fast)], max_workers=2
+        )
+    assert slow_done.is_set()  # drained, not orphaned
+    assert threading.active_count() < 20  # pool threads were reaped
+
+
+def test_fault_in_one_branch_retries_per_classifier():
+    """PR-1 semantics under concurrency: a transient fault injected into
+    one DAG branch retries inside that branch (other branches never
+    notice); a fatal one aborts the DAG with dependents unstarted."""
+    plan = faults.load_fault_plan(
+        json.dumps([{"match": "probe-slice-1", "times": 2, "rc": 1,
+                     "output": "Error 429: Too Many Requests"}]),
+        echo=lambda line: None,
+    )
+    calls = []
+    lock = threading.Lock()
+
+    def fake_run(args, **kwargs):
+        with lock:
+            calls.append(" ".join(args))
+        return "ok"
+
+    policy = retry.RetryPolicy(max_attempts=4, base_delay=0.0, max_delay=0.0)
+    timer = PhaseTimer(out=io.StringIO())
+    runner = retry.retrying_runner(
+        plan.wrap(fake_run), policy, record=timer.note_retry,
+        sleep=lambda s: None, echo=lambda line: None,
+    )
+
+    def probe(i):
+        return lambda results: runner(["probe-slice-%d" % i])
+
+    tasks = [Task(f"probe-{i}", probe(i)) for i in range(4)]
+    run_dag(tasks, max_workers=4, timer=timer, echo=lambda line: None)
+    # branch 1 absorbed its two transients; every branch converged
+    assert sum(1 for c in calls if c == "probe-slice-1") == 1
+    assert len(plan.injected) == 2
+    assert {c for c in calls} == {f"probe-slice-{i}" for i in range(4)}
+
+    # fatal: branch aborts on first attempt, dependents never start
+    plan2 = faults.load_fault_plan(
+        json.dumps([{"match": "probe-slice-2", "times": 1, "rc": 1,
+                     "output": "PERMISSION_DENIED"}]),
+        echo=lambda line: None,
+    )
+    runner2 = retry.retrying_runner(
+        plan2.wrap(fake_run), policy,
+        sleep=lambda s: None, echo=lambda line: None,
+    )
+    ran_after = []
+    tasks2 = [
+        Task("probe-2", lambda r: runner2(["probe-slice-2"])),
+        Task("after-2", lambda r: ran_after.append(1), after=("probe-2",)),
+    ]
+    with pytest.raises(CommandError) as exc:
+        quiet_dag(tasks2)
+    assert "PERMISSION_DENIED" in exc.value.tail
+    assert len(plan2.injected) == 1  # one attempt: fatal means no retry
+    assert ran_after == []
+
+
+# ------------------------------------------------------- virtual-clock overlap
+
+
+def test_independent_tasks_overlap_on_virtual_clock():
+    clock = SimClock()
+
+    def sleeper(seconds):
+        def fn(results):
+            clock.begin()
+            clock.sleep(seconds)
+
+        return fn
+
+    timer = PhaseTimer(out=io.StringIO(), clock=clock.time, wall=clock.time)
+    run_dag(
+        [Task("a", sleeper(100)), Task("b", sleeper(40)),
+         Task("c", sleeper(30), after=("b",))],
+        max_workers=4, timer=timer,
+        on_submit=clock.launch, on_settled=clock.release,
+        echo=lambda line: None,
+    )
+    assert timer.durations == {"a": 100.0, "b": 40.0, "c": 30.0}
+    assert timer.total == 170.0
+    assert timer.wall == 100.0  # a covers b->c; makespan is max, not sum
+
+
+def test_simclock_stalls_loudly_when_pool_too_narrow():
+    clock = SimClock(stall_timeout=0.2)
+
+    def sleeper(results):
+        clock.begin()
+        clock.sleep(10)
+
+    with pytest.raises(SimClockStalled, match="pool narrower"):
+        run_dag(
+            [Task("a", sleeper), Task("b", sleeper)],
+            max_workers=1,  # b queues behind a -> launched slot never begins
+            on_submit=clock.launch, on_settled=clock.release,
+            echo=lambda line: None,
+        )
+
+
+# ------------------------------------------------------------ the benchmark
+
+
+@pytest.mark.perf
+def test_provision_benchmark_dag_beats_sequential():
+    """The acceptance number: on the simulated 4-slice cluster the DAG
+    pipeline is >= 1.5x faster than the strictly-sequential baseline,
+    the makespan equals the critical-path prediction exactly, and the
+    sequential baseline degenerates to the sum of phases."""
+    result = bench_provision.run_benchmark(num_slices=4)
+    assert result["value"] >= 1.5
+    assert result["dag_matches_critical_path"]
+    assert result["sequential"]["wall_s"] == pytest.approx(
+        result["sequential"]["work_s"]
+    )
+    # critical path runs terraform -> one slice's probes -> ansible
+    assert result["critical_path"][0] == "terraform-apply"
+    assert result["critical_path"][-1] == "host-configuration"
+
+
+@pytest.mark.perf
+def test_perf_smoke_critical_path_strictly_shorter_than_sum():
+    """Tier-1 guard: the DAG schedule must actually overlap work — its
+    critical path (== simulated makespan) is strictly shorter than the
+    sum of phase durations, for any slice count the CLI supports."""
+    for slices in (1, 2, 4):
+        result = bench_provision.run_benchmark(num_slices=slices)
+        assert result["dag"]["wall_s"] < result["dag"]["work_s"], slices
+        assert result["dag"]["wall_s"] < result["sequential"]["wall_s"]
+
+
+def test_benchmark_json_document(tmp_path, capsys):
+    out = tmp_path / "BENCH_provision.json"
+    assert bench_provision.main(["--slices", "2", "--out", str(out)]) == 0
+    doc = json.loads(out.read_text())
+    assert doc["benchmark"] == "provision_sim"
+    assert doc["num_slices"] == 2
+    assert doc["value"] > 1.0
+    assert "critical_path" in doc and doc["critical_path_s"] > 0
+    assert "speedup" in doc["metric"] or "wall" in doc["metric"]
+    assert "provision" in capsys.readouterr().out

@@ -55,12 +55,22 @@ def _gcloud_get(key: str, run: Runner) -> str:
 
 def discover(run: Runner = _default_runner) -> GcloudEnv:
     """Pull project/account/zone from gcloud config; empty fields mean
-    "unknown" and the wizard prompts for them instead."""
-    return GcloudEnv(
-        project=_gcloud_get("project", run),
-        account=_gcloud_get("account", run),
-        zone=_gcloud_get("compute/zone", run),
-    )
+    "unknown" and the wizard prompts for them instead.
+
+    The three lookups are independent gcloud invocations (~1 s of CLI
+    startup each), so they fan out concurrently — discovery costs one
+    gcloud round-trip, not three (the DAG-pipeline discipline applied to
+    the pre-wizard phase; docs/performance.md)."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    with ThreadPoolExecutor(
+        max_workers=3, thread_name_prefix="gcloud-discover"
+    ) as pool:
+        project, account, zone = pool.map(
+            lambda key: _gcloud_get(key, run),
+            ("project", "account", "compute/zone"),
+        )
+    return GcloudEnv(project=project, account=account, zone=zone)
 
 
 def require_credentials(env: GcloudEnv, run: Runner = _default_runner) -> None:
@@ -188,14 +198,19 @@ def list_tpu_zones(generation: str, run: Runner = _default_runner) -> list[str]:
     reference's `triton networks`/`triton packages` menus (setup.sh:257-259).
 
     `gcloud compute tpus accelerator-types list` is zone-scoped, so each
-    catalog zone is probed individually; any gcloud failure falls back to
-    the static catalog.
+    catalog zone is probed individually — but CONCURRENTLY: the probes
+    are independent read-only calls, and the wizard's zone menu should
+    cost one gcloud round-trip, not len(zones) of them. Any gcloud
+    failure falls back to the static catalog.
     """
+    from concurrent.futures import ThreadPoolExecutor
+
     from tritonk8ssupervisor_tpu.config import catalog
 
     spec = catalog.get_spec(generation)
-    live: list[str] = []
-    for zone in spec.zones:
+
+    def probe_zone(zone: str) -> bool | None:
+        """True/False: zone offers the generation; None: gcloud failed."""
         try:
             proc = run(
                 [
@@ -209,12 +224,22 @@ def list_tpu_zones(generation: str, run: Runner = _default_runner) -> list[str]:
                 ]
             )
         except (OSError, subprocess.SubprocessError):
-            return list(spec.zones)
+            return None
         if proc.returncode != 0:
-            return list(spec.zones)
+            return None
         # name format: projects/P/locations/ZONE/acceleratorTypes/TYPE
-        for line in proc.stdout.strip().splitlines():
-            if line.split("/")[-1].startswith(spec.type_prefix + "-"):
-                live.append(zone)
-                break
+        return any(
+            line.split("/")[-1].startswith(spec.type_prefix + "-")
+            for line in proc.stdout.strip().splitlines()
+        )
+
+    if not spec.zones:
+        return []
+    with ThreadPoolExecutor(
+        max_workers=min(8, len(spec.zones)), thread_name_prefix="gcloud-zones"
+    ) as pool:
+        verdicts = list(pool.map(probe_zone, spec.zones))
+    if any(v is None for v in verdicts):
+        return list(spec.zones)
+    live = [zone for zone, ok in zip(spec.zones, verdicts) if ok]
     return live or list(spec.zones)

@@ -1,10 +1,16 @@
 """Pipeline orchestrator — the rebuild of `main` (reference setup.sh:8-92).
 
-Same sequence as the reference (SURVEY.md §3.1): previous-run guard →
+Same phases as the reference (SURVEY.md §3.1): previous-run guard →
 environment discovery → wizard → human verification gate → persist config →
-terraform apply → host configuration (ansible) → readiness wait → success
-banner — plus what the reference lacked: every phase is timed
-(utils/phases.py), since wall-clock-to-ready is the north-star metric.
+then the provisioning phases — terraform apply, host configuration
+(ansible), readiness wait, manifest compilation, probe job. Unlike the
+reference's strict line, the provisioning phases run as a dependency DAG
+(provision/scheduler.py): compile-manifests needs only the config and
+rides along terraform-apply/readiness-wait; everything else keeps its
+ordering edges. Every phase is timed with overlap-aware spans
+(utils/phases.py), since wall-clock-to-ready is the north-star metric
+and the DAG's makespan — not the sum of phases — is that number. See
+docs/performance.md for the graph and how to read the runlog.
 
 `./setup.sh -c` dispatches to teardown (cleanRunner analogue,
 setup.sh:9-12, 484-521).
@@ -33,6 +39,7 @@ from tritonk8ssupervisor_tpu.provision import (
     teardown,
     terraform as terraform_mod,
 )
+from tritonk8ssupervisor_tpu.provision.scheduler import Task, run_dag
 from tritonk8ssupervisor_tpu.testing import faults
 from tritonk8ssupervisor_tpu.utils.phases import PhaseTimer
 
@@ -338,46 +345,92 @@ def provision(args, paths: state.RunPaths, prompter: Prompter) -> int:
     store.save_config_file(config, paths.config_file)
     store.export_to_env(config)
 
-    with timer.phase("terraform-apply"):
+    tasks = build_provision_dag(
+        args, config, paths, prompter,
+        run=run, run_quiet=run_quiet, ssh_key=ssh_key, ssh_user=ssh_user,
+    )
+    results = run_dag(tasks, max_workers=scheduler_workers(), timer=timer)
+
+    banner(config, results["terraform-apply"], results["compile-manifests"],
+           prompter)
+    timer.report()
+    return 0
+
+
+def scheduler_workers(environ: dict | None = None) -> int:
+    """Pool width for the provision DAG. 4 covers the widest graph today
+    (terraform + manifests overlapping, then probes fanned out inside
+    their task); TK8S_SCHED_WORKERS=1 degrades to the old strictly
+    sequential pipeline for debugging."""
+    env = os.environ if environ is None else environ
+    try:
+        return max(1, int(env.get("TK8S_SCHED_WORKERS", "4")))
+    except ValueError:
+        return 4
+
+
+def build_provision_dag(
+    args,
+    config: ClusterConfig,
+    paths: state.RunPaths,
+    prompter: Prompter,
+    run: run_mod.RunFn,
+    run_quiet: run_mod.RunFn,
+    ssh_key: Path | str = "",
+    ssh_user: str = "",
+) -> list[Task]:
+    """The provisioning phases as an explicit dependency graph.
+
+    Edges encode real data/order constraints and nothing else:
+
+    - readiness/host-configuration need terraform's hosts;
+    - tpu-vm mode: readiness comes BEFORE host configuration — ansible
+      needs live sshd on every host (TPU state READY + SSH banner; the
+      deterministic replacement for the reference's sleep-30 bootstrap,
+      terraform/master/main.tf:22). GKE keeps readiness after: the
+      gkejoin play itself fetches credentials, and node registration is
+      what the wait observes;
+    - compile-manifests needs only the config, so it overlaps the whole
+      cloud-facing pipeline (the DAG's free win);
+    - the probe Job needs a ready cluster.
+
+    Diagram + measured overlap numbers: docs/performance.md.
+    """
+
+    def do_terraform(results: dict) -> state.ClusterHosts:
         if terraform_mod.already_applied(config, paths):
             prompter.say("terraform state present; converging existing deployment")
-        hosts = terraform_mod.apply(config, paths, run=run, run_quiet=run_quiet)
+        return terraform_mod.apply(config, paths, run=run, run_quiet=run_quiet)
 
-    # tpu-vm mode: readiness comes BEFORE host configuration — ansible
-    # needs live sshd on every host (TPU state READY + SSH banner; the
-    # deterministic replacement for the reference's sleep-30 bootstrap,
-    # terraform/master/main.tf:22). GKE keeps readiness after: the gkejoin
-    # play itself fetches credentials, and node registration is what the
-    # wait observes.
-    if config.mode == "tpu-vm" and not args.skip_readiness:
-        with timer.phase("readiness-wait"):
-            # one shared budget for both polls — the user's timeout caps
-            # the whole phase, not each poll
-            poll_start = time.monotonic()
+    def do_readiness(results: dict) -> None:
+        if config.mode == "gke":
             wait_ready(config, args.readiness_timeout, run_quiet=run_quiet)
-            remaining = max(
-                0.0, args.readiness_timeout - (time.monotonic() - poll_start)
-            )
-            readiness.poll(
-                lambda: readiness.ssh_ready_probe(
-                    hosts.flat_ips, ssh_user=ssh_user, ssh_key=str(ssh_key),
-                    run_quiet=run_quiet,
-                ),
-                interval=5.0,
-                timeout=remaining,
-            )
+            return
+        # one shared budget for both polls — the user's timeout caps
+        # the whole phase, not each poll
+        hosts = results["terraform-apply"]
+        poll_start = time.monotonic()
+        wait_ready(config, args.readiness_timeout, run_quiet=run_quiet)
+        remaining = max(
+            0.0, args.readiness_timeout - (time.monotonic() - poll_start)
+        )
+        readiness.poll(
+            lambda: readiness.ssh_ready_probe(
+                hosts.flat_ips, ssh_user=ssh_user, ssh_key=str(ssh_key),
+                run_quiet=run_quiet,
+            ),
+            interval=5.0,
+            timeout=remaining,
+        )
 
-    with timer.phase("host-configuration"):
+    def do_ansible(results: dict) -> None:
         ansible_mod.write_runtime_configs(
-            config, hosts, paths, ssh_key=ssh_key, ansible_user=ssh_user
+            config, results["terraform-apply"], paths,
+            ssh_key=ssh_key, ansible_user=ssh_user,
         )
         ansible_mod.run_playbook(paths, run=run)
 
-    if config.mode == "gke" and not args.skip_readiness:
-        with timer.phase("readiness-wait"):
-            wait_ready(config, args.readiness_timeout, run_quiet=run_quiet)
-
-    with timer.phase("compile-manifests"):
+    def do_manifests(results: dict) -> list:
         job_kwargs = {"image": args.bench_image} if args.bench_image else {}
         if args.checkpoint_dir:
             job_kwargs["checkpoint_dir"] = args.checkpoint_dir
@@ -393,24 +446,46 @@ def provision(args, paths: state.RunPaths, prompter: Prompter) -> int:
             job_kwargs["workload_name"] = args.workload_name
         if args.independent_slices:
             job_kwargs["cross_slice"] = False
-        manifest_paths = compiler.write_manifests(
-            config, paths.manifests_dir, **job_kwargs
+        return compiler.write_manifests(config, paths.manifests_dir, **job_kwargs)
+
+    def do_probe(results: dict) -> None:
+        readiness.run_probe_job(
+            config,
+            paths.probe_dir,
+            run=run,
+            run_quiet=run_quiet,
+            timeout_seconds=args.readiness_timeout,
+            image=args.probe_image,
         )
 
-    if args.probe and config.mode == "gke":
-        with timer.phase("probe-job"):
-            readiness.run_probe_job(
-                config,
-                paths.probe_dir,
-                run=run,
-                run_quiet=run_quiet,
-                timeout_seconds=args.readiness_timeout,
-                image=args.probe_image,
+    tasks = [
+        Task("terraform-apply", do_terraform),
+        Task("compile-manifests", do_manifests),
+    ]
+    ready_gate = "terraform-apply"
+    if config.mode == "tpu-vm":
+        if not args.skip_readiness:
+            tasks.append(
+                Task("readiness-wait", do_readiness, after=("terraform-apply",))
             )
-
-    banner(config, hosts, manifest_paths, prompter)
-    timer.report()
-    return 0
+            ready_gate = "readiness-wait"
+        tasks.append(
+            Task("host-configuration", do_ansible, after=(ready_gate,))
+        )
+    else:
+        tasks.append(
+            Task("host-configuration", do_ansible, after=("terraform-apply",))
+        )
+        ready_gate = "host-configuration"
+        if not args.skip_readiness:
+            tasks.append(
+                Task("readiness-wait", do_readiness,
+                     after=("host-configuration",))
+            )
+            ready_gate = "readiness-wait"
+        if args.probe:
+            tasks.append(Task("probe-job", do_probe, after=(ready_gate,)))
+    return tasks
 
 
 def wait_ready(

@@ -5,4 +5,8 @@ The process-boundary layer — where the reference shelled out to
 (setup.sh:111-115), and `curl`/`ssh` readiness probing (setup.sh:59-85).
 Every runner takes an injectable subprocess function so the whole pipeline
 is testable with stub binaries (SURVEY.md §4: fake-cluster harness).
+
+scheduler.py executes these runners as a dependency DAG instead of the
+reference's straight line — independent phases overlap, probes fan out,
+and the runlog records the schedule (docs/performance.md).
 """

@@ -110,6 +110,7 @@ def ssh_ready_probe(
     ssh_key: str = "",
     run_quiet: run_mod.RunFn = run_mod.run_capture,
     connect_timeout: int = 5,
+    max_workers: int = 16,
 ) -> str:
     """Ready when `ssh <ip> true` succeeds on every host with the exact
     credentials ansible will use.
@@ -120,8 +121,14 @@ def ssh_ready_probe(
     READY" does not imply that (GCP propagates metadata SSH keys after
     boot). BatchMode fails instead of hanging on a password prompt;
     known_hosts stays untouched so teardown's scrub list remains accurate.
+
+    All hosts are probed CONCURRENTLY and the verdict names every unready
+    host: one straggler costs one ConnectTimeout, not N of them, and the
+    operator sees the whole unready set instead of rediscovering it one
+    poll cycle at a time.
     """
-    for ip in ips:
+
+    def probe_one(ip: str) -> str:
         args = [
             "ssh",
             "-o", "BatchMode=yes",
@@ -136,7 +143,22 @@ def ssh_ready_probe(
         try:
             run_quiet(args + [ip, "true"])
         except run_mod.CommandError as e:
-            return f"host {ip} ssh not ready (rc {e.returncode})"
+            return f"{ip} (rc {e.returncode})"
+        return ""
+
+    if not ips:
+        return ""
+    from concurrent.futures import ThreadPoolExecutor
+
+    with ThreadPoolExecutor(
+        max_workers=min(max_workers, len(ips)),
+        thread_name_prefix="ssh-probe",
+    ) as pool:
+        verdicts = list(pool.map(probe_one, ips))
+    unready = [v for v in verdicts if v]
+    if unready:
+        return (f"{len(unready)}/{len(ips)} host(s) ssh not ready: "
+                + ", ".join(unready))
     return ""
 
 
@@ -145,23 +167,41 @@ def tpu_vm_probe(
     slice_names: list[str],
     run_quiet: run_mod.RunFn = run_mod.run_capture,
 ) -> str:
-    """Ready when every slice's Cloud TPU state is READY."""
-    for name in slice_names:
-        raw = run_quiet(
-            [
-                "gcloud",
-                "compute",
-                "tpus",
-                "tpu-vm",
-                "describe",
-                name,
-                f"--zone={config.zone}",
-                "--format=value(state)",
-            ]
-        )
-        state = raw.strip()
-        if state != "READY":
-            return f"slice {name} is {state or 'UNKNOWN'}"
+    """Ready when every slice's Cloud TPU state is READY.
+
+    One `tpu-vm list` call covers every slice (instead of N per-slice
+    `describe` round-trips — at ~1 s of gcloud startup + API latency
+    each, that's the whole poll interval burned on a 16-slice pool), and
+    the verdict names every slice still in flight. A slice absent from
+    the listing reads CREATING: the QueuedResource has not materialised
+    a node yet, which is the normal early-boot state, not an error.
+    """
+    raw = run_quiet(
+        [
+            "gcloud",
+            "compute",
+            "tpus",
+            "tpu-vm",
+            "list",
+            f"--zone={config.zone}",
+            "--format=value(name,state)",
+        ]
+    )
+    states: dict[str, str] = {}
+    for line in raw.splitlines():
+        parts = line.split()
+        if not parts:
+            continue
+        # value() output is NAME<tab>STATE; a bare NAME means no state yet
+        name = parts[0].rsplit("/", 1)[-1]  # tolerate full resource paths
+        states[name] = parts[1] if len(parts) > 1 else "UNKNOWN"
+    unready = [
+        f"{name} is {states.get(name) or 'CREATING'}"
+        for name in slice_names
+        if states.get(name) != "READY"
+    ]
+    if unready:
+        return f"slice(s) not ready: {', '.join(unready)}"
     return ""
 
 

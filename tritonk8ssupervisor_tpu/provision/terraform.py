@@ -15,6 +15,7 @@ terraform/hosts.json — the masters.ip/hosts.ip analogue
 from __future__ import annotations
 
 import json
+import os
 import sys
 
 from tritonk8ssupervisor_tpu.config import compile as compiler
@@ -68,13 +69,40 @@ def precheck(config: ClusterConfig, paths: RunPaths) -> None:
         )
 
 
+def terraform_env(paths: RunPaths, environ: dict | None = None) -> dict:
+    """Child environment for terraform commands: TF_PLUGIN_CACHE_DIR
+    pinned to a shared cache under terraform/ so the google provider
+    (~100 MB) downloads ONCE per checkout instead of once per module per
+    re-run — a full network round-trip shaved off every converge. An
+    operator's own TF_PLUGIN_CACHE_DIR wins."""
+    env = dict(os.environ if environ is None else environ)
+    if not env.get("TF_PLUGIN_CACHE_DIR"):
+        cache = paths.terraform_dir / ".plugin-cache"
+        try:
+            cache.mkdir(parents=True, exist_ok=True)
+        except OSError:
+            return env  # unwritable checkout: terraform caches per-module
+        env["TF_PLUGIN_CACHE_DIR"] = str(cache)
+    return env
+
+
+def init_needed(config: ClusterConfig, paths: RunPaths) -> bool:
+    """`terraform init` is only needed until the module's .terraform/
+    (providers + lock) exists; after that, re-running init is a network
+    round-trip that adds nothing to a converge. Provider upgrades are an
+    explicit operator action (`terraform init -upgrade`), not something
+    every provision run should re-negotiate."""
+    module_dir = paths.terraform_module(config.mode)
+    return not (module_dir / ".terraform").is_dir()
+
+
 def apply(
     config: ClusterConfig,
     paths: RunPaths,
     run: run_mod.RunFn = run_mod.run_streaming,
     run_quiet: run_mod.RunFn = run_mod.run_capture,
 ) -> ClusterHosts:
-    """terraform init + apply, then persist endpoints.
+    """terraform init (first run only) + apply, then persist endpoints.
 
     `terraform get && terraform apply` analogue (setup.sh:154-158); output
     collection replaces the reference's local-exec IP appending.
@@ -82,10 +110,17 @@ def apply(
     module_dir = paths.terraform_module(config.mode)
     precheck(config, paths)
     compiler.write_tfvars(config, paths.terraform_dir)
-    run(["terraform", "init", "-input=false", "-no-color"], cwd=module_dir)
+    env = terraform_env(paths)
+    if init_needed(config, paths):
+        run(["terraform", "init", "-input=false", "-no-color"],
+            cwd=module_dir, env=env)
+    else:
+        print(f"terraform module {config.mode} already initialized; "
+              "skipping init", flush=True)
     run(
         ["terraform", "apply", "-auto-approve", "-input=false", "-no-color"],
         cwd=module_dir,
+        env=env,
     )
     hosts = collect_outputs(config, paths, run_quiet)
     hosts.save(paths.hosts_file)

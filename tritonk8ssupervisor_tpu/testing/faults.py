@@ -38,6 +38,7 @@ import json
 import os
 import re
 import sys
+import threading
 import time
 from pathlib import Path
 from typing import Callable
@@ -100,6 +101,11 @@ class FaultPlan:
         self.sleep = sleep
         self.echo = echo
         self.injected: list[dict] = []  # what fired, for drills/asserts
+        # The DAG scheduler (provision/scheduler.py) drives wrapped
+        # runners from several worker threads at once; the Nth-match
+        # bookkeeping must stay atomic or "fail the 2nd terraform apply"
+        # becomes a race. One lock guards rule.seen and the ledger.
+        self._lock = threading.Lock()
 
     @classmethod
     def from_json(cls, text: str, **kwargs) -> "FaultPlan":
@@ -120,20 +126,32 @@ class FaultPlan:
         cli's composition so injected failures exercise exactly the
         classify/backoff path real ones take."""
 
+        def claim(line: str) -> tuple[FaultRule, int] | None:
+            """Atomically find the owning rule, advance its counter, and
+            decide whether this invocation fires. The slow parts (hang
+            sleeps, raising) happen OUTSIDE the lock so concurrent
+            unmatched commands never serialize behind an injected hang."""
+            with self._lock:
+                for rule in self.rules:
+                    if not re.search(rule.match, line):
+                        continue
+                    nth = rule.seen
+                    rule.seen += 1
+                    if not (rule.after <= nth < rule.after + rule.times):
+                        return None  # owns the call but lets it through
+                    self.injected.append(
+                        {"match": rule.match, "command": line, "nth": nth,
+                         "rc": 124 if rule.hang else rule.rc,
+                         "hang": rule.hang}
+                    )
+                    return rule, nth
+                return None
+
         def faulty(args, **kwargs) -> str:
             line = " ".join(str(a) for a in args)
-            for rule in self.rules:
-                if not re.search(rule.match, line):
-                    continue
-                nth = rule.seen
-                rule.seen += 1
-                if not (rule.after <= nth < rule.after + rule.times):
-                    break  # this rule owns the call but lets it through
-                self.injected.append(
-                    {"match": rule.match, "command": line, "nth": nth,
-                     "rc": 124 if rule.hang else rule.rc,
-                     "hang": rule.hang}
-                )
+            fired = claim(line)
+            if fired is not None:
+                rule, nth = fired
                 if rule.hang:
                     budget = kwargs.get("timeout") or rule.hang_seconds
                     self.echo(
