@@ -17,9 +17,18 @@ removes, and that the measured win equals the critical-path prediction
 exactly. The first real-quota run replaces the model with measured
 runlog spans (docs/performance.md).
 
+PR 3 adds the resilience drills (`--resilience`): the same simulated
+4-slice provision is SIGKILL'd mid-DAG (testing/faults.py `kill` rule)
+and resumed from the durable journal (provision/journal.py), reporting
+MTTR and the redo-work ratio (resume must redo < 30% of a cold run);
+then a single slice is lost and repaired via `heal` (provision/heal.py),
+asserting the scoped terraform replace addressed ONLY the lost slice and
+healthy slices' tfstate entries are byte-identical afterwards.
+
 Usage::
 
     python bench_provision.py [--slices 4] [--out BENCH_provision.json]
+    python bench_provision.py --resilience [--out BENCH_resilience.json]
 """
 
 from __future__ import annotations
@@ -27,8 +36,12 @@ from __future__ import annotations
 import argparse
 import io
 import json
+import shutil
 import sys
+import tempfile
+from pathlib import Path
 
+from tritonk8ssupervisor_tpu.provision import journal as journal_mod
 from tritonk8ssupervisor_tpu.provision.scheduler import (
     Task,
     critical_path,
@@ -158,18 +171,341 @@ def run_benchmark(num_slices: int = 4) -> dict:
     }
 
 
+# ------------------------------------------------------- resilience drills
+
+
+def build_journaled_tasks(
+    clock: SimClock,
+    num_slices: int,
+    workdir: Path,
+    executed: list,
+    plan=None,
+) -> tuple[list[Task], dict[str, float]]:
+    """The provision DAG shape with journal metadata: each task sleeps
+    its modeled duration on the virtual clock, then writes an artifact
+    file — so a resume has real inputs-hashes and on-disk digests to
+    verify, exactly like the live pipeline's tfstate/hosts.json. `plan`
+    is a FaultPlan consulted at task START (kill-at-task fires before
+    any virtual time elapses — the task dies with only its fsync'd
+    `running` record, the SIGKILL signature)."""
+    durations: dict[str, float] = {}
+    art_dir = workdir / "artifacts"
+
+    def sim(name: str, seconds: float, after: tuple = ()) -> Task:
+        durations[name] = seconds
+        artifact = art_dir / f"{name}.out"
+
+        def fn(results: dict) -> float:
+            clock.begin()
+            if plan is not None:
+                plan.fire(name)
+            clock.sleep(seconds)
+            executed.append(name)
+            art_dir.mkdir(parents=True, exist_ok=True)
+            artifact.write_text(f"{name}: {seconds}\n")
+            return seconds
+
+        return Task(
+            name, fn, after=after,
+            inputs_hash=journal_mod.inputs_hash(name, seconds),
+            artifacts=(artifact,),
+            restore=lambda results: durations[name],
+        )
+
+    tasks = [
+        sim("terraform-apply", SIM_SECONDS["terraform-apply"]),
+        sim("compile-manifests", SIM_SECONDS["compile-manifests"]),
+    ]
+    ssh_names = []
+    for i in range(num_slices):
+        tpu, ssh = f"tpu-state-slice-{i}", f"ssh-ready-slice-{i}"
+        tasks.append(sim(tpu, SIM_SECONDS["tpu-state-slice"],
+                         after=("terraform-apply",)))
+        tasks.append(sim(ssh, SIM_SECONDS["ssh-ready-slice"], after=(tpu,)))
+        ssh_names.append(ssh)
+    tasks.append(sim("host-configuration",
+                     SIM_SECONDS["host-configuration"],
+                     after=tuple(ssh_names)))
+    return tasks, durations
+
+
+def _journaled_run(num_slices: int, workdir: Path, plan=None) -> dict:
+    """One DAG execution against the journal at `workdir`: returns the
+    executed task list, wall-clock makespan, and the raised kill (if
+    any) — the shared leg of the crash-resume drill."""
+    from tritonk8ssupervisor_tpu.testing.faults import SupervisorKilled
+
+    clock = SimClock()
+    executed: list = []
+    tasks, durations = build_journaled_tasks(
+        clock, num_slices, workdir, executed, plan=plan
+    )
+    timer = PhaseTimer(out=io.StringIO(), clock=clock.time, wall=clock.time)
+    journal = journal_mod.Journal(
+        workdir / "journal.jsonl", echo=lambda line: None
+    )
+    killed = False
+    with journal:
+        try:
+            run_dag(
+                tasks,
+                max_workers=2 * num_slices + 2,
+                timer=timer,
+                journal=journal,
+                on_submit=clock.launch,
+                on_settled=clock.release,
+                echo=lambda line: None,
+            )
+        except SupervisorKilled:
+            killed = True
+    return {"executed": executed, "wall_s": timer.wall,
+            "durations": durations, "killed": killed}
+
+
+def run_crash_resume_drill(
+    num_slices: int = 4,
+    kill_at: str = "ssh-ready-slice-1",
+    workdir: Path | None = None,
+) -> dict:
+    """SIGKILL the supervisor mid-DAG, resume from the journal, and
+    measure the redo: the resume must execute strictly fewer tasks than
+    a cold run and redo < 30% of the cold run's task-seconds."""
+    from tritonk8ssupervisor_tpu.testing.faults import FaultPlan, FaultRule
+
+    own_tmp = workdir is None
+    root = Path(workdir) if workdir is not None else Path(
+        tempfile.mkdtemp(prefix="tk8s-crash-drill-")
+    )
+    try:
+        cold = _journaled_run(num_slices, root / "cold")
+        cold_work = sum(cold["durations"][t] for t in cold["executed"])
+
+        crash_dir = root / "crash"
+        plan = FaultPlan(
+            [FaultRule(match=f"^{kill_at}$", kill=True)],
+            echo=lambda line: None,
+        )
+        crashed = _journaled_run(num_slices, crash_dir, plan=plan)
+        assert crashed["killed"], "kill-at-task fault did not fire"
+
+        resumed = _journaled_run(num_slices, crash_dir)
+        redo_work = sum(resumed["durations"][t] for t in resumed["executed"])
+        return {
+            "kill_at": kill_at,
+            "cold_tasks": len(cold["executed"]),
+            "cold_work_s": cold_work,
+            "cold_wall_s": cold["wall_s"],
+            "tasks_done_before_kill": len(crashed["executed"]),
+            "resumed_tasks": len(resumed["executed"]),
+            "resumed_task_names": sorted(resumed["executed"]),
+            "redo_work_s": redo_work,
+            "mttr_wall_s": resumed["wall_s"],
+            "redo_ratio": round(redo_work / cold_work, 4),
+            "resume_beats_cold": resumed["wall_s"] < cold["wall_s"],
+        }
+    finally:
+        if own_tmp:
+            shutil.rmtree(root, ignore_errors=True)
+
+
+class _Say:
+    """Minimal prompter for the drills: collect say() lines."""
+
+    def __init__(self):
+        self.lines: list = []
+
+    def say(self, text: str = "") -> None:
+        self.lines.append(text)
+
+
+def run_slice_loss_drill(
+    num_slices: int = 4,
+    lost_slice: int = 2,
+    workdir: Path | None = None,
+) -> dict:
+    """Lose one slice, repair it through the REAL heal path
+    (provision/heal.py -> terraform -replace -> ansible --limit ->
+    scoped readiness) against scripted runners, and verify the healthy
+    slices' tfstate entries come out byte-identical."""
+    from tritonk8ssupervisor_tpu.config.schema import ClusterConfig
+    from tritonk8ssupervisor_tpu.provision import heal as heal_mod
+    from tritonk8ssupervisor_tpu.provision.state import ClusterHosts, RunPaths
+
+    own_tmp = workdir is None
+    root = Path(workdir) if workdir is not None else Path(
+        tempfile.mkdtemp(prefix="tk8s-heal-drill-")
+    )
+    try:
+        paths = RunPaths(root)
+        paths.terraform_module("tpu-vm").mkdir(parents=True, exist_ok=True)
+        config = ClusterConfig(
+            project="sim-proj", zone="us-west4-a", generation="v5e",
+            topology="4x4", mode="tpu-vm", num_slices=num_slices,
+        )
+        host_ips = [[f"10.0.{i}.1"] for i in range(num_slices)]
+        internal = [[f"10.1.{i}.1"] for i in range(num_slices)]
+        # one tfstate, one entry per slice — what -replace must scope over
+        tfstate = {"resources": [
+            {"type": "google_tpu_v2_vm", "name": "slice", "index": i,
+             "ip": host_ips[i][0], "generation": 0}
+            for i in range(num_slices)
+        ]}
+        paths.tfstate("tpu-vm").write_text(json.dumps(tfstate, indent=2))
+        hosts = ClusterHosts(host_ips=[list(s) for s in host_ips],
+                             internal_ips=[list(s) for s in internal],
+                             coordinator_ip=internal[0][0])
+        # the loss: slice's hosts vanish from the record (maintenance ate
+        # the node / terraform state drifted)
+        hosts.host_ips[lost_slice] = []
+        hosts.internal_ips[lost_slice] = []
+        hosts.save(paths.hosts_file)
+
+        healthy_before = {
+            r["index"]: json.dumps(r, sort_keys=True)
+            for r in tfstate["resources"] if r["index"] != lost_slice
+        }
+        new_ip = f"10.9.{lost_slice}.1"
+        calls: list = []
+
+        def run(args, cwd=None, **kwargs):
+            line = " ".join(str(a) for a in args)
+            calls.append(line)
+            if args[:2] == ["terraform", "apply"]:
+                st = json.loads(paths.tfstate("tpu-vm").read_text())
+                for a in args:
+                    if str(a).startswith("-replace="):
+                        idx = int(str(a).split("[")[1].rstrip("]"))
+                        for r in st["resources"]:
+                            if r["index"] == idx:
+                                r["ip"] = new_ip
+                                r["generation"] += 1
+                paths.tfstate("tpu-vm").write_text(json.dumps(st, indent=2))
+            return ""
+
+        def run_quiet(args, cwd=None, **kwargs):
+            line = " ".join(str(a) for a in args)
+            calls.append(line)
+            if args[:3] == ["terraform", "output", "-json"]:
+                st = json.loads(paths.tfstate("tpu-vm").read_text())
+                by_index = {r["index"]: r for r in st["resources"]}
+                return json.dumps({
+                    "host_ips": {"value": [
+                        [by_index[i]["ip"]] for i in range(num_slices)
+                    ]},
+                    "internal_ips": {"value": [list(s) for s in internal]},
+                })
+            if args and args[0] == "gcloud":
+                return "\n".join(
+                    f"{config.node_prefix}-{i}\tREADY"
+                    for i in range(num_slices)
+                )
+            return ""  # ssh probes / drain checks: reachable, no drain
+
+        prompter = _Say()
+        heal_mod.heal(
+            config, paths, prompter, run=run, run_quiet=run_quiet,
+            readiness_timeout=30.0, sleep=lambda s: None,
+        )
+
+        st_after = json.loads(paths.tfstate("tpu-vm").read_text())
+        healthy_after = {
+            r["index"]: json.dumps(r, sort_keys=True)
+            for r in st_after["resources"] if r["index"] != lost_slice
+        }
+        lost_after = next(r for r in st_after["resources"]
+                          if r["index"] == lost_slice)
+        hosts_after = ClusterHosts.load(paths.hosts_file)
+        replace_args = sorted(
+            a for line in calls if line.startswith("terraform apply")
+            for a in line.split() if a.startswith("-replace=")
+        )
+        limit_used = any("--limit" in line and new_ip in line
+                         for line in calls if "ansible" in line)
+        # modeled MTTR: the heal redoes one slice's provision chain while
+        # a cold redeploy pays the full DAG critical path
+        heal_model_s = (SIM_SECONDS["tpu-state-slice"]
+                        + SIM_SECONDS["ssh-ready-slice"]
+                        + SIM_SECONDS["host-configuration"])
+        cold_model_s = (SIM_SECONDS["terraform-apply"]
+                        + SIM_SECONDS["tpu-state-slice"]
+                        + SIM_SECONDS["ssh-ready-slice"]
+                        + SIM_SECONDS["host-configuration"])
+        return {
+            "lost_slice": lost_slice,
+            "replace_args": replace_args,
+            "scoped_to_lost_slice_only": replace_args == [
+                f"-replace=google_tpu_v2_vm.slice[{lost_slice}]"
+            ],
+            "healthy_tfstate_untouched": healthy_before == healthy_after,
+            "lost_slice_recreated": lost_after["generation"] == 1
+            and lost_after["ip"] == new_ip,
+            "hosts_rewritten": hosts_after.host_ips[lost_slice] == [new_ip],
+            "ansible_limited_to_healed_hosts": limit_used,
+            "heal_model_s": heal_model_s,
+            "cold_redeploy_model_s": cold_model_s,
+            "mttr_ratio": round(heal_model_s / cold_model_s, 4),
+        }
+    finally:
+        if own_tmp:
+            shutil.rmtree(root, ignore_errors=True)
+
+
+def run_resilience_benchmark(num_slices: int = 4) -> dict:
+    """The PR-3 acceptance datapoint: crash-resume + slice-loss drills,
+    one BENCH-style JSON document."""
+    crash = run_crash_resume_drill(num_slices)
+    loss = run_slice_loss_drill(num_slices)
+    return {
+        "benchmark": "provision_resilience",
+        "metric": "crash_resume_redo_ratio",
+        "unit": "fraction of cold-run task seconds redone after a "
+                "mid-DAG SIGKILL (target < 0.30)",
+        "num_slices": num_slices,
+        "model_seconds": dict(SIM_SECONDS),
+        "value": crash["redo_ratio"],
+        "crash_resume": crash,
+        "slice_loss": loss,
+        "passes": bool(
+            crash["redo_ratio"] < 0.30
+            and crash["resumed_tasks"] < crash["cold_tasks"]
+            and loss["scoped_to_lost_slice_only"]
+            and loss["healthy_tfstate_untouched"]
+        ),
+    }
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--slices", type=int, default=4)
+    parser.add_argument("--resilience", action="store_true",
+                        help="run the crash-resume + slice-loss drills "
+                        "instead of the sequential-vs-DAG comparison")
     parser.add_argument("--out", default=None, metavar="FILE",
                         help="also write the JSON document to FILE")
     args = parser.parse_args(argv)
-    result = run_benchmark(args.slices)
+    if args.resilience:
+        result = run_resilience_benchmark(args.slices)
+    else:
+        result = run_benchmark(args.slices)
     doc = json.dumps(result, indent=2, sort_keys=True)
     print(doc)
     if args.out:
         with open(args.out, "w") as f:
             f.write(doc + "\n")
+    if args.resilience:
+        crash = result["crash_resume"]
+        print(
+            f"\n{args.slices}-slice resilience (simulated): SIGKILL at "
+            f"{crash['kill_at']} -> resume redid "
+            f"{crash['resumed_tasks']}/{crash['cold_tasks']} tasks "
+            f"({crash['redo_ratio']:.1%} of cold work, MTTR "
+            f"{crash['mttr_wall_s']:.0f}s); slice-loss heal scoped="
+            f"{result['slice_loss']['scoped_to_lost_slice_only']} "
+            f"healthy-untouched="
+            f"{result['slice_loss']['healthy_tfstate_untouched']}",
+            file=sys.stderr,
+        )
+        return 0 if result["passes"] else 1
     print(
         f"\n{args.slices}-slice provision (simulated): "
         f"sequential {result['sequential']['wall_s']:.0f}s -> "

@@ -84,8 +84,13 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
-        "chaos: fault-injection drills exercising real sleeps/timeouts; "
-        "skipped unless --chaos",
+        "chaos: fault-injection drills exercising real sleeps/timeouts "
+        "or full-CLI crash scenarios — e.g. the kill-resume drill "
+        "(supervisor SIGKILL'd mid-provision via a `kill` fault rule, "
+        "then resumed from the durable journal); skipped unless --chaos. "
+        "Tier-1 keeps a FAST resume smoke instead: "
+        "tests/test_journal.py::test_resume_after_simulated_crash_"
+        "executes_fewer_tasks runs the same drill on the virtual clock.",
     )
     config.addinivalue_line(
         "markers",

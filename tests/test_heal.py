@@ -1,0 +1,298 @@
+"""Slice-granular fleet health + repair (provision/heal.py): diagnosis
+verdicts, scoped terraform/ansible/readiness repair, quarantine records,
+and the --max-degraded N-of-M policy."""
+
+import json
+
+import pytest
+
+from tritonk8ssupervisor_tpu.config.schema import ClusterConfig, ConfigError
+from tritonk8ssupervisor_tpu.provision import heal as heal_mod
+from tritonk8ssupervisor_tpu.provision import readiness
+from tritonk8ssupervisor_tpu.provision import runner as run_mod
+from tritonk8ssupervisor_tpu.provision.state import ClusterHosts, RunPaths
+
+
+def cfg(**overrides):
+    base = dict(project="my-proj", zone="us-west4-a", generation="v5e",
+                topology="4x4", mode="tpu-vm", num_slices=3)
+    base.update(overrides)
+    return ClusterConfig(**base)
+
+
+class Say:
+    def __init__(self):
+        self.lines = []
+
+    def say(self, text=""):
+        self.lines.append(text)
+
+    def text(self):
+        return "\n".join(self.lines)
+
+
+def seed_world(tmp_path, num_slices=3):
+    paths = RunPaths(tmp_path)
+    paths.terraform_module("tpu-vm").mkdir(parents=True)
+    hosts = ClusterHosts(
+        host_ips=[[f"10.0.{i}.1"] for i in range(num_slices)],
+        internal_ips=[[f"10.1.{i}.1"] for i in range(num_slices)],
+        coordinator_ip="10.1.0.1",
+    )
+    hosts.save(paths.hosts_file)
+    paths.tfstate("tpu-vm").write_text(json.dumps(
+        {"resources": [{"index": i} for i in range(num_slices)]}
+    ))
+    return paths, hosts
+
+
+def scripted_quiet(listing=None, ssh_fail=(), drains=None):
+    """run_quiet fake: gcloud listing, per-IP ssh verdicts, drain files."""
+    listing = listing if listing is not None else {
+        f"tpunode-{i}": "READY" for i in range(3)
+    }
+    drains = drains or {}
+
+    def run_quiet(args, cwd=None, **kwargs):
+        if args and args[0] == "gcloud":
+            return "\n".join(f"{n}\t{s}" for n, s in listing.items())
+        if args and args[0] == "ssh":
+            ip = args[-2]
+            if "cat" in args[-1]:
+                return drains.get(ip, "")
+            if ip in ssh_fail:
+                raise run_mod.CommandError(args, 255)
+            return ""
+        return ""
+
+    return run_quiet
+
+
+# --------------------------------------------------------------- diagnosis
+
+
+def test_diagnose_healthy_fleet(tmp_path):
+    paths, _ = seed_world(tmp_path)
+    health = heal_mod.diagnose(cfg(), paths, run_quiet=scripted_quiet())
+    assert [s.state for s in health.slices] == ["healthy"] * 3
+    assert health.degraded == []
+
+
+def test_diagnose_missing_unready_draining(tmp_path):
+    paths, hosts = seed_world(tmp_path)
+    hosts.host_ips[0] = []  # slice 0: record lost
+    hosts.save(paths.hosts_file)
+    quiet = scripted_quiet(
+        ssh_fail={"10.0.1.1"},  # slice 1: host refuses ssh
+        drains={"10.0.2.1": "maintenance-event: TERMINATE"},  # slice 2
+    )
+    health = heal_mod.diagnose(cfg(), paths, run_quiet=quiet)
+    assert [s.state for s in health.slices] == [
+        "missing", "unready", "draining"
+    ]
+    assert "no hosts recorded" in health.slices[0].detail
+    assert "10.0.1.1" in health.slices[1].detail
+    assert "TERMINATE" in health.slices[2].detail
+    assert health.degraded == [0, 1, 2]
+
+
+def test_diagnose_absent_from_listing_and_stuck_state(tmp_path):
+    paths, _ = seed_world(tmp_path)
+    quiet = scripted_quiet(listing={
+        "tpunode-0": "READY",
+        "tpunode-1": "PREEMPTED",
+        # tpunode-2 absent: the node was deleted under us
+    })
+    health = heal_mod.diagnose(cfg(), paths, run_quiet=quiet)
+    assert health.slices[0].state == "healthy"
+    assert health.slices[1].state == "unready"
+    assert "PREEMPTED" in health.slices[1].detail
+    assert health.slices[2].state == "missing"
+    assert "Cloud TPU listing" in health.slices[2].detail
+
+
+def test_diagnose_with_no_hosts_record_marks_all_missing(tmp_path):
+    paths = RunPaths(tmp_path)
+    paths.terraform_module("tpu-vm").mkdir(parents=True)
+    health = heal_mod.diagnose(cfg(), paths, run_quiet=scripted_quiet())
+    assert [s.state for s in health.slices] == ["missing"] * 3
+
+
+# ------------------------------------------------------------------- heal
+
+
+class HealWorld:
+    """Scripted run/run_quiet pair for the repair path: terraform output
+    reflects the replaced slice's new IP; ssh readiness per IP."""
+
+    def __init__(self, paths, num_slices=3, new_ip="10.9.9.9",
+                 still_bad_ips=()):
+        self.paths = paths
+        self.num_slices = num_slices
+        self.new_ip = new_ip
+        self.replaced: list = []
+        self.calls: list = []
+        self.still_bad_ips = set(still_bad_ips)
+
+    def run(self, args, cwd=None, **kwargs):
+        line = " ".join(str(a) for a in args)
+        self.calls.append(line)
+        for a in args:
+            if str(a).startswith("-replace="):
+                self.replaced.append(int(str(a).split("[")[1].rstrip("]")))
+        return ""
+
+    def run_quiet(self, args, cwd=None, **kwargs):
+        line = " ".join(str(a) for a in args)
+        self.calls.append(line)
+        if args[:3] == ["terraform", "output", "-json"]:
+            ips = [[f"10.0.{i}.1"] for i in range(self.num_slices)]
+            for i in self.replaced:
+                ips[i] = [self.new_ip]
+            return json.dumps({
+                "host_ips": {"value": ips},
+                "internal_ips": {"value": [
+                    [f"10.1.{i}.1"] for i in range(self.num_slices)
+                ]},
+            })
+        if args and args[0] == "gcloud":
+            return "\n".join(f"tpunode-{i}\tREADY"
+                             for i in range(self.num_slices))
+        if args and args[0] == "ssh":
+            ip = args[-2]
+            if "cat" in args[-1]:
+                return ""
+            if ip in self.still_bad_ips:
+                raise run_mod.CommandError(args, 255)
+            return ""
+        return ""
+
+
+def test_heal_repairs_only_the_broken_slice(tmp_path):
+    paths, hosts = seed_world(tmp_path)
+    hosts.host_ips[1] = []  # slice 1 lost
+    hosts.internal_ips[1] = []
+    hosts.save(paths.hosts_file)
+    world = HealWorld(paths)
+    say = Say()
+    assert heal_mod.heal(
+        cfg(), paths, say, run=world.run, run_quiet=world.run_quiet,
+        readiness_timeout=10.0, sleep=lambda s: None,
+    ) is True
+    # terraform scoped to slice 1 only
+    applies = [c for c in world.calls if c.startswith("terraform apply")]
+    assert len(applies) == 1
+    assert "-replace=google_tpu_v2_vm.slice[1]" in applies[0]
+    assert "slice[0]" not in applies[0] and "slice[2]" not in applies[0]
+    # ansible limited to the healed host
+    play = next(c for c in world.calls if c.startswith("ansible-playbook"))
+    assert f"--limit {world.new_ip}" in play
+    # hosts.json rewritten with the replacement IP, healthy slices intact
+    after = ClusterHosts.load(paths.hosts_file)
+    assert after.host_ips == [["10.0.0.1"], ["10.9.9.9"], ["10.0.2.1"]]
+    # fully healed: quarantine entries cleared again
+    q = json.loads(paths.quarantine_file.read_text())
+    assert q["slices"] == {}
+    assert "fleet fully healthy" in say.text().lower()
+
+
+def test_heal_healthy_fleet_is_a_noop(tmp_path):
+    paths, _ = seed_world(tmp_path)
+    world = HealWorld(paths)
+    say = Say()
+    assert heal_mod.heal(cfg(), paths, say, run=world.run,
+                         run_quiet=world.run_quiet) is True
+    assert not any(c.startswith("terraform apply") for c in world.calls)
+    assert "nothing to heal" in say.text().lower()
+
+
+def test_heal_max_degraded_n_of_m(tmp_path):
+    """A slice that stays broken after repair: with --max-degraded 1 the
+    heal SUCCEEDS degraded — the slice is emptied from hosts.json and
+    recorded as degraded in quarantine.json; with the default budget of
+    0 the readiness timeout propagates."""
+    paths, hosts = seed_world(tmp_path)
+    hosts.host_ips[1] = []
+    hosts.internal_ips[1] = []
+    hosts.save(paths.hosts_file)
+    world = HealWorld(paths, still_bad_ips={"10.9.9.9"})
+    say = Say()
+    assert heal_mod.heal(
+        cfg(), paths, say, run=world.run, run_quiet=world.run_quiet,
+        max_degraded=1, readiness_timeout=0.0, sleep=lambda s: None,
+    ) is True
+    after = ClusterHosts.load(paths.hosts_file)
+    assert after.host_ips[1] == []  # out of service
+    assert after.host_ips[0] == ["10.0.0.1"]  # healthy untouched
+    q = json.loads(paths.quarantine_file.read_text())
+    assert q["slices"]["1"]["state"] == "degraded"
+    assert "2/3 slices" in say.text()
+
+    # same failure with no degradation budget: the timeout is the verdict
+    paths2, hosts2 = seed_world(tmp_path / "strict")
+    hosts2.host_ips[1] = []
+    hosts2.internal_ips[1] = []
+    hosts2.save(paths2.hosts_file)
+    world2 = HealWorld(paths2, still_bad_ips={"10.9.9.9"})
+    with pytest.raises(readiness.NotReadyError):
+        heal_mod.heal(
+            cfg(), paths2, Say(), run=world2.run,
+            run_quiet=world2.run_quiet,
+            max_degraded=0, readiness_timeout=0.0, sleep=lambda s: None,
+        )
+
+
+def test_heal_quarantine_survives_a_crashed_repair(tmp_path):
+    """The quarantine record is written BEFORE terraform runs, so a heal
+    that dies mid-apply leaves the evidence of what was condemned."""
+    paths, hosts = seed_world(tmp_path)
+    hosts.host_ips[2] = []
+    hosts.internal_ips[2] = []
+    hosts.save(paths.hosts_file)
+
+    def exploding_run(args, cwd=None, **kwargs):
+        if "apply" in args:
+            raise run_mod.CommandError(args, 1, tail="QUOTA_EXCEEDED")
+        return ""
+
+    world = HealWorld(paths)
+    with pytest.raises(run_mod.CommandError):
+        heal_mod.heal(cfg(), paths, Say(), run=exploding_run,
+                      run_quiet=world.run_quiet)
+    q = json.loads(paths.quarantine_file.read_text())
+    assert q["slices"]["2"]["state"] == "missing"
+
+
+def test_heal_rejects_gke_mode(tmp_path):
+    paths = RunPaths(tmp_path)
+    with pytest.raises(ConfigError, match="self-repair"):
+        heal_mod.heal(cfg(mode="gke", topology="2x2"), paths, Say())
+
+
+def test_drain_verdicts_unreachable_host_is_not_draining():
+    def quiet(args, cwd=None, **kwargs):
+        raise run_mod.CommandError(args, 255)
+
+    assert heal_mod.drain_verdicts([["10.0.0.1"]], run_quiet=quiet) == {}
+
+
+def test_record_quarantine_merge_and_clear(tmp_path):
+    paths = RunPaths(tmp_path)
+    paths.terraform_dir.mkdir()
+    heal_mod.record_quarantine(
+        paths, {1: {"state": "unready", "detail": "x", "hosts": []}}
+    )
+    heal_mod.record_quarantine(
+        paths, {2: {"state": "missing", "detail": "y", "hosts": []}}
+    )
+    q = json.loads(paths.quarantine_file.read_text())
+    assert set(q["slices"]) == {"1", "2"}
+    heal_mod.record_quarantine(paths, {1: None})
+    q = json.loads(paths.quarantine_file.read_text())
+    assert set(q["slices"]) == {"2"}
+    # a torn quarantine file is rewritten whole, never a crash
+    paths.quarantine_file.write_text('{"slices": {"2": trunc')
+    heal_mod.record_quarantine(paths, {3: {"state": "draining",
+                                           "detail": "", "hosts": []}})
+    q = json.loads(paths.quarantine_file.read_text())
+    assert set(q["slices"]) == {"3"}

@@ -1,0 +1,319 @@
+"""Durable run journal + crash-safe DAG resume (provision/journal.py):
+replay invariants, torn-write truncation, lockfile exclusion, and the
+scheduler's verified-skip semantics — the PR-3 tentpole's contract that a
+SIGKILL'd supervisor resumes the dirty suffix instead of starting over."""
+
+import json
+import os
+import threading
+
+import pytest
+
+import bench_provision
+from tritonk8ssupervisor_tpu.provision import journal as journal_mod
+from tritonk8ssupervisor_tpu.provision.journal import (
+    Journal,
+    JournalError,
+    JournalLockedError,
+    digest_path,
+    inputs_hash,
+)
+from tritonk8ssupervisor_tpu.provision.scheduler import Task, run_dag
+from tritonk8ssupervisor_tpu.testing import faults
+
+
+def quiet_journal(tmp_path, name="journal.jsonl"):
+    return Journal(tmp_path / name, echo=lambda line: None)
+
+
+def quiet_dag(tasks, **kwargs):
+    kwargs.setdefault("echo", lambda line: None)
+    return run_dag(tasks, **kwargs)
+
+
+# ------------------------------------------------------------ hashing bits
+
+
+def test_inputs_hash_stable_and_sensitive():
+    a = inputs_hash("terraform", {"zone": "us-west4-a", "num_slices": 4})
+    b = inputs_hash("terraform", {"num_slices": 4, "zone": "us-west4-a"})
+    assert a == b  # dict ordering cannot fake a change
+    assert a != inputs_hash("terraform", {"zone": "us-west4-a",
+                                          "num_slices": 8})
+
+
+def test_digest_path_file_dir_missing(tmp_path):
+    f = tmp_path / "x.json"
+    f.write_text("{}")
+    d1 = digest_path(f)
+    f.write_text('{"changed": 1}')
+    assert digest_path(f) != d1
+    assert digest_path(tmp_path / "ghost") is None
+    sub = tmp_path / "manifests"
+    sub.mkdir()
+    (sub / "a.yaml").write_text("a: 1\n")
+    dir1 = digest_path(sub)
+    (sub / "b.yaml").write_text("b: 2\n")
+    assert digest_path(sub) != dir1  # new file in the dir dirties it
+
+
+# ------------------------------------------------------- append + replay
+
+
+def test_replay_last_transition_wins_with_attempt_history(tmp_path):
+    j = quiet_journal(tmp_path)
+    j.note_running("tf", "h1", attempt=1)
+    j.note_failed("tf", "h1", "Error 403")
+    j.note_running("tf", "h1", attempt=2)
+    j.note_done("tf", "h1")
+    ledgers = j.replay()
+    assert ledgers["tf"].status == "done"
+    assert ledgers["tf"].attempts == 2  # full history, not just last run
+    assert ledgers["tf"].errors == ["Error 403"]
+
+
+def test_torn_trailing_line_truncated_not_fatal(tmp_path):
+    """The one write a SIGKILL can interrupt is the LAST line; replay must
+    truncate it away (physically — later appends go after valid JSON) and
+    carry on. Corruption mid-file, with valid records after it, is a
+    different disease and raises."""
+    j = quiet_journal(tmp_path)
+    j.note_running("tf", "h1", attempt=1)
+    j.note_done("tf", "h1")
+    with j.path.open("a") as f:
+        f.write('{"v": 1, "task": "ansible", "status": "runn')  # torn
+    ledgers = j.replay()
+    assert ledgers["tf"].status == "done"
+    assert "ansible" not in ledgers
+    # physically truncated: the file ends with the last GOOD record
+    lines = j.path.read_text().splitlines()
+    assert len(lines) == 2 and json.loads(lines[-1])["status"] == "done"
+    # and appends after truncation produce a parseable ledger
+    j.note_running("ansible", "h2", attempt=1)
+    assert j.replay()["ansible"].status == "running"
+
+    # mid-file corruption with valid records after it is NOT a torn write
+    bad = quiet_journal(tmp_path, "corrupt.jsonl")
+    bad.note_done("tf", "h1")
+    raw = bad.path.read_text()
+    bad.path.write_text("GARBAGE\n" + raw)
+    with pytest.raises(JournalError, match="corrupt at line 1"):
+        bad.replay()
+
+
+def test_newer_schema_records_skipped(tmp_path):
+    j = quiet_journal(tmp_path)
+    j.note_done("tf", "h1")
+    with j.path.open("a") as f:
+        f.write(json.dumps({"v": journal_mod.SCHEMA_VERSION + 1,
+                            "task": "tf", "status": "exploded",
+                            "quantum": True}) + "\n")
+    ledgers = j.replay()  # the future's records are opaque, never fatal
+    assert ledgers["tf"].status == "done"
+
+
+def test_concurrent_writers_rejected_via_lockfile(tmp_path):
+    first = quiet_journal(tmp_path)
+    second = quiet_journal(tmp_path)
+    with first:
+        with pytest.raises(JournalLockedError, match="locked by live"):
+            second.acquire()
+    # lock released on exit: the second writer now gets in
+    with second:
+        pass
+
+
+def test_stale_lock_from_dead_pid_is_stolen(tmp_path):
+    j = quiet_journal(tmp_path)
+    # a pid that cannot exist on Linux (> pid_max default), i.e. a crashed
+    # supervisor's residue — exactly the case resume exists for
+    j.lock_path.write_text("99999999\n")
+    with j:
+        assert j.lock_path.read_text().strip() == str(os.getpid())
+
+
+# ------------------------------------------- verified-skip replay invariants
+
+
+def make_task(name, fn_log, tmp_path, seconds=1.0, after=(), fail=False):
+    artifact = tmp_path / "artifacts" / f"{name}.out"
+
+    def fn(results):
+        fn_log.append(name)
+        if fail:
+            raise RuntimeError(f"{name} exploded")
+        artifact.parent.mkdir(parents=True, exist_ok=True)
+        artifact.write_text(f"{name}\n")
+        return name
+
+    return Task(name, fn, after=after,
+                inputs_hash=inputs_hash(name, seconds),
+                artifacts=(artifact,),
+                restore=lambda results: f"{name} (restored)")
+
+
+def test_resume_skips_verified_prefix_and_restores_results(tmp_path):
+    ran: list = []
+    tasks = [
+        make_task("a", ran, tmp_path),
+        make_task("b", ran, tmp_path, after=("a",)),
+    ]
+    with quiet_journal(tmp_path) as j:
+        quiet_dag(tasks, journal=j)
+    assert ran == ["a", "b"]
+    ran.clear()
+    with quiet_journal(tmp_path) as j:
+        results = quiet_dag(tasks, journal=j)
+    assert ran == []  # everything verified; nothing re-ran
+    assert results == {"a": "a (restored)", "b": "b (restored)"}
+
+
+def test_done_task_with_mutated_inputs_hash_reruns(tmp_path):
+    ran: list = []
+    with quiet_journal(tmp_path) as j:
+        quiet_dag([make_task("a", ran, tmp_path, seconds=1.0)], journal=j)
+    ran.clear()
+    # same task name, different inputs: the recorded completion is stale
+    with quiet_journal(tmp_path) as j:
+        quiet_dag([make_task("a", ran, tmp_path, seconds=2.0)], journal=j)
+    assert ran == ["a"]
+
+
+def test_done_task_with_mutated_artifact_reruns_dirty_suffix(tmp_path):
+    """Artifact drift re-runs the task — and everything downstream of it,
+    even though downstream's own record still verifies (an upstream
+    re-run dirties the whole suffix)."""
+    ran: list = []
+    tasks = [
+        make_task("a", ran, tmp_path),
+        make_task("b", ran, tmp_path, after=("a",)),
+        make_task("c", ran, tmp_path, after=("b",)),
+    ]
+    with quiet_journal(tmp_path) as j:
+        quiet_dag(tasks, journal=j)
+    ran.clear()
+    (tmp_path / "artifacts" / "a.out").write_text("drifted by hand\n")
+    with quiet_journal(tmp_path) as j:
+        quiet_dag(tasks, journal=j)
+    assert ran == ["a", "b", "c"]
+
+
+def test_failed_task_reruns_with_attempt_history_preserved(tmp_path):
+    ran: list = []
+    with quiet_journal(tmp_path) as j:
+        with pytest.raises(RuntimeError, match="a exploded"):
+            quiet_dag([make_task("a", ran, tmp_path, fail=True)], journal=j)
+    with quiet_journal(tmp_path) as j:
+        quiet_dag([make_task("a", ran, tmp_path)], journal=j)
+        records = [json.loads(line)
+                   for line in j.path.read_text().splitlines()]
+    statuses = [(r["task"], r["status"]) for r in records]
+    assert ("a", "failed") in statuses
+    # the re-run's `running` record continues the attempt numbering
+    running = [r["attempt"] for r in records if r["status"] == "running"]
+    assert running == [1, 2]
+    assert j.replay()["a"].status == "done"
+    assert j.replay()["a"].attempts == 2
+
+
+def test_kill_leaves_running_record_and_no_failed_record(tmp_path):
+    """A simulated SIGKILL (BaseException) must write NOTHING on the way
+    out — the lingering `running` record IS the crash signature."""
+    plan = faults.FaultPlan(
+        [faults.FaultRule(match="^victim$", kill=True)],
+        echo=lambda line: None,
+    )
+    ran: list = []
+    task = make_task("victim", ran, tmp_path)
+
+    def killed_fn(results):
+        plan.fire("victim")
+
+    victim = Task("victim", killed_fn, inputs_hash=task.inputs_hash,
+                  artifacts=task.artifacts)
+    with quiet_journal(tmp_path) as j:
+        with pytest.raises(faults.SupervisorKilled):
+            quiet_dag([victim], journal=j)
+        statuses = [json.loads(line)["status"]
+                    for line in j.path.read_text().splitlines()]
+    assert statuses == ["running"]  # no failed/done — the process "died"
+    # resume re-runs it
+    ran.clear()
+    with quiet_journal(tmp_path) as j:
+        quiet_dag([task], journal=j)
+    assert ran == ["victim"]
+
+
+def test_task_without_inputs_hash_never_skips(tmp_path):
+    """Empty inputs_hash opts a task out of resume (the probe Job: an
+    acceptance test is only meaningful re-run)."""
+    ran: list = []
+
+    def fn(results):
+        ran.append("probe")
+
+    with quiet_journal(tmp_path) as j:
+        quiet_dag([Task("probe", fn)], journal=j)
+        quiet_dag([Task("probe", fn)], journal=j)
+    assert ran == ["probe", "probe"]
+
+
+# ----------------------------------------------------- tier-1 resume smoke
+
+
+def test_resume_after_simulated_crash_executes_fewer_tasks(tmp_path):
+    """The fast tier-1 smoke behind the chaos drill: on the 4-slice
+    simclock provision, a mid-DAG SIGKILL resume executes strictly fewer
+    tasks than the cold run and redoes < 30% of its task-seconds — the
+    PR-3 acceptance number, with MTTR beating the cold makespan."""
+    result = bench_provision.run_crash_resume_drill(
+        num_slices=4, workdir=tmp_path
+    )
+    assert result["resumed_tasks"] < result["cold_tasks"]
+    assert result["redo_ratio"] < 0.30
+    assert result["resume_beats_cold"]
+    assert result["mttr_wall_s"] < result["cold_wall_s"]
+
+
+def test_slice_loss_heals_without_touching_healthy_tfstate(tmp_path):
+    """PR-3 acceptance, second half: a single-slice loss heals through
+    the real heal path with terraform -replace scoped to the lost slice,
+    healthy slices' tfstate entries byte-identical, hosts.json rewritten."""
+    result = bench_provision.run_slice_loss_drill(
+        num_slices=4, lost_slice=2, workdir=tmp_path
+    )
+    assert result["scoped_to_lost_slice_only"]
+    assert result["healthy_tfstate_untouched"]
+    assert result["lost_slice_recreated"]
+    assert result["hosts_rewritten"]
+    assert result["ansible_limited_to_healed_hosts"]
+    assert result["mttr_ratio"] < 1.0  # heal beats a cold redeploy
+
+
+def test_resilience_benchmark_json_document(tmp_path, capsys):
+    out = tmp_path / "BENCH_resilience.json"
+    assert bench_provision.main(["--resilience", "--out", str(out)]) == 0
+    doc = json.loads(out.read_text())
+    assert doc["benchmark"] == "provision_resilience"
+    assert doc["passes"] is True
+    assert doc["value"] < 0.30
+    assert doc["crash_resume"]["resumed_tasks"] < doc["crash_resume"]["cold_tasks"]
+    assert doc["slice_loss"]["healthy_tfstate_untouched"]
+    assert "resilience" in capsys.readouterr().err
+
+
+# ----------------------------------------------------- journal concurrency
+
+
+def test_journal_appends_are_thread_safe(tmp_path):
+    j = quiet_journal(tmp_path)
+    threads = [
+        threading.Thread(target=j.note_running, args=(f"t{i}", "h", 1))
+        for i in range(16)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    ledgers = j.replay()  # every line parseable — no interleaved writes
+    assert len(ledgers) == 16

@@ -174,13 +174,111 @@ def test_provision_gke_mode(fake_world, capsys):
 
 
 def test_resume_detected_on_second_run(fake_world, capsys):
-    work, _ = fake_world
+    work, calls_log = fake_world
     config_path = saved_config(work)
     assert main(["--yes", "--config", str(config_path), "--workdir", str(work)]) == 0
     capsys.readouterr()
-    # second run without --config resumes from the saved config file
+    # second run without --config resumes from the saved config file —
+    # and the journal (provision/journal.py) verifies every recorded
+    # task's inputs-hash + artifacts, so NOTHING cloud-facing re-runs
     assert main(["--yes", "--workdir", str(work)]) == 0
-    assert "Previous run detected" in capsys.readouterr().out
+    captured = capsys.readouterr()
+    assert "Previous run detected" in captured.out
+    assert "journal-verified; skipping" in captured.err
+    calls = calls_log.read_text()
+    assert calls.count("terraform apply") == 1  # first run only
+    assert calls.count("ansible-playbook -i hosts clusterUp.yml") == 1
+    # the runlog records the skips (status=skipped, zero seconds)
+    records = [json.loads(l)
+               for l in RunPaths(work).runlog.read_text().splitlines()]
+    skipped = {r["phase"] for r in records if r.get("status") == "skipped"}
+    assert "terraform-apply" in skipped and "host-configuration" in skipped
+
+
+def test_second_run_after_config_change_redoes_dirty_suffix(fake_world, capsys):
+    """A changed config mutates the terraform inputs-hash, so the journal
+    must NOT skip — the stale completion re-runs (replay invariant at the
+    CLI level)."""
+    work, calls_log = fake_world
+    assert main(["--yes", "--config", str(saved_config(work)),
+                 "--workdir", str(work)]) == 0
+    second = saved_config(work, TOPOLOGY="2x4")
+    assert main(["--yes", "--config", str(second),
+                 "--workdir", str(work)]) == 0
+    assert calls_log.read_text().count("terraform apply") == 2
+
+
+@pytest.mark.chaos
+def test_kill_resume_drill_cli(fake_world, capsys):
+    """The full chaos drill at the CLI: a `kill` fault-plan rule SIGKILLs
+    (simulated) the supervisor at the ansible step; the re-run resumes
+    from the fsync'd journal — terraform/readiness are journal-verified
+    and skipped, only the dirty suffix (ansible) executes."""
+    from tritonk8ssupervisor_tpu.testing.faults import SupervisorKilled
+
+    work, calls_log = fake_world
+    plan = json.dumps([{"match": "ansible-playbook", "kill": True}])
+    with pytest.raises(SupervisorKilled):
+        main(["--yes", "--config", str(saved_config(work)),
+              "--workdir", str(work), "--fault-plan", plan])
+    calls = calls_log.read_text()
+    assert calls.count("terraform apply") == 1
+    assert "ansible-playbook" not in calls  # died before the child ran
+    # the journal holds the crash signature: host-configuration `running`
+    journal_lines = [
+        json.loads(l)
+        for l in RunPaths(work).journal.read_text().splitlines()
+    ]
+    by_task = {}
+    for r in journal_lines:
+        by_task[r["task"]] = r["status"]
+    assert by_task["terraform-apply"] == "done"
+    assert by_task["host-configuration"] == "running"
+    # the lock was released on the way down (crash -> no live holder)
+    capsys.readouterr()
+
+    # resume: no fault plan; the dirty suffix re-runs, the prefix skips
+    assert main(["--yes", "--workdir", str(work)]) == 0
+    calls = calls_log.read_text()
+    assert calls.count("terraform apply") == 1  # never re-ran
+    assert calls.count("ansible-playbook -i hosts clusterUp.yml") == 1
+    assert "journal-verified; skipping" in capsys.readouterr().err
+
+
+def test_cli_heal_repairs_lost_slice(fake_world, capsys):
+    """`./setup.sh heal`: one slice's host record is lost; heal re-creates
+    only that slice (terraform -replace scoped), reconverges ansible with
+    --limit, and rewrites hosts.json."""
+    work, calls_log = fake_world
+    assert main(["--yes", "--config", str(saved_config(work)),
+                 "--workdir", str(work)]) == 0
+    paths = RunPaths(work)
+    record = json.loads(paths.hosts_file.read_text())
+    record["host_ips"] = [[]]  # the slice vanished
+    record["internal_ips"] = []
+    paths.hosts_file.write_text(json.dumps(record))
+    calls_log.write_text("")
+    capsys.readouterr()
+
+    assert main(["heal", "--yes", "--workdir", str(work)]) == 0
+    out = capsys.readouterr().out
+    assert "slice 0: missing" in out
+    calls = calls_log.read_text()
+    assert "-replace=google_tpu_v2_vm.slice[0]" in calls
+    limit_line = next(l for l in calls.splitlines()
+                      if l.startswith("ansible-playbook"))
+    assert "--limit 10.0.0.1,10.0.0.2" in limit_line
+    # hosts.json restored from the (stub) terraform outputs
+    healed = json.loads(paths.hosts_file.read_text())
+    assert healed["host_ips"] == [["10.0.0.1", "10.0.0.2"]]
+    assert "heal-apply" in out  # phases timed like any other run
+
+
+def test_cli_heal_without_deployment_is_friendly(fake_world, capsys):
+    work, _ = fake_world
+    assert main(["heal", "--yes", "--workdir", str(work)]) == 1
+    err = capsys.readouterr().err
+    assert "ERROR:" in err and "provision first" in err
 
 
 def test_clean_without_config_is_noop(fake_world, capsys):

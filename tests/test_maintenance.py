@@ -166,3 +166,16 @@ def test_timed_windows_stops_at_drained_window(tmp_path, monkeypatch):
     monkeypatch.delenv(mt.DRAIN_FILE_VAR)
     _, timing = perf.timed_windows(run_once, 0, steps=2, warmup=1, windows=3)
     assert timing["windows"] == 3 and timing["drained"] is None
+
+
+def test_request_drain_writes_atomically(tmp_path):
+    """Temp file + os.replace: the workload polling drain_requested()
+    between steps must only ever see the old or the new content — a
+    partial drain file reads as a reason-less stop. No temp residue."""
+    drain = tmp_path / "sub" / "drain"
+    mt.request_drain(drain, "maintenance-event: TERMINATE")
+    assert drain.read_text() == "maintenance-event: TERMINATE\n"
+    assert [p.name for p in drain.parent.iterdir()] == ["drain"]
+    mt.request_drain(drain, "maintenance-event: MIGRATE")  # overwrite ok
+    assert drain.read_text() == "maintenance-event: MIGRATE\n"
+    assert [p.name for p in drain.parent.iterdir()] == ["drain"]

@@ -68,6 +68,52 @@ def test_load_hosts_missing_aborts_like_reference(tmp_path):
         state.load_hosts(paths)
 
 
+def test_load_hosts_truncated_file_gives_repair_hint(tmp_path):
+    """A torn hosts.json (supervisor killed mid-write, pre-atomic-save
+    residue) must surface as MissingStateError with the provision/heal
+    hint — never a raw JSONDecodeError traceback."""
+    paths = state.RunPaths(tmp_path)
+    paths.terraform_dir.mkdir()
+    paths.hosts_file.write_text('{"host_ips": [["10.0.0.1"]')  # torn
+    with pytest.raises(state.MissingStateError, match="heal"):
+        state.load_hosts(paths)
+
+
+def test_cluster_hosts_load_tolerates_unknown_keys(tmp_path):
+    """Forward compat: a newer supervisor's hosts.json (extra fields)
+    stays readable — unknown keys are dropped, not a TypeError."""
+    p = tmp_path / "hosts.json"
+    p.write_text(json.dumps({
+        "host_ips": [["1.2.3.4"]],
+        "coordinator_ip": "1.2.3.4",
+        "some_future_field": {"x": 1},
+    }))
+    hosts = state.ClusterHosts.load(p)
+    assert hosts.flat_ips == ["1.2.3.4"]
+
+
+def test_cluster_hosts_load_stale_schema_is_missing_state(tmp_path):
+    p = tmp_path / "hosts.json"
+    p.write_text(json.dumps({"host_ips": "10.0.0.1"}))  # pre-slice shape
+    with pytest.raises(state.MissingStateError, match="stale schema"):
+        state.ClusterHosts.load(p)
+    p.write_text(json.dumps([["10.0.0.1"]]))  # not even an object
+    with pytest.raises(state.MissingStateError, match="JSON object"):
+        state.ClusterHosts.load(p)
+    p.write_text(json.dumps({"coordinator_ip": "x"}))  # host_ips absent
+    with pytest.raises(state.MissingStateError):
+        state.ClusterHosts.load(p)
+
+
+def test_cluster_hosts_save_is_atomic_no_temp_residue(tmp_path):
+    hosts = state.ClusterHosts(host_ips=[["10.0.0.1"]])
+    target = tmp_path / "t" / "hosts.json"
+    hosts.save(target)
+    assert state.ClusterHosts.load(target) == hosts
+    # temp file replaced away, nothing else left behind
+    assert [p.name for p in target.parent.iterdir()] == ["hosts.json"]
+
+
 # -------------------------------------------------------------- terraform
 
 
@@ -398,6 +444,33 @@ def test_ssh_ready_probe_empty_host_list_is_ready():
     assert readiness.ssh_ready_probe([], run_quiet=None) == ""
 
 
+def test_slice_ssh_verdicts_isolate_the_bad_slice():
+    """Heal's granularity source: one dead host condemns ITS slice's
+    verdict; the other slices read clean."""
+
+    def run_quiet(args, cwd=None, **kwargs):
+        if args[-2] == "10.0.1.1":
+            raise run_mod.CommandError(args, 255)
+        return ""
+
+    verdicts = readiness.slice_ssh_verdicts(
+        [["10.0.0.1", "10.0.0.2"], ["10.0.1.1"], ["10.0.2.1"]],
+        run_quiet=run_quiet,
+    )
+    assert verdicts[0] == "" and verdicts[2] == ""
+    assert "10.0.1.1" in verdicts[1]
+
+
+def test_tpu_vm_states_parses_batched_listing():
+    quiet = RecordingRunner(
+        responses={("gcloud",):
+                   "n-0\tREADY\nprojects/p/locations/z/nodes/n-1\tCREATING\nn-2\n"}
+    )
+    states = readiness.tpu_vm_states(cfg(), quiet)
+    assert states == {"n-0": "READY", "n-1": "CREATING", "n-2": "UNKNOWN"}
+    assert len(quiet.calls) == 1
+
+
 def test_modes_with_state(tmp_path):
     paths = state.RunPaths(tmp_path)
     assert terraform_mod.modes_with_state(paths) == []
@@ -647,6 +720,59 @@ def test_teardown_full_scrub(tmp_path):
     ):
         assert not gone.exists(), gone
     assert "private_key_file = " in paths.ansible_cfg.read_text()
+
+
+def test_teardown_idempotent_with_journal_and_partial_residue(tmp_path):
+    """Re-running clean over a half-cleaned workdir (tfstate gone,
+    inventory gone, hosts.json truncated) must not raise, and must
+    still scrub the journal."""
+    from tritonk8ssupervisor_tpu.provision import journal as journal_mod
+
+    paths = make_paths(tmp_path)
+    config = cfg()
+    paths.config_file.write_text("PROJECT=my-proj\n")
+    paths.hosts_file.parent.mkdir(parents=True, exist_ok=True)
+    paths.hosts_file.write_text('{"host_ips": [["10.0')  # torn record
+    paths.quarantine_file.write_text('{"slices": {}}')
+    journal = journal_mod.Journal(paths.journal, echo=lambda l: None)
+    journal.note_running("terraform-apply", "h", 1)
+
+    run = RecordingRunner()
+    prompter = Prompter(io.StringIO("yes\nyes\n"), io.StringIO())
+    assert teardown.clean(config, paths, prompter, run=run) is True
+    assert not paths.journal.exists()
+    assert not paths.quarantine_file.exists()
+    # second clean over the now-empty residue: no raise, still True
+    paths.config_file.write_text("PROJECT=my-proj\n")
+    assert teardown.clean(config, paths, prompter, run=run) is True
+
+
+def test_teardown_scrubs_journal_last(tmp_path, monkeypatch):
+    """A clean that crashes before finishing must leave the journal on
+    disk — it is scrubbed LAST, so a crashed clean is itself resumable."""
+    from tritonk8ssupervisor_tpu.provision import journal as journal_mod
+
+    paths = make_paths(tmp_path)
+    paths.config_file.write_text("PROJECT=my-proj\n")
+    journal_mod.Journal(paths.journal, echo=lambda l: None).note_done(
+        "terraform-apply", "h"
+    )
+
+    def exploding_reset(ansible_cfg):
+        raise OSError("disk went away mid-clean")
+
+    monkeypatch.setattr(ansible_mod, "reset_private_key", exploding_reset)
+    prompter = Prompter(io.StringIO("yes\n"), io.StringIO())
+    with pytest.raises(OSError):
+        teardown.clean(cfg(), paths, prompter, run=RecordingRunner())
+    assert paths.journal.exists()  # the crashed clean left the ledger
+
+    monkeypatch.undo()
+    paths.config_file.write_text("PROJECT=my-proj\n")
+    prompter = Prompter(io.StringIO("yes\n"), io.StringIO())
+    assert teardown.clean(cfg(), paths, prompter,
+                          run=RecordingRunner()) is True
+    assert not paths.journal.exists()
 
 
 def test_teardown_abort_leaves_everything(tmp_path):

@@ -19,6 +19,7 @@ setup.sh:9-12, 484-521).
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import os
 import shlex
 import sys
@@ -32,6 +33,8 @@ from tritonk8ssupervisor_tpu.config import store
 from tritonk8ssupervisor_tpu.config.schema import ClusterConfig, ConfigError
 from tritonk8ssupervisor_tpu.provision import (
     ansible as ansible_mod,
+    heal as heal_mod,
+    journal as journal_mod,
     readiness,
     retry,
     runner as run_mod,
@@ -51,7 +54,28 @@ def build_parser() -> argparse.ArgumentParser:
     )
     # the reference's single flag (setup.sh:9-12)
     parser.add_argument(
+        "command",
+        nargs="?",
+        choices=["heal"],
+        metavar="command",
+        help="optional subcommand: `heal` diagnoses per-slice fleet "
+        "health (missing / unready / draining) and repairs ONLY the "
+        "broken slices — scoped terraform replace, ansible --limit, "
+        "scoped readiness — leaving healthy slices untouched "
+        "(docs/failure-modes.md, crash & repair runbook)",
+    )
+    parser.add_argument(
         "-c", "--clean", action="store_true", help="destroy the cluster and all state"
+    )
+    parser.add_argument(
+        "--max-degraded",
+        type=int,
+        default=0,
+        metavar="N",
+        help="heal: tolerate up to N slices that stay broken after "
+        "repair — they are quarantined (terraform/quarantine.json) and "
+        "emptied from hosts.json, and heal succeeds on the remaining "
+        "healthy slices instead of aborting (N-of-M semantics)",
     )
     parser.add_argument(
         "--yes", action="store_true", help="skip confirmation gates (CI use)"
@@ -192,6 +216,8 @@ def main(argv: list[str] | None = None, prompter: Prompter | None = None) -> int
     try:
         if args.clean:
             return clean(args, paths, prompter)
+        if args.command == "heal":
+            return heal_cmd(args, paths, prompter)
         if args.show_config:
             return show_config(args, paths, prompter)
         return provision(args, paths, prompter)
@@ -202,6 +228,7 @@ def main(argv: list[str] | None = None, prompter: Prompter | None = None) -> int
         readiness.NotReadyError,
         run_mod.CommandError,
         faults.FaultPlanError,
+        journal_mod.JournalError,
         EndOfInput,
     ) as e:
         print(f"ERROR: {e}", file=sys.stderr)
@@ -266,6 +293,38 @@ def clean(args, paths: state.RunPaths, prompter: Prompter) -> int:
     run, _ = build_runners(args.fault_plan)
     ok = teardown.clean(config, paths, prompter, run=run, assume_yes=args.yes)
     return 0 if ok else 1
+
+
+def heal_cmd(args, paths: state.RunPaths, prompter: Prompter) -> int:
+    """`./setup.sh heal [--max-degraded N]` — slice-granular repair of an
+    existing deployment (provision/heal.py). Works from the saved config
+    (or an explicit --config): heal converges what provision recorded, it
+    never invents a new deployment."""
+    source = args.config or paths.config_file
+    if not source.exists():
+        raise state.MissingStateError(
+            f"no configuration at {source} — heal repairs an existing "
+            "deployment; run ./setup.sh to provision first"
+        )
+    config = store.load_config_file(source)
+    config.validate()
+    timer = PhaseTimer(logfile=paths.runlog)
+    run, run_quiet = build_runners(args.fault_plan, timer)
+    ssh_key: Path | str = ""
+    ssh_user = ""
+    if config.mode == "tpu-vm":
+        ssh_key = discovery.find_ssh_key()
+        ssh_user = discovery.ssh_username()
+    heal_mod.heal(
+        config, paths, prompter,
+        run=run, run_quiet=run_quiet,
+        ssh_key=str(ssh_key), ssh_user=ssh_user,
+        max_degraded=max(0, args.max_degraded),
+        readiness_timeout=args.readiness_timeout,
+        timer=timer,
+    )
+    timer.report()
+    return 0
 
 
 def provision(args, paths: state.RunPaths, prompter: Prompter) -> int:
@@ -349,7 +408,16 @@ def provision(args, paths: state.RunPaths, prompter: Prompter) -> int:
         args, config, paths, prompter,
         run=run, run_quiet=run_quiet, ssh_key=ssh_key, ssh_user=ssh_user,
     )
-    results = run_dag(tasks, max_workers=scheduler_workers(), timer=timer)
+    # The durable ledger (provision/journal.py): every task transition is
+    # fsync'd, so a SIGKILL'd supervisor resumes the dirty suffix of the
+    # DAG instead of starting over. The lock rejects a second concurrent
+    # supervisor over the same workdir.
+    journal = journal_mod.Journal(paths.journal)
+    with journal:
+        results = run_dag(
+            tasks, max_workers=scheduler_workers(), timer=timer,
+            journal=journal,
+        )
 
     banner(config, results["terraform-apply"], results["compile-manifests"],
            prompter)
@@ -394,8 +462,17 @@ def build_provision_dag(
       cloud-facing pipeline (the DAG's free win);
     - the probe Job needs a ready cluster.
 
+    Each task also carries its journal metadata (provision/journal.py):
+    an inputs-hash over everything that must dirty it when changed
+    (tfvars/config/CLI knobs), the artifact paths whose digests get
+    recorded at done-time (tfstate, hosts.json, inventory, manifests),
+    and a `restore` that recomputes the task's return value from those
+    artifacts when a resume skips it. The probe Job carries none — a
+    health check is only meaningful re-run.
+
     Diagram + measured overlap numbers: docs/performance.md.
     """
+    cfg_fp = dataclasses.asdict(config)  # the config fingerprint
 
     def do_terraform(results: dict) -> state.ClusterHosts:
         if terraform_mod.already_applied(config, paths):
@@ -430,22 +507,23 @@ def build_provision_dag(
         )
         ansible_mod.run_playbook(paths, run=run)
 
+    job_kwargs = {"image": args.bench_image} if args.bench_image else {}
+    if args.checkpoint_dir:
+        job_kwargs["checkpoint_dir"] = args.checkpoint_dir
+    if args.bench_workload != "resnet50":
+        job_kwargs["workload"] = args.bench_workload
+    if args.bench_flags:
+        job_kwargs["bench_flags"] = tuple(shlex.split(args.bench_flags))
+    if args.workload_image:
+        job_kwargs["workload_image"] = args.workload_image
+        job_kwargs["workload_command"] = shlex.split(
+            args.workload_command or ""
+        )
+        job_kwargs["workload_name"] = args.workload_name
+    if args.independent_slices:
+        job_kwargs["cross_slice"] = False
+
     def do_manifests(results: dict) -> list:
-        job_kwargs = {"image": args.bench_image} if args.bench_image else {}
-        if args.checkpoint_dir:
-            job_kwargs["checkpoint_dir"] = args.checkpoint_dir
-        if args.bench_workload != "resnet50":
-            job_kwargs["workload"] = args.bench_workload
-        if args.bench_flags:
-            job_kwargs["bench_flags"] = tuple(shlex.split(args.bench_flags))
-        if args.workload_image:
-            job_kwargs["workload_image"] = args.workload_image
-            job_kwargs["workload_command"] = shlex.split(
-                args.workload_command or ""
-            )
-            job_kwargs["workload_name"] = args.workload_name
-        if args.independent_slices:
-            job_kwargs["cross_slice"] = False
         return compiler.write_manifests(config, paths.manifests_dir, **job_kwargs)
 
     def do_probe(results: dict) -> None:
@@ -458,32 +536,54 @@ def build_provision_dag(
             image=args.probe_image,
         )
 
-    tasks = [
-        Task("terraform-apply", do_terraform),
-        Task("compile-manifests", do_manifests),
-    ]
+    tf_task = Task(
+        "terraform-apply", do_terraform,
+        inputs_hash=journal_mod.inputs_hash(
+            "terraform-apply", compiler.to_tfvars(config)
+        ),
+        artifacts=(paths.tfstate(config.mode), paths.hosts_file),
+        restore=lambda results: state.load_hosts(paths),
+    )
+    manifests_task = Task(
+        "compile-manifests", do_manifests,
+        inputs_hash=journal_mod.inputs_hash(
+            "compile-manifests", cfg_fp, job_kwargs
+        ),
+        artifacts=(paths.manifests_dir,),
+        restore=lambda results: sorted(paths.manifests_dir.glob("*.yaml")),
+    )
+    def readiness_task(after: tuple) -> Task:
+        return Task(
+            "readiness-wait", do_readiness, after=after,
+            inputs_hash=journal_mod.inputs_hash("readiness-wait", cfg_fp),
+            artifacts=(paths.hosts_file,),
+        )
+
+    def ansible_task(after: tuple) -> Task:
+        return Task(
+            "host-configuration", do_ansible, after=after,
+            inputs_hash=journal_mod.inputs_hash(
+                "host-configuration", cfg_fp, str(ssh_key), ssh_user
+            ),
+            artifacts=(paths.inventory, paths.hosts_file),
+        )
+
+    tasks = [tf_task, manifests_task]
     ready_gate = "terraform-apply"
     if config.mode == "tpu-vm":
         if not args.skip_readiness:
-            tasks.append(
-                Task("readiness-wait", do_readiness, after=("terraform-apply",))
-            )
+            tasks.append(readiness_task(("terraform-apply",)))
             ready_gate = "readiness-wait"
-        tasks.append(
-            Task("host-configuration", do_ansible, after=(ready_gate,))
-        )
+        tasks.append(ansible_task((ready_gate,)))
     else:
-        tasks.append(
-            Task("host-configuration", do_ansible, after=("terraform-apply",))
-        )
+        tasks.append(ansible_task(("terraform-apply",)))
         ready_gate = "host-configuration"
         if not args.skip_readiness:
-            tasks.append(
-                Task("readiness-wait", do_readiness,
-                     after=("host-configuration",))
-            )
+            tasks.append(readiness_task(("host-configuration",)))
             ready_gate = "readiness-wait"
         if args.probe:
+            # no journal metadata: the probe is an acceptance test, and a
+            # resumed run must re-prove the cluster, not trust a record
             tasks.append(Task("probe-job", do_probe, after=(ready_gate,)))
     return tasks
 

@@ -1,0 +1,340 @@
+"""Slice-granular fleet health and repair.
+
+Before this module, one dead slice aborted the whole deployment: the
+readiness poll timed out, the run failed, and the only recovery was a
+full re-provision — the opposite of how Podracer-style TPU orchestration
+(PAPERS.md, 2104.06272) treats pod loss, where slices come and go and the
+controller degrades instead of dying. Here the fleet gets a health model
+and a scoped repair path:
+
+- `diagnose()` builds a `FleetHealth`: per slice, `healthy`, `missing`
+  (no hosts recorded / node absent from the Cloud TPU listing), `unready`
+  (TPU state not READY, or a host refusing authenticated SSH), or
+  `draining` (the maintenance watchdog's drain file is present on a host
+  — provision/maintenance.py). One dead host condemns its slice (the JAX
+  gang loses the collective anyway) but never the fleet.
+- `heal()` quarantines the bad slices (terraform/quarantine.json, written
+  atomically), re-creates ONLY them (`terraform apply -replace=` on the
+  slice addresses — healthy slices' state entries are untouched),
+  reconverges ansible with `--limit` to the healed hosts, polls readiness
+  for just those hosts, and rewrites hosts.json atomically.
+- `--max-degraded N` turns abort-on-loss into degrade-on-loss: slices
+  that stay broken after repair are recorded as degraded and emptied from
+  hosts.json, and the run SUCCEEDS on the remaining healthy slices —
+  N-of-M semantics. (Cross-slice training manifests still span the
+  original slice count; `./setup.sh --resize` shrinks the training
+  surface when the loss is long-lived — see docs/failure-modes.md.)
+
+tpu-vm mode only: GKE slice repair is the node pool's auto-repair job
+(terraform/gke/main.tf `management.auto_repair`), not ours.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import json
+import time
+
+from tritonk8ssupervisor_tpu.config.schema import ClusterConfig, ConfigError
+from tritonk8ssupervisor_tpu.provision import ansible as ansible_mod
+from tritonk8ssupervisor_tpu.provision import maintenance
+from tritonk8ssupervisor_tpu.provision import readiness
+from tritonk8ssupervisor_tpu.provision import runner as run_mod
+from tritonk8ssupervisor_tpu.provision import terraform as terraform_mod
+from tritonk8ssupervisor_tpu.provision.state import (
+    MissingStateError,
+    RunPaths,
+    atomic_write_text,
+    load_hosts,
+)
+
+HEALTHY = "healthy"
+MISSING = "missing"
+UNREADY = "unready"
+DRAINING = "draining"
+DEGRADED = "degraded"  # quarantine-file state: left out of service
+
+
+@dataclasses.dataclass(frozen=True)
+class SliceHealth:
+    index: int
+    state: str  # HEALTHY / MISSING / UNREADY / DRAINING
+    detail: str = ""
+    hosts: tuple = ()
+
+
+@dataclasses.dataclass
+class FleetHealth:
+    """Per-slice verdicts for one deployment, in slice order."""
+
+    slices: list
+
+    @property
+    def healthy(self) -> list:
+        return [s.index for s in self.slices if s.state == HEALTHY]
+
+    @property
+    def degraded(self) -> list:
+        return [s.index for s in self.slices if s.state != HEALTHY]
+
+    def summary(self) -> list:
+        lines = []
+        for s in self.slices:
+            detail = f" ({s.detail})" if s.detail else ""
+            lines.append(f"slice {s.index}: {s.state}{detail}")
+        return lines
+
+
+def _ssh_args(ssh_user: str, ssh_key: str, connect_timeout: int = 5) -> list:
+    args = [
+        "ssh",
+        "-o", "BatchMode=yes",
+        "-o", f"ConnectTimeout={connect_timeout}",
+        "-o", "StrictHostKeyChecking=no",
+        "-o", "UserKnownHostsFile=/dev/null",
+    ]
+    if ssh_key:
+        args += ["-i", str(ssh_key)]
+    if ssh_user:
+        args += ["-l", ssh_user]
+    return args
+
+
+def drain_verdicts(
+    host_ips: list,
+    ssh_user: str = "",
+    ssh_key: str = "",
+    run_quiet: run_mod.RunFn = run_mod.run_capture,
+    drain_file: str = maintenance.DEFAULT_DRAIN_FILE,
+) -> dict:
+    """{slice index: drain reason} for slices where ANY host carries the
+    maintenance watchdog's drain file. An unreachable host is NOT
+    draining (it shows up as unready via the SSH probe instead); a
+    reachable host without the file returns empty output — also not
+    draining."""
+    verdicts: dict = {}
+    for i, slice_ips in enumerate(host_ips):
+        for ip in slice_ips:
+            try:
+                reason = run_quiet(
+                    _ssh_args(ssh_user, ssh_key)
+                    + [ip, f"cat {drain_file} 2>/dev/null || true"]
+                ).strip()
+            except run_mod.CommandError:
+                continue  # cannot ask — the SSH probe owns that verdict
+            if reason:
+                verdicts[i] = f"{ip}: {reason}"
+                break
+    return verdicts
+
+
+def diagnose(
+    config: ClusterConfig,
+    paths: RunPaths,
+    run_quiet: run_mod.RunFn = run_mod.run_capture,
+    ssh_user: str = "",
+    ssh_key: str = "",
+    check_drain: bool = True,
+) -> FleetHealth:
+    """Readiness verdicts + the drain signal, folded into per-slice
+    health. Probes are batched/concurrent the PR-2 way: one `tpu-vm
+    list` for the whole fleet, SSH fan-out per slice."""
+    try:
+        hosts = load_hosts(paths)
+        host_ips = hosts.host_ips
+    except MissingStateError:
+        host_ips = []
+    try:
+        listing = readiness.tpu_vm_states(config, run_quiet)
+    except Exception:  # noqa: BLE001 - listing is advisory; SSH decides
+        listing = {}
+    ssh_verdicts = readiness.slice_ssh_verdicts(
+        host_ips, ssh_user=ssh_user, ssh_key=ssh_key, run_quiet=run_quiet
+    )
+    drains = (
+        drain_verdicts(host_ips, ssh_user=ssh_user, ssh_key=ssh_key,
+                       run_quiet=run_quiet)
+        if check_drain else {}
+    )
+
+    slices = []
+    for i in range(config.num_slices):
+        name = f"{config.node_prefix}-{i}"
+        slice_ips = tuple(host_ips[i]) if i < len(host_ips) else ()
+        if not slice_ips:
+            slices.append(SliceHealth(i, MISSING, "no hosts recorded"))
+        elif listing and name not in listing:
+            slices.append(SliceHealth(
+                i, MISSING, "absent from the Cloud TPU listing",
+                hosts=slice_ips,
+            ))
+        elif listing and listing.get(name) != "READY":
+            slices.append(SliceHealth(
+                i, UNREADY, f"TPU state {listing[name]}", hosts=slice_ips
+            ))
+        elif i in drains:
+            slices.append(SliceHealth(i, DRAINING, drains[i],
+                                      hosts=slice_ips))
+        elif ssh_verdicts.get(i):
+            slices.append(SliceHealth(i, UNREADY, ssh_verdicts[i],
+                                      hosts=slice_ips))
+        else:
+            slices.append(SliceHealth(i, HEALTHY, hosts=slice_ips))
+    return FleetHealth(slices)
+
+
+def record_quarantine(
+    paths: RunPaths,
+    entries: dict,
+    clock=time.time,
+) -> None:
+    """Merge {slice index: {state, detail, hosts}} into
+    terraform/quarantine.json (atomic write). The record survives the
+    heal so an operator can see WHAT was pulled and WHY even after
+    hosts.json has been rewritten; healed slices are removed again."""
+    existing: dict = {}
+    if paths.quarantine_file.exists():
+        try:
+            existing = json.loads(paths.quarantine_file.read_text())
+        except (json.JSONDecodeError, OSError):
+            existing = {}  # a torn quarantine record is rewritten whole
+    slices = existing.get("slices", {})
+    for index, entry in entries.items():
+        if entry is None:
+            slices.pop(str(index), None)
+        else:
+            slices[str(index)] = entry
+    atomic_write_text(
+        paths.quarantine_file,
+        json.dumps({"updated": clock(), "slices": slices},
+                   indent=2, sort_keys=True) + "\n",
+    )
+
+
+def heal(
+    config: ClusterConfig,
+    paths: RunPaths,
+    prompter,
+    run: run_mod.RunFn = run_mod.run_streaming,
+    run_quiet: run_mod.RunFn = run_mod.run_capture,
+    ssh_key: str = "",
+    ssh_user: str = "",
+    max_degraded: int = 0,
+    readiness_timeout: float = 900.0,
+    timer=None,
+    check_drain: bool = True,
+    sleep=time.sleep,
+) -> bool:
+    """Diagnose and repair the fleet at slice granularity.
+
+    Returns True when every slice is healthy afterwards, or when the
+    leftover breakage fits inside `max_degraded` (those slices are
+    quarantined and emptied from hosts.json — N-of-M success). Breakage
+    beyond the budget re-raises the readiness timeout; terraform/ansible
+    failures raise through the normal error path, retries included.
+    """
+    if config.mode != "tpu-vm":
+        raise ConfigError(
+            "heal repairs standalone TPU VM slices; GKE node pools "
+            "self-repair (auto_repair) and gang-restart via the Job "
+            "backoff budget — see docs/failure-modes.md"
+        )
+
+    def phase(name: str):
+        return (timer.phase(name) if timer is not None
+                else contextlib.nullcontext())
+
+    with phase("heal-diagnose"):
+        health = diagnose(
+            config, paths, run_quiet=run_quiet,
+            ssh_user=ssh_user, ssh_key=ssh_key, check_drain=check_drain,
+        )
+    for line in health.summary():
+        prompter.say(f"  {line}")
+    bad = health.degraded
+    if not bad:
+        prompter.say("Fleet healthy; nothing to heal.")
+        return True
+
+    # Quarantine BEFORE touching anything: if the repair itself crashes,
+    # the record of which slices were condemned (and why) survives.
+    record_quarantine(paths, {
+        s.index: {"state": s.state, "detail": s.detail,
+                  "hosts": list(s.hosts)}
+        for s in health.slices if s.state != HEALTHY
+    })
+    prompter.say(
+        f"Healing slice(s) {', '.join(str(i) for i in bad)} "
+        f"(quarantined in {paths.quarantine_file}); healthy slice(s) "
+        f"{', '.join(str(i) for i in health.healthy) or '(none)'} untouched."
+    )
+
+    with phase("heal-apply"):
+        hosts = terraform_mod.apply_slices(
+            config, paths, bad, run=run, run_quiet=run_quiet
+        )
+    healed_ips = [
+        ip for i in bad if i < len(hosts.host_ips)
+        for ip in hosts.host_ips[i]
+    ]
+    with phase("heal-configure"):
+        ansible_mod.write_runtime_configs(
+            config, hosts, paths, ssh_key=ssh_key, ansible_user=ssh_user
+        )
+        limit = ["--limit", ",".join(healed_ips)] if healed_ips else []
+        ansible_mod.run_playbook(paths, run=run, extra_args=limit)
+    still_bad: list = []
+    with phase("heal-readiness"):
+        try:
+            readiness.poll(
+                lambda: readiness.ssh_ready_probe(
+                    healed_ips, ssh_user=ssh_user, ssh_key=str(ssh_key),
+                    run_quiet=run_quiet,
+                ),
+                interval=5.0,
+                timeout=readiness_timeout,
+                sleep=sleep,
+            )
+        except readiness.NotReadyError:
+            verdicts = readiness.slice_ssh_verdicts(
+                hosts.host_ips, ssh_user=ssh_user, ssh_key=str(ssh_key),
+                run_quiet=run_quiet,
+            )
+            still_bad = [i for i in bad if verdicts.get(i)]
+            if len(still_bad) > max_degraded:
+                raise
+
+    if still_bad:
+        # N-of-M degradation: pull the unhealable slices from service —
+        # empty their host records (atomic rewrite) and keep the
+        # quarantine entries — instead of failing the whole fleet.
+        for i in still_bad:
+            if i < len(hosts.host_ips):
+                hosts.host_ips[i] = []
+            if i < len(hosts.internal_ips):
+                hosts.internal_ips[i] = []
+        hosts.save(paths.hosts_file)
+        record_quarantine(paths, {
+            i: {"state": DEGRADED,
+                "detail": "still unready after heal; left out of service "
+                          f"(--max-degraded {max_degraded})",
+                "hosts": []}
+            for i in still_bad
+        })
+        prompter.say(
+            f"WARNING: slice(s) {', '.join(str(i) for i in still_bad)} "
+            "stayed unhealthy and were left out of service "
+            f"(--max-degraded {max_degraded}). Running degraded on "
+            f"{config.num_slices - len(still_bad)}/{config.num_slices} "
+            "slices; use --resize to shrink the training surface, or "
+            "re-run heal later."
+        )
+    else:
+        # everything healed: clear the quarantine entries for these slices
+        record_quarantine(paths, {i: None for i in bad})
+        prompter.say(
+            f"Healed slice(s) {', '.join(str(i) for i in bad)}; "
+            "fleet fully healthy."
+        )
+    return True

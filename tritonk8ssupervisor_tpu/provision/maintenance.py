@@ -81,10 +81,16 @@ def poll_event(
 
 
 def request_drain(drain_file: Path, reason: str) -> None:
-    """Write the one-way drain signal (idempotent; content = reason)."""
-    drain_file = Path(drain_file)
-    drain_file.parent.mkdir(parents=True, exist_ok=True)
-    drain_file.write_text(f"{reason}\n")
+    """Write the one-way drain signal (idempotent; content = reason).
+
+    Atomic (temp file + os.replace): the training loop polls
+    `drain_requested()` between steps, and a reader racing a plain
+    write_text could see an empty/partial file — an empty drain file
+    still reads as "drain requested" with no reason, so the workload
+    would stop without knowing why."""
+    from tritonk8ssupervisor_tpu.provision.state import atomic_write_text
+
+    atomic_write_text(Path(drain_file), f"{reason}\n")
 
 
 def drain_requested(environ: dict | None = None) -> str | None:

@@ -162,20 +162,33 @@ def ssh_ready_probe(
     return ""
 
 
-def tpu_vm_probe(
-    config: ClusterConfig,
-    slice_names: list[str],
+def slice_ssh_verdicts(
+    host_ips: list[list[str]],
+    ssh_user: str = "",
+    ssh_key: str = "",
     run_quiet: run_mod.RunFn = run_mod.run_capture,
-) -> str:
-    """Ready when every slice's Cloud TPU state is READY.
+    connect_timeout: int = 5,
+) -> dict[int, str]:
+    """Per-slice SSH readiness verdict ("" = every host in the slice
+    accepts authenticated sessions). The heal diagnosis needs verdicts at
+    SLICE granularity — one dead host condemns its slice (the JAX gang
+    loses the whole collective anyway) but must not condemn the fleet."""
+    return {
+        i: ssh_ready_probe(
+            list(slice_ips), ssh_user=ssh_user, ssh_key=ssh_key,
+            run_quiet=run_quiet, connect_timeout=connect_timeout,
+        )
+        for i, slice_ips in enumerate(host_ips)
+    }
 
-    One `tpu-vm list` call covers every slice (instead of N per-slice
-    `describe` round-trips — at ~1 s of gcloud startup + API latency
-    each, that's the whole poll interval burned on a 16-slice pool), and
-    the verdict names every slice still in flight. A slice absent from
-    the listing reads CREATING: the QueuedResource has not materialised
-    a node yet, which is the normal early-boot state, not an error.
-    """
+
+def tpu_vm_states(
+    config: ClusterConfig,
+    run_quiet: run_mod.RunFn = run_mod.run_capture,
+) -> dict[str, str]:
+    """Cloud TPU state per node name from ONE batched `tpu-vm list` call.
+    Shared by the readiness poll (every slice) and the heal diagnosis
+    (which slices are missing/stuck while the rest of the fleet is up)."""
     raw = run_quiet(
         [
             "gcloud",
@@ -195,6 +208,24 @@ def tpu_vm_probe(
         # value() output is NAME<tab>STATE; a bare NAME means no state yet
         name = parts[0].rsplit("/", 1)[-1]  # tolerate full resource paths
         states[name] = parts[1] if len(parts) > 1 else "UNKNOWN"
+    return states
+
+
+def tpu_vm_probe(
+    config: ClusterConfig,
+    slice_names: list[str],
+    run_quiet: run_mod.RunFn = run_mod.run_capture,
+) -> str:
+    """Ready when every slice's Cloud TPU state is READY.
+
+    One `tpu-vm list` call covers every slice (instead of N per-slice
+    `describe` round-trips — at ~1 s of gcloud startup + API latency
+    each, that's the whole poll interval burned on a 16-slice pool), and
+    the verdict names every slice still in flight. A slice absent from
+    the listing reads CREATING: the QueuedResource has not materialised
+    a node yet, which is the normal early-boot state, not an error.
+    """
+    states = tpu_vm_states(config, run_quiet)
     unready = [
         f"{name} is {states.get(name) or 'CREATING'}"
         for name in slice_names

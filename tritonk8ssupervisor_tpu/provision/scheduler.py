@@ -23,6 +23,13 @@ Failure semantics preserve PR-1's errexit-with-retries contract:
 - In-flight tasks are never abandoned mid-run: threads can't be killed,
   so the scheduler waits for them — no orphaned threads holding half-open
   subprocesses past the run's end.
+
+Crash-safety (PR 3) layers a durable ledger on top (provision/journal.py):
+with `journal=`, every task transition is fsync'd to an append-only JSONL
+file, and a re-run skips the verified prefix — tasks whose recorded
+inputs-hash and artifact digests still match, reached only through other
+skipped tasks — executing just the dirty suffix. A SIGKILL'd supervisor
+resumes mid-DAG instead of from zero.
 """
 
 from __future__ import annotations
@@ -30,6 +37,7 @@ from __future__ import annotations
 import dataclasses
 import sys
 from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
+from pathlib import Path
 from typing import Callable
 
 
@@ -45,11 +53,27 @@ class Task:
 
     `fn` receives the results-so-far mapping {task name: return value};
     every dependency named in `after` is guaranteed present when it runs.
+
+    The journal fields are optional and only consulted when run_dag gets a
+    `journal=`:
+
+    - `inputs_hash` fingerprints everything that, when changed, must make
+      a recorded completion stale (journal.inputs_hash of tfvars, config,
+      CLI knobs). Empty means "never resume-skip this task".
+    - `artifacts` are the on-disk outputs whose digests are recorded at
+      done-time and re-verified before a skip (tfstate, hosts.json,
+      inventory, manifest dir).
+    - `restore` recomputes the task's return value from those artifacts
+      when the task is skipped (e.g. load hosts.json instead of re-running
+      terraform), so dependents see the same results mapping either way.
     """
 
     name: str
     fn: Callable[[dict], object]
     after: tuple[str, ...] = ()
+    inputs_hash: str = ""
+    artifacts: tuple[Path, ...] = ()
+    restore: Callable[[dict], object] | None = None
 
 
 def validate(tasks: list[Task]) -> list[Task]:
@@ -87,6 +111,7 @@ def run_dag(
     *,
     max_workers: int = 4,
     timer=None,
+    journal=None,
     on_submit: Callable[[Task], None] | None = None,
     on_settled: Callable[[Task], None] | None = None,
     echo: Callable[[str], None] = lambda line: print(
@@ -110,6 +135,17 @@ def run_dag(
     in-flight tasks, reports any tasks it skipped, and re-raises the
     first error unchanged. Later failures from already-running tasks are
     echoed, not raised — one run, one verdict.
+
+    `journal` (a provision.journal.Journal, already holding its writer
+    lock) turns the run crash-safe: each task's running/done/failed
+    transition is fsync'd before/after execution, and at submit time a
+    task is SKIPPED — `restore`d instead of executed — when the replayed
+    ledger verifies it (recorded inputs-hash matches, artifact digests
+    match, and every dependency was itself skipped, so an upstream re-run
+    dirties the whole suffix). Failed/killed tasks re-run with attempt
+    numbers continuing the recorded history. A BaseException that is not
+    an Exception (KeyboardInterrupt, a simulated SIGKILL) writes nothing:
+    the lingering `running` record IS the crash signature resume keys on.
     """
     order = validate(tasks)
     if not order:
@@ -120,12 +156,31 @@ def run_dag(
     pending = list(order)  # not yet submitted, in stable topo order
     failure: BaseException | None = None
     failed_or_skipped: list[str] = []
+    replayed = journal.replay() if journal is not None else {}
+    restored: set[str] = set()  # journal-verified skips this run
 
     def run_task(task: Task):
-        if timer is not None:
-            with timer.phase(task.name, after=task.after):
-                return task.fn(results)
-        return task.fn(results)
+        if journal is not None:
+            prior = replayed.get(task.name)
+            attempt = (prior.attempts if prior is not None else 0) + 1
+            journal.note_running(task.name, task.inputs_hash, attempt)
+        try:
+            if timer is not None:
+                with timer.phase(task.name, after=task.after):
+                    result = task.fn(results)
+            else:
+                result = task.fn(results)
+        except BaseException as e:
+            # Only genuine task failures are journaled; a non-Exception
+            # BaseException models the supervisor dying mid-task, which
+            # writes nothing — the open `running` record marks the task
+            # dirty for the resume run, exactly like a real SIGKILL.
+            if journal is not None and isinstance(e, Exception):
+                journal.note_failed(task.name, task.inputs_hash, str(e))
+            raise
+        if journal is not None:
+            journal.note_done(task.name, task.inputs_hash, task.artifacts)
+        return result
 
     with ThreadPoolExecutor(
         max_workers=max(1, max_workers), thread_name_prefix="tk8s-dag"
@@ -134,19 +189,49 @@ def run_dag(
 
         def submit_ready() -> None:
             nonlocal pending
-            ready = [t for t in pending
-                     if all(d in done for d in t.after)]
-            ready_names = {t.name for t in ready}
-            pending = [t for t in pending if t.name not in ready_names]
-            # announce the WHOLE batch before submitting any of it: a
-            # task handed to the pool can start (and block on a virtual
-            # clock) instantly, and on_submit accounting must already
-            # cover its still-unsubmitted siblings (testing/simclock.py)
-            if on_submit is not None:
+            # Loop because a journal-verified skip completes a task
+            # instantly, which can make its dependents ready within the
+            # same scheduling round (a fully-verified prefix collapses
+            # without ever touching the pool).
+            while True:
+                ready = [t for t in pending
+                         if all(d in done for d in t.after)]
+                ready_names = {t.name for t in ready}
+                pending = [t for t in pending if t.name not in ready_names]
+                to_submit = []
+                skipped_any = False
                 for task in ready:
-                    on_submit(task)
-            for task in ready:
-                futures[pool.submit(run_task, task)] = task
+                    if (
+                        journal is not None
+                        and all(d in restored for d in task.after)
+                        and journal.verified_done(
+                            replayed, task.name, task.inputs_hash,
+                            task.artifacts,
+                        )
+                    ):
+                        results[task.name] = (
+                            task.restore(results)
+                            if task.restore is not None else None
+                        )
+                        done.add(task.name)
+                        restored.add(task.name)
+                        skipped_any = True
+                        echo(f"  {task.name}: journal-verified; skipping")
+                        if timer is not None and hasattr(timer, "note_skip"):
+                            timer.note_skip(task.name, after=task.after)
+                    else:
+                        to_submit.append(task)
+                # announce the WHOLE batch before submitting any of it: a
+                # task handed to the pool can start (and block on a virtual
+                # clock) instantly, and on_submit accounting must already
+                # cover its still-unsubmitted siblings (testing/simclock.py)
+                if on_submit is not None:
+                    for task in to_submit:
+                        on_submit(task)
+                for task in to_submit:
+                    futures[pool.submit(run_task, task)] = task
+                if not skipped_any:
+                    break
 
         submit_ready()
         while futures:

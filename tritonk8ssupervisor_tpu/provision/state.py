@@ -14,7 +14,20 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import os
 from pathlib import Path
+
+
+def atomic_write_text(path: Path, text: str) -> None:
+    """Write via a same-directory temp file + os.replace so readers only
+    ever see the old or the new content, never a torn half-write — the
+    contract every polled state file here needs (hosts.json is read by
+    heal/teardown, the drain file by training loops mid-step)."""
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    tmp = path.with_name(f".{path.name}.{os.getpid()}.tmp")
+    tmp.write_text(text)
+    os.replace(tmp, path)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -73,6 +86,18 @@ class RunPaths:
     def runlog(self) -> Path:
         return self.root / "runlog.jsonl"
 
+    @property
+    def journal(self) -> Path:
+        # the durable provisioning ledger (provision/journal.py) — crash
+        # resume and teardown both key off it, so it lives at the root
+        # next to `config`, not under any one phase's directory
+        return self.root / "provision-journal.jsonl"
+
+    @property
+    def quarantine_file(self) -> Path:
+        # hosts/slices pulled from service by heal (provision/heal.py)
+        return self.terraform_dir / "quarantine.json"
+
 
 @dataclasses.dataclass
 class ClusterHosts:
@@ -93,17 +118,45 @@ class ClusterHosts:
         return [ip for slice_ips in self.host_ips for ip in slice_ips]
 
     def save(self, path: Path) -> None:
-        path.parent.mkdir(parents=True, exist_ok=True)
-        path.write_text(json.dumps(dataclasses.asdict(self), indent=2) + "\n")
+        # atomic: hosts.json is the terraform→ansible phase contract AND
+        # what heal rewrites on a live deployment — a reader racing the
+        # write must never see a truncated record
+        atomic_write_text(
+            path, json.dumps(dataclasses.asdict(self), indent=2) + "\n"
+        )
 
     @classmethod
     def load(cls, path: Path) -> "ClusterHosts":
-        return cls(**json.loads(path.read_text()))
+        """Tolerant load: unknown keys are dropped (a newer supervisor's
+        hosts.json must stay readable — forward compat), and a truncated
+        or stale-schema file raises MissingStateError with a repair hint
+        instead of a raw JSONDecodeError/TypeError traceback."""
+        try:
+            raw = json.loads(Path(path).read_text())
+            if not isinstance(raw, dict):
+                raise TypeError(f"expected a JSON object, got {type(raw).__name__}")
+            known = {f.name for f in dataclasses.fields(cls)}
+            hosts = cls(**{k: v for k, v in raw.items() if k in known})
+        except (json.JSONDecodeError, TypeError, ValueError, OSError) as e:
+            raise MissingStateError(
+                f"{path} is unreadable or stale ({e}) — the hosts record "
+                "is the terraform→ansible phase contract; re-run "
+                "./setup.sh to converge, or ./setup.sh heal to repair it"
+            ) from e
+        if not isinstance(hosts.host_ips, list):
+            raise MissingStateError(
+                f"{path} has a stale schema (host_ips is "
+                f"{type(hosts.host_ips).__name__}, expected per-slice "
+                "lists) — re-run provision or ./setup.sh heal"
+            )
+        return hosts
 
 
 class MissingStateError(RuntimeError):
-    """A phase's input file is absent — the analogue of the reference's
-    missing-ip-file abort (setup.sh:117-120)."""
+    """A phase's input file is absent or unreadable — the analogue of the
+    reference's missing-ip-file abort (setup.sh:117-120), extended to
+    truncated/stale records (a torn write is a missing record, not a
+    traceback)."""
 
 
 def load_hosts(paths: RunPaths) -> ClusterHosts:

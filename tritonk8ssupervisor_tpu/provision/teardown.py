@@ -13,9 +13,26 @@ import shutil
 from tritonk8ssupervisor_tpu.cli.io import Prompter
 from tritonk8ssupervisor_tpu.config.schema import ClusterConfig
 from tritonk8ssupervisor_tpu.provision import ansible as ansible_mod
+from tritonk8ssupervisor_tpu.provision import journal as journal_mod
 from tritonk8ssupervisor_tpu.provision import runner as run_mod
 from tritonk8ssupervisor_tpu.provision import terraform as terraform_mod
-from tritonk8ssupervisor_tpu.provision.state import ClusterHosts, RunPaths
+from tritonk8ssupervisor_tpu.provision.state import (
+    ClusterHosts,
+    MissingStateError,
+    RunPaths,
+)
+
+
+def _recorded_hosts(paths: RunPaths) -> ClusterHosts | None:
+    """hosts.json when present AND readable. Teardown must stay runnable
+    over any partial-clean residue: a truncated hosts record means no IPs
+    to list/scrub, never an abort that strands the remaining state."""
+    if not paths.hosts_file.exists():
+        return None
+    try:
+        return ClusterHosts.load(paths.hosts_file)
+    except MissingStateError:
+        return None
 
 
 def clean(
@@ -49,19 +66,25 @@ def clean(
         doomed_modes.add(config.mode)
     for mode in sorted(doomed_modes):
         terraform_mod.destroy_mode(mode, paths, run)
-    if not doomed_modes and paths.hosts_file.exists():
-        # No tfstate anywhere but host IPs are on record: nothing was
-        # actually destroyed — say so loudly before the scrub deletes the
-        # last record of possibly-live resources.
-        hosts = ClusterHosts.load(paths.hosts_file)
-        prompter.say(
-            "WARNING: no terraform state found — nothing was destroyed. "
-            "Hosts recorded at: " + ", ".join(hosts.flat_ips) + ". "
-            "If they still exist, delete them manually, e.g. "
-            "`gcloud compute tpus tpu-vm delete <name> --zone <zone>`."
-        )
+    if not doomed_modes:
+        hosts = _recorded_hosts(paths)
+        if hosts is not None and hosts.flat_ips:
+            # No tfstate anywhere but host IPs are on record: nothing was
+            # actually destroyed — say so loudly before the scrub deletes
+            # the last record of possibly-live resources.
+            prompter.say(
+                "WARNING: no terraform state found — nothing was destroyed. "
+                "Hosts recorded at: " + ", ".join(hosts.flat_ips) + ". "
+                "If they still exist, delete them manually, e.g. "
+                "`gcloud compute tpus tpu-vm delete <name> --zone <zone>`."
+            )
     _scrub_known_hosts(paths, run)
     _remove_generated_state(config, paths)
+    # The journal goes LAST: every earlier step is individually idempotent
+    # (unlink missing_ok, destroy keyed off tfstate existence), so a clean
+    # that crashes anywhere above leaves the ledger behind and the re-run
+    # simply does the remaining work — a crashed clean is itself resumable.
+    journal_mod.Journal(paths.journal).scrub()
     prompter.say("Clean. Re-run ./setup.sh to provision again.")
     return True
 
@@ -83,8 +106,8 @@ def _describe_doomed(config: ClusterConfig | None, paths: RunPaths) -> list[str]
             f"orphaned terraform state: {', '.join(modes)} "
             "(config file missing; destroying from state)"
         ]
-    if paths.hosts_file.exists():
-        hosts = ClusterHosts.load(paths.hosts_file)
+    hosts = _recorded_hosts(paths)
+    if hosts is not None:
         for ip in hosts.flat_ips:
             lines.append(f"TPU host {ip}")
         if hosts.gke_endpoint:
@@ -97,9 +120,9 @@ def _describe_doomed(config: ClusterConfig | None, paths: RunPaths) -> list[str]
 def _scrub_known_hosts(paths: RunPaths, run: run_mod.RunFn) -> None:
     """ssh-keygen -R per host IP (setup.sh:504-508) so re-provisioned VMs
     with recycled IPs don't trip host-key verification."""
-    if not paths.hosts_file.exists():
+    hosts = _recorded_hosts(paths)
+    if hosts is None:
         return
-    hosts = ClusterHosts.load(paths.hosts_file)
     for ip in hosts.flat_ips:
         try:
             run(["ssh-keygen", "-R", ip])
@@ -120,6 +143,7 @@ def _remove_generated_state(config: ClusterConfig | None, paths: RunPaths) -> No
             paths.terraform_module(mode) / ".terraform", ignore_errors=True
         )
     paths.hosts_file.unlink(missing_ok=True)
+    paths.quarantine_file.unlink(missing_ok=True)
     paths.inventory.unlink(missing_ok=True)
     (paths.ansible_dir / "group_vars" / "all.yml").unlink(missing_ok=True)
     shutil.rmtree(paths.ansible_dir / "roles" / "tpuhost" / "files", ignore_errors=True)

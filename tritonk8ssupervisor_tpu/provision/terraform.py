@@ -127,6 +127,54 @@ def apply(
     return hosts
 
 
+def slice_replace_addresses(slice_indices: list[int]) -> list[str]:
+    """Terraform `-replace=` addresses for the named slice instances of
+    the tpu-vm module's count fan-out (`google_tpu_v2_vm.slice`)."""
+    return [f"-replace=google_tpu_v2_vm.slice[{i}]"
+            for i in sorted(set(slice_indices))]
+
+
+def apply_slices(
+    config: ClusterConfig,
+    paths: RunPaths,
+    slice_indices: list[int],
+    run: run_mod.RunFn = run_mod.run_streaming,
+    run_quiet: run_mod.RunFn = run_mod.run_capture,
+) -> ClusterHosts:
+    """Heal-scoped converge: re-create ONLY the named slices.
+
+    Terraform's plan is already a no-op for healthy resources, but a
+    slice that is unreachable yet still in the state file would no-op
+    too — `-replace=` (the taint successor) forces destroy+create for
+    exactly the quarantined slice addresses while every healthy slice's
+    state entry is left untouched. tpu-vm only: GKE slice repair is the
+    node pool's auto-repair job (terraform/gke/main.tf), not ours.
+    """
+    if config.mode != "tpu-vm":
+        raise ConfigError(
+            "slice-scoped apply is a tpu-vm operation; gke node pools "
+            "self-repair (management.auto_repair)"
+        )
+    if not slice_indices:
+        raise ValueError("apply_slices needs at least one slice index")
+    module_dir = paths.terraform_module(config.mode)
+    precheck(config, paths)
+    compiler.write_tfvars(config, paths.terraform_dir)
+    env = terraform_env(paths)
+    if init_needed(config, paths):
+        run(["terraform", "init", "-input=false", "-no-color"],
+            cwd=module_dir, env=env)
+    run(
+        ["terraform", "apply", "-auto-approve", "-input=false", "-no-color"]
+        + slice_replace_addresses(slice_indices),
+        cwd=module_dir,
+        env=env,
+    )
+    hosts = collect_outputs(config, paths, run_quiet)
+    hosts.save(paths.hosts_file)  # atomic rewrite (state.atomic_write_text)
+    return hosts
+
+
 def collect_outputs(
     config: ClusterConfig,
     paths: RunPaths,

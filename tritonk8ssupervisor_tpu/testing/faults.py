@@ -23,12 +23,20 @@ Plan shape (a bare list is accepted too)::
          "output": "Error: googleapi: Error 429: Too Many Requests"},
         {"match": "kubectl get nodes", "after": 1, "times": 1,
          "output": "Unable to connect to the server: net/http: TLS handshake timeout"},
-        {"match": "ansible-playbook", "times": 1, "hang": true}
+        {"match": "ansible-playbook", "times": 1, "hang": true},
+        {"match": "terraform apply", "kill": true}
     ]}
 
 `match` is a regex searched against the joined command line. The first
 rule whose pattern matches OWNS the invocation: its counter advances,
 and the call fails iff the count is within [after, after+times).
+
+`kill: true` is the crash-drill kind: instead of a failing child command
+it raises `SupervisorKilled` (a BaseException — nothing retries or
+records it), simulating SIGKILL of the supervisor at exactly that
+invocation. Paired with the durable journal (provision/journal.py) it
+drives the kill→resume drills: provision dies mid-DAG, the re-run skips
+the journal-verified prefix and redoes only the dirty suffix.
 """
 
 from __future__ import annotations
@@ -53,6 +61,15 @@ class FaultPlanError(ValueError):
     reason to fall back to fault-free execution silently."""
 
 
+class SupervisorKilled(BaseException):
+    """Deterministic stand-in for SIGKILL-ing the supervisor mid-task
+    (the `kill` fault kind). A BaseException on purpose: nothing may
+    catch-and-handle it on the way out — no retry, no journal `failed`
+    record — because a real SIGKILL runs no handlers either. The crash
+    drills (bench_provision.py --resilience, the chaos kill-resume test)
+    catch it at top level and then resume from the journal."""
+
+
 @dataclasses.dataclass
 class FaultRule:
     match: str  # regex searched against the joined command line
@@ -62,10 +79,11 @@ class FaultRule:
     output: str = "fault injected"
     hang: bool = False  # consume the call's timeout budget, then rc 124
     hang_seconds: float = 3600.0  # hang length when the call has no timeout
+    kill: bool = False  # simulate SIGKILL of the whole supervisor here
     seen: int = dataclasses.field(default=0, init=False)  # matches so far
 
     _KNOWN = ("match", "times", "after", "rc", "output", "hang",
-              "hang_seconds")
+              "hang_seconds", "kill")
 
     @classmethod
     def from_dict(cls, raw: dict) -> "FaultRule":
@@ -121,52 +139,73 @@ class FaultPlan:
             )
         return cls([FaultRule.from_dict(r) for r in data], **kwargs)
 
+    def _claim(self, line: str) -> tuple[FaultRule, int] | None:
+        """Atomically find the owning rule, advance its counter, and
+        decide whether this invocation fires. The slow parts (hang
+        sleeps, raising) happen OUTSIDE the lock so concurrent
+        unmatched commands never serialize behind an injected hang."""
+        with self._lock:
+            for rule in self.rules:
+                if not re.search(rule.match, line):
+                    continue
+                nth = rule.seen
+                rule.seen += 1
+                if not (rule.after <= nth < rule.after + rule.times):
+                    return None  # owns the call but lets it through
+                self.injected.append(
+                    {"match": rule.match, "command": line, "nth": nth,
+                     "rc": 124 if rule.hang else rule.rc,
+                     "hang": rule.hang, "kill": rule.kill}
+                )
+                return rule, nth
+            return None
+
+    def fire(self, args, timeout: float | None = None) -> None:
+        """Consult the plan for one invocation and raise if a rule owns
+        it. `args` is a command argv OR a bare string — the latter is
+        what task-level injection points use (the DAG drills match task
+        NAMES, not child command lines: a `kill` rule on
+        "host-configuration" dies when that task starts, no subprocess
+        required). Returning without raising means "not this one"."""
+        if isinstance(args, str):
+            line, argv = args, [args]
+        else:
+            argv = list(args)
+            line = " ".join(str(a) for a in argv)
+        fired = self._claim(line)
+        if fired is None:
+            return
+        rule, nth = fired
+        if rule.kill:
+            self.echo(
+                f"FAULT-INJECT: SIGKILL(simulated) at {line!r} "
+                f"(match {rule.match!r}, occurrence {nth})"
+            )
+            raise SupervisorKilled(f"supervisor killed at {line!r}")
+        if rule.hang:
+            budget = timeout or rule.hang_seconds
+            self.echo(f"FAULT-INJECT: hanging {line!r} for {budget:.0f}s")
+            self.sleep(budget)
+            raise CommandError(
+                argv, 124,
+                tail=f"fault-injected hang killed after {budget:.0f}s",
+            )
+        self.echo(
+            f"FAULT-INJECT: rc={rule.rc} for {line!r} "
+            f"(match {rule.match!r}, occurrence {nth})"
+        )
+        raise CommandError(argv, rule.rc, tail=rule.output)
+
     def wrap(self, run: RunFn) -> RunFn:
         """The RunFn decorator. Sits UNDER the retry wrapper in the
         cli's composition so injected failures exercise exactly the
-        classify/backoff path real ones take."""
-
-        def claim(line: str) -> tuple[FaultRule, int] | None:
-            """Atomically find the owning rule, advance its counter, and
-            decide whether this invocation fires. The slow parts (hang
-            sleeps, raising) happen OUTSIDE the lock so concurrent
-            unmatched commands never serialize behind an injected hang."""
-            with self._lock:
-                for rule in self.rules:
-                    if not re.search(rule.match, line):
-                        continue
-                    nth = rule.seen
-                    rule.seen += 1
-                    if not (rule.after <= nth < rule.after + rule.times):
-                        return None  # owns the call but lets it through
-                    self.injected.append(
-                        {"match": rule.match, "command": line, "nth": nth,
-                         "rc": 124 if rule.hang else rule.rc,
-                         "hang": rule.hang}
-                    )
-                    return rule, nth
-                return None
+        classify/backoff path real ones take. A `kill` rule's
+        SupervisorKilled is a BaseException, so it sails PAST the retry
+        engine and the scheduler's journal `failed` hook — the process
+        'dies' with only the fsync'd `running` record on disk."""
 
         def faulty(args, **kwargs) -> str:
-            line = " ".join(str(a) for a in args)
-            fired = claim(line)
-            if fired is not None:
-                rule, nth = fired
-                if rule.hang:
-                    budget = kwargs.get("timeout") or rule.hang_seconds
-                    self.echo(
-                        f"FAULT-INJECT: hanging {line!r} for {budget:.0f}s"
-                    )
-                    self.sleep(budget)
-                    raise CommandError(
-                        args, 124,
-                        tail=f"fault-injected hang killed after {budget:.0f}s",
-                    )
-                self.echo(
-                    f"FAULT-INJECT: rc={rule.rc} for {line!r} "
-                    f"(match {rule.match!r}, occurrence {nth})"
-                )
-                raise CommandError(args, rule.rc, tail=rule.output)
+            self.fire(args, timeout=kwargs.get("timeout"))
             return run(args, **kwargs)
 
         return faulty

@@ -65,6 +65,8 @@ class PhaseTimer:
             if record.get("attempts", 1) > 1 else ""
         if status == "start":
             line = f"==> {phase}"
+        elif status == "skipped":
+            line = f"==> {phase} skipped (journal-verified, resumed)"
         elif status == "done":
             line = f"==> {phase} done in {record['seconds']:.1f}s{retried}"
         else:
@@ -74,6 +76,18 @@ class PhaseTimer:
             if self._logfile is not None:
                 with self._logfile.open("a") as f:
                     f.write(json.dumps(record, sort_keys=True) + "\n")
+
+    def note_skip(self, name: str, after: Iterable[str] = ()) -> None:
+        """Record a phase the scheduler resolved WITHOUT running it — a
+        journal-verified resume skip (provision/journal.py). Zero seconds,
+        status "skipped": the runlog of a resumed run shows what was
+        reused, and the budget table can report redo-vs-reuse honestly
+        instead of a resumed run looking impossibly fast."""
+        now = self._clock()
+        deps = {"after": sorted(after)} if after else {}
+        self._emit({"ts": self._wall(), "phase": name, "status": "skipped",
+                    "seconds": 0.0, "t_start": round(now - self._t0, 3),
+                    "t_end": round(now - self._t0, 3), **deps})
 
     def note_retry(self, cause: str) -> None:
         """Record one retried attempt against the phase open in THIS
@@ -196,6 +210,21 @@ PHASE_BUDGETS: dict[str, float] = {
 }
 TOTAL_BUDGET_SECONDS = 900.0  # the BASELINE.md north star
 
+# Slice-granular repair (provision/heal.py) is a SEPARATE run from
+# provision, so its budgets live outside the 900 s sum invariant above
+# (a provision run never executes heal phases and vice versa). The
+# per-phase ceilings still matter: a single-slice heal must beat a cold
+# re-provision by construction — the scoped terraform replace skips the
+# control-plane/other-slice work, ansible runs with --limit, readiness
+# polls only the healed hosts. These sum to 630 s vs the 800 s the
+# provision chain would pay to redo everything.
+HEAL_PHASE_BUDGETS: dict[str, float] = {
+    "heal-diagnose": 30.0,
+    "heal-apply": 300.0,
+    "heal-configure": 180.0,
+    "heal-readiness": 120.0,
+}
+
 
 def _critical_path(rows: dict[str, dict]) -> list[str]:
     """Longest dependency chain by summed phase seconds, over the `after`
@@ -245,14 +274,17 @@ def analyze_runlog(path: Path) -> list[dict]:
         if not line.strip():
             continue
         record = json.loads(line)
-        if record.get("status") not in ("done", "failed"):
+        if record.get("status") not in ("done", "failed", "skipped"):
             continue
         name = record["phase"]
         row = rows.setdefault(
-            name, {"phase": name, "seconds": 0.0, "status": "done",
+            name, {"phase": name, "seconds": 0.0,
+                   "status": record["status"],
                    "retries": 0, "after": [], "t_start": None,
                    "t_end": None}
         )
+        if record["status"] == "done" and row["status"] == "skipped":
+            row["status"] = "done"
         row["seconds"] += float(record.get("seconds", 0.0))
         row["retries"] += max(0, int(record.get("attempts", 1)) - 1)
         for dep in record.get("after", []):
@@ -269,7 +301,9 @@ def analyze_runlog(path: Path) -> list[dict]:
     on_path = set(_critical_path(rows))
     out = []
     for row in rows.values():
-        budget = PHASE_BUDGETS.get(row["phase"])
+        budget = PHASE_BUDGETS.get(
+            row["phase"], HEAL_PHASE_BUDGETS.get(row["phase"])
+        )
         row["budget"] = budget
         row["over"] = budget is not None and row["seconds"] > budget
         row["crit"] = row["phase"] in on_path
@@ -299,6 +333,7 @@ def format_runlog_report(rows: list[dict]) -> str:
         total += row["seconds"]
         budget = "-" if row["budget"] is None else f"{row['budget']:.0f}"
         verdict = ("FAILED" if row["status"] == "failed"
+                   else "skipped (resumed)" if row["status"] == "skipped"
                    else "OVER-BUDGET" if row["over"] else "ok")
         crit = ("*" if row.get("crit") else "") if any_crit else "-"
         retries = row.get("retries", 0)
