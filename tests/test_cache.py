@@ -1,0 +1,280 @@
+"""Content-addressed warm path (provision/cache.py): key construction,
+the invalidation matrix — mutating a manifest input, an inventory entry,
+or a role file flips exactly the affected tasks to dirty and nothing
+else — and the shared cache-aware converge unit (ansible.converge_slice)
+both provision and heal execute."""
+
+import json
+
+from tritonk8ssupervisor_tpu.config.schema import ClusterConfig
+from tritonk8ssupervisor_tpu.provision import ansible as ansible_mod
+from tritonk8ssupervisor_tpu.provision import cache as cache_mod
+from tritonk8ssupervisor_tpu.provision import journal as journal_mod
+from tritonk8ssupervisor_tpu.provision.cache import WarmCache
+from tritonk8ssupervisor_tpu.provision.state import ClusterHosts, RunPaths
+
+
+def cfg(**overrides):
+    base = dict(project="p", zone="us-west4-a", generation="v5e",
+                topology="4x4", mode="tpu-vm", num_slices=2)
+    base.update(overrides)
+    return ClusterConfig(**base)
+
+
+INVENTORY = """\
+[TPUHOST]
+10.0.0.1 slice_index=0 process_id=0 slice_coordinator=10.1.0.1 global_coordinator=10.1.0.1
+10.0.1.1 slice_index=1 process_id=0 slice_coordinator=10.1.1.1 global_coordinator=10.1.0.1
+
+[TPUHOST:vars]
+ansible_python_interpreter=/usr/bin/python3
+
+[LOCAL]
+localhost ansible_connection=local
+"""
+
+
+def seed_world(tmp_path):
+    """A workdir with an ansible tree + inventory + compiled manifests —
+    the full input surface of the converge/compile content keys."""
+    paths = RunPaths(tmp_path)
+    (paths.ansible_dir / "roles" / "tpuhost" / "tasks").mkdir(parents=True)
+    (paths.ansible_dir / "group_vars").mkdir()
+    (paths.ansible_dir / "clusterUp.yml").write_text("- hosts: TPUHOST\n")
+    (paths.ansible_dir / "roles" / "tpuhost" / "tasks" / "main.yml"
+     ).write_text("- name: install\n")
+    (paths.ansible_dir / "group_vars" / "all.yml").write_text("chips: 16\n")
+    (paths.ansible_dir / "ansible.cfg").write_text("[defaults]\n")
+    paths.inventory.write_text(INVENTORY)
+    paths.manifests_dir.mkdir(parents=True)
+    (paths.manifests_dir / "bench-job-0.yaml").write_text("kind: Job\n")
+    return paths
+
+
+def converge_keys(paths):
+    return {
+        i: cache_mod.converge_key(paths, i, [f"10.0.{i}.1"],
+                                  ssh_key="/k", ansible_user="u")
+        for i in (0, 1)
+    }
+
+
+def record_all(paths, cache, manifest_key):
+    keys = converge_keys(paths)
+    cache.record("compile-manifests", manifest_key,
+                 artifacts=(paths.manifests_dir,))
+    for i, key in keys.items():
+        cache.record(f"configure-slice-{i}", key)
+    return keys
+
+
+def freshness(paths, cache, manifest_key):
+    """{task: fresh?} for the three cached tasks, with keys recomputed
+    from CURRENT disk content — exactly what a warm re-run would do."""
+    keys = converge_keys(paths)
+    return {
+        "compile-manifests": cache.fresh(
+            "compile-manifests", manifest_key,
+            artifacts=(paths.manifests_dir,)),
+        "configure-slice-0": cache.fresh("configure-slice-0", keys[0]),
+        "configure-slice-1": cache.fresh("configure-slice-1", keys[1]),
+    }
+
+
+# ------------------------------------------------- the invalidation matrix
+
+
+def test_untouched_world_is_fully_warm(tmp_path):
+    paths = seed_world(tmp_path)
+    cache = WarmCache(paths.warm_cache)
+    manifest_key = journal_mod.inputs_hash("compile-manifests", {"t": "4x4"})
+    record_all(paths, cache, manifest_key)
+    assert freshness(paths, cache, manifest_key) == {
+        "compile-manifests": True,
+        "configure-slice-0": True,
+        "configure-slice-1": True,
+    }
+
+
+def test_manifest_input_mutation_dirties_only_compile(tmp_path):
+    paths = seed_world(tmp_path)
+    cache = WarmCache(paths.warm_cache)
+    manifest_key = journal_mod.inputs_hash("compile-manifests", {"t": "4x4"})
+    record_all(paths, cache, manifest_key)
+    # the operator changes a Job knob -> a NEW manifest key
+    mutated_key = journal_mod.inputs_hash(
+        "compile-manifests", {"t": "4x4", "workload": "lm"}
+    )
+    got = freshness(paths, cache, mutated_key)
+    assert got == {
+        "compile-manifests": False,
+        "configure-slice-0": True,
+        "configure-slice-1": True,
+    }
+
+
+def test_hand_edited_manifest_dirties_compile_despite_same_key(tmp_path):
+    """Content over history: the recorded artifact digest must match the
+    disk, or the warm hit is refused."""
+    paths = seed_world(tmp_path)
+    cache = WarmCache(paths.warm_cache)
+    manifest_key = journal_mod.inputs_hash("compile-manifests", {"t": "4x4"})
+    record_all(paths, cache, manifest_key)
+    (paths.manifests_dir / "bench-job-0.yaml").write_text("kind: Hacked\n")
+    got = freshness(paths, cache, manifest_key)
+    assert got["compile-manifests"] is False
+    assert got["configure-slice-0"] and got["configure-slice-1"]
+
+
+def test_inventory_entry_mutation_dirties_only_that_slice(tmp_path):
+    """A replaced host line (slice 1 got a new IP) dirties slice 1's
+    converge and NOTHING else — the per-slice inventory view is the key
+    input, not the whole file."""
+    paths = seed_world(tmp_path)
+    cache = WarmCache(paths.warm_cache)
+    manifest_key = journal_mod.inputs_hash("compile-manifests", {"t": "4x4"})
+    record_all(paths, cache, manifest_key)
+    paths.inventory.write_text(INVENTORY.replace(
+        "10.0.1.1 slice_index=1", "10.0.1.99 slice_index=1"
+    ))
+    assert freshness(paths, cache, manifest_key) == {
+        "compile-manifests": True,
+        "configure-slice-0": True,
+        "configure-slice-1": False,
+    }
+
+
+def test_global_inventory_line_dirties_every_slice(tmp_path):
+    """Lines without a slice tag ([TPUHOST:vars] etc.) are global inputs:
+    changing one dirties every slice's converge, but never the compile."""
+    paths = seed_world(tmp_path)
+    cache = WarmCache(paths.warm_cache)
+    manifest_key = journal_mod.inputs_hash("compile-manifests", {"t": "4x4"})
+    record_all(paths, cache, manifest_key)
+    paths.inventory.write_text(INVENTORY.replace(
+        "ansible_python_interpreter=/usr/bin/python3",
+        "ansible_python_interpreter=/usr/bin/python3.12",
+    ))
+    assert freshness(paths, cache, manifest_key) == {
+        "compile-manifests": True,
+        "configure-slice-0": False,
+        "configure-slice-1": False,
+    }
+
+
+def test_role_file_mutation_dirties_every_converge_not_compile(tmp_path):
+    paths = seed_world(tmp_path)
+    cache = WarmCache(paths.warm_cache)
+    manifest_key = journal_mod.inputs_hash("compile-manifests", {"t": "4x4"})
+    record_all(paths, cache, manifest_key)
+    (paths.ansible_dir / "roles" / "tpuhost" / "tasks" / "main.yml"
+     ).write_text("- name: install\n- name: new step\n")
+    assert freshness(paths, cache, manifest_key) == {
+        "compile-manifests": True,
+        "configure-slice-0": False,
+        "configure-slice-1": False,
+    }
+
+
+def test_ansible_cfg_and_retry_files_are_not_role_tree_inputs(tmp_path):
+    """ansible.cfg churns with the patched SSH key path (the key is part
+    of converge_key directly) and *.retry files are failure residue —
+    neither may fake a dirty converge."""
+    paths = seed_world(tmp_path)
+    before = cache_mod.role_tree_hash(paths.ansible_dir)
+    (paths.ansible_dir / "ansible.cfg").write_text(
+        "[defaults]\nprivate_key_file = /new/key\n"
+    )
+    (paths.ansible_dir / "clusterUp.retry").write_text("10.0.0.1\n")
+    assert cache_mod.role_tree_hash(paths.ansible_dir) == before
+
+
+def test_ssh_identity_is_part_of_the_converge_key(tmp_path):
+    paths = seed_world(tmp_path)
+    a = cache_mod.converge_key(paths, 0, ["10.0.0.1"],
+                               ssh_key="/k", ansible_user="u")
+    assert a != cache_mod.converge_key(paths, 0, ["10.0.0.1"],
+                                       ssh_key="/other", ansible_user="u")
+    assert a != cache_mod.converge_key(paths, 0, ["10.0.0.1"],
+                                       ssh_key="/k", ansible_user="v")
+
+
+# ------------------------------------------------------------ store basics
+
+
+def test_corrupt_store_reads_cold_never_raises(tmp_path):
+    paths = seed_world(tmp_path)
+    paths.warm_cache.write_text('{"configure-slice-0": {"key": trunc')
+    cache = WarmCache(paths.warm_cache)
+    assert cache.fresh("configure-slice-0", "anything") is False
+    cache.record("configure-slice-0", "k1")  # rewrites the store whole
+    assert cache.fresh("configure-slice-0", "k1") is True
+
+
+def test_invalidate_one_task_and_whole_store(tmp_path):
+    paths = seed_world(tmp_path)
+    cache = WarmCache(paths.warm_cache)
+    cache.record("a", "k1")
+    cache.record("b", "k2")
+    cache.invalidate("a")
+    assert not cache.fresh("a", "k1") and cache.fresh("b", "k2")
+    cache.invalidate()
+    assert not cache.fresh("b", "k2")
+    assert not paths.warm_cache.exists()
+
+
+def test_store_writes_are_atomic_no_temp_residue(tmp_path):
+    paths = seed_world(tmp_path)
+    WarmCache(paths.warm_cache).record("a", "k")
+    assert json.loads(paths.warm_cache.read_text())["a"]["key"] == "k"
+    assert not list(tmp_path.glob(".*.tmp"))
+
+
+# ------------------------------------------- the shared converge unit
+
+
+def test_converge_slice_runs_then_warm_skips_then_redirties(tmp_path):
+    paths = seed_world(tmp_path)
+    cache = WarmCache(paths.warm_cache)
+    hosts = ClusterHosts(host_ips=[["10.0.0.1"], ["10.0.1.1"]],
+                         internal_ips=[["10.1.0.1"], ["10.1.1.1"]],
+                         coordinator_ip="10.1.0.1")
+    calls = []
+
+    def run(args, cwd=None, **kwargs):
+        calls.append(" ".join(str(a) for a in args))
+        return ""
+
+    ran = ansible_mod.converge_slice(
+        cfg(), paths, hosts, 0, run=run, cache=cache,
+        ssh_key="/k", ssh_user="u", echo=lambda line: None,
+    )
+    assert ran is True
+    assert calls == [
+        "ansible-playbook -i hosts clusterUp.yml --limit 10.0.0.1"
+    ]
+    # warm: same content -> no ansible
+    assert ansible_mod.converge_slice(
+        cfg(), paths, hosts, 0, run=run, cache=cache,
+        ssh_key="/k", ssh_user="u", echo=lambda line: None,
+    ) is False
+    assert len(calls) == 1
+    # a role edit dirties it again
+    (paths.ansible_dir / "group_vars" / "all.yml").write_text("chips: 32\n")
+    assert ansible_mod.converge_slice(
+        cfg(), paths, hosts, 0, run=run, cache=cache,
+        ssh_key="/k", ssh_user="u", echo=lambda line: None,
+    ) is True
+    assert len(calls) == 2
+
+
+def test_converge_slice_empty_slice_is_a_noop(tmp_path):
+    paths = seed_world(tmp_path)
+    hosts = ClusterHosts(host_ips=[[]], internal_ips=[[]])
+    calls = []
+    assert ansible_mod.converge_slice(
+        cfg(num_slices=1), paths, hosts, 0,
+        run=lambda *a, **k: calls.append(a),
+        cache=WarmCache(paths.warm_cache), echo=lambda line: None,
+    ) is False
+    assert calls == []

@@ -196,6 +196,50 @@ def test_heal_repairs_only_the_broken_slice(tmp_path):
     assert "fleet fully healthy" in say.text().lower()
 
 
+def test_heal_reuses_warm_cache_for_healthy_slices(tmp_path):
+    """The PR-4 acceptance bullet: heal of one lost slice runs ONLY that
+    slice's converge — the healthy slices' warm-cache entries are left
+    byte-identical (a later provision run warm-skips them), while the
+    replaced slice gets a fresh entry under its new content key."""
+    import json as json_mod
+
+    from tritonk8ssupervisor_tpu.provision.cache import WarmCache
+
+    paths, hosts = seed_world(tmp_path)
+    hosts.host_ips[1] = []
+    hosts.internal_ips[1] = []
+    hosts.save(paths.hosts_file)
+    cache = WarmCache(paths.warm_cache)
+    cache.record("configure-slice-0", "prior-key-0")
+    cache.record("configure-slice-1", "prior-key-1")  # the doomed slice
+    cache.record("configure-slice-2", "prior-key-2")
+    world = HealWorld(paths)
+    assert heal_mod.heal(
+        cfg(), paths, Say(), run=world.run, run_quiet=world.run_quiet,
+        readiness_timeout=10.0, sleep=lambda s: None,
+    ) is True
+    plays = [c for c in world.calls if c.startswith("ansible-playbook")]
+    assert len(plays) == 1 and f"--limit {world.new_ip}" in plays[0]
+    store = json_mod.loads(paths.warm_cache.read_text())
+    # healthy entries untouched, the replaced slice re-keyed
+    assert store["configure-slice-0"]["key"] == "prior-key-0"
+    assert store["configure-slice-2"]["key"] == "prior-key-2"
+    assert store["configure-slice-1"]["key"] not in (
+        "prior-key-1", "", None
+    )
+
+
+def test_heal_shares_one_tpu_vm_listing_for_diagnosis(tmp_path):
+    """Satellite: the diagnosis consumes the run's shared FleetSnapshot
+    — exactly ONE `tpu-vm list` round-trip for a healthy-fleet heal."""
+    paths, _ = seed_world(tmp_path)
+    world = HealWorld(paths)
+    assert heal_mod.heal(cfg(), paths, Say(), run=world.run,
+                         run_quiet=world.run_quiet) is True
+    listings = [c for c in world.calls if "tpu-vm list" in c]
+    assert len(listings) == 1
+
+
 def test_heal_healthy_fleet_is_a_noop(tmp_path):
     paths, _ = seed_world(tmp_path)
     world = HealWorld(paths)

@@ -258,6 +258,72 @@ def test_task_without_inputs_hash_never_skips(tmp_path):
     assert ran == ["probe", "probe"]
 
 
+# -------------------------------------------------------------- compaction
+
+
+def test_compaction_folds_history_and_still_resumes(tmp_path):
+    """After heal cycles / repeated converges the append-only ledger
+    grows without bound; compact() rewrites it to one record per task
+    (atomic temp+replace) and a compacted journal must resume exactly
+    like the full one — verified skips, artifact-drift dirtying, all of
+    it."""
+    ran: list = []
+    tasks = [
+        make_task("a", ran, tmp_path),
+        make_task("b", ran, tmp_path, after=("a",)),
+    ]
+    with quiet_journal(tmp_path) as j:
+        quiet_dag(tasks, journal=j)
+    # artifact drift forces a full re-run -> the ledger accumulates
+    # running/done history for every task
+    (tmp_path / "artifacts" / "a.out").write_text("drifted\n")
+    with quiet_journal(tmp_path) as j:
+        quiet_dag(tasks, journal=j)
+        before = len([l for l in j.path.read_text().splitlines()
+                      if l.strip()])
+        dropped = j.compact()
+        records = [json.loads(l)
+                   for l in j.path.read_text().splitlines()]
+    assert before == 8  # 2 tasks x 2 runs x (running + done)
+    assert dropped == before - len(records) and dropped > 0
+    assert [r["task"] for r in records] == ["a", "b"]
+    assert all(r["status"] == "done" for r in records)
+    assert all(r["artifacts"] for r in records)  # digests survive
+    assert not list(tmp_path.glob("*.tmp"))  # atomic: no temp residue
+
+    # the compacted snapshot still resumes: nothing re-runs...
+    ran.clear()
+    with quiet_journal(tmp_path) as j:
+        results = quiet_dag(tasks, journal=j)
+    assert ran == []
+    assert results == {"a": "a (restored)", "b": "b (restored)"}
+    # ...and artifact drift still dirties the suffix
+    (tmp_path / "artifacts" / "b.out").write_text("drifted again\n")
+    ran.clear()
+    with quiet_journal(tmp_path) as j:
+        quiet_dag(tasks, journal=j)
+    assert ran == ["b"]
+
+
+def test_compaction_preserves_crash_signature_and_failures(tmp_path):
+    """compact() is history-folding, not history-laundering: a lingering
+    `running` record (the SIGKILL signature) and a last-status `failed`
+    survive as the task's final state."""
+    j = quiet_journal(tmp_path)
+    j.note_running("killed-task", "h1", 1)
+    j.note_running("flaky", "h2", 1)
+    j.note_failed("flaky", "h2", "exploded")
+    assert j.compact() == 1  # 3 records fold to 2 (one per task)
+    replayed = j.replay()
+    assert replayed["killed-task"].status == "running"
+    assert replayed["flaky"].status == "failed"
+    assert replayed["flaky"].errors == ["exploded"]
+
+
+def test_compact_missing_journal_is_noop(tmp_path):
+    assert quiet_journal(tmp_path).compact() == 0
+
+
 # ----------------------------------------------------- tier-1 resume smoke
 
 

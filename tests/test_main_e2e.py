@@ -136,18 +136,28 @@ def test_provision_then_clean_tpu_vm(fake_world, capsys):
     assert "10.0.0.1" in paths.inventory.read_text()
     assert (paths.manifests_dir / "bench-service.yaml").exists()
     assert "private_key_file = " in paths.ansible_cfg.read_text()
-    # phase timing recorded (north-star wall-clock, SURVEY.md §5)
+    # phase timing recorded (north-star wall-clock, SURVEY.md §5) — the
+    # tpu-vm pipeline is per-slice since the host-configuration split
     records = [json.loads(l) for l in paths.runlog.read_text().splitlines()]
     phases = [r["phase"] for r in records]
-    assert "terraform-apply" in phases and "readiness-wait" in phases
+    assert "terraform-apply" in phases and "readiness-slice-0" in phases
     # DAG metadata: spans + dependency edges land in the runlog so
     # `python -m ...utils.phases runlog.jsonl` can compute the critical
     # path (docs/performance.md)
     done = {r["phase"]: r for r in records if r.get("status") == "done"}
-    assert done["readiness-wait"]["after"] == ["terraform-apply"]
-    assert done["host-configuration"]["after"] == ["readiness-wait"]
+    assert done["readiness-slice-0"]["after"] == ["terraform-apply"]
+    # a slice's converge waits for ITS readiness + the shared prep, and
+    # nothing else — the per-slice pipeline's defining edge set
+    assert done["configure-slice-0"]["after"] == [
+        "host-prep", "readiness-slice-0"
+    ]
+    assert done["host-prep"]["after"] == ["terraform-apply"]
     assert "after" not in done["compile-manifests"]  # free to overlap
     assert all("t_start" in r and "t_end" in r for r in done.values())
+    # the converge ran scoped to this slice's hosts
+    limit_line = next(l for l in calls.splitlines()
+                      if l.startswith("ansible-playbook"))
+    assert "--limit 10.0.0.1,10.0.0.2" in limit_line
 
     out = capsys.readouterr().out
     assert "Cluster is ready" in out
@@ -192,7 +202,48 @@ def test_resume_detected_on_second_run(fake_world, capsys):
     records = [json.loads(l)
                for l in RunPaths(work).runlog.read_text().splitlines()]
     skipped = {r["phase"] for r in records if r.get("status") == "skipped"}
-    assert "terraform-apply" in skipped and "host-configuration" in skipped
+    assert "terraform-apply" in skipped and "configure-slice-0" in skipped
+    # a fully-green run compacts the ledger to one record per task —
+    # the snapshot the NEXT resume verifies against (atomic rewrite)
+    journal_records = [
+        json.loads(l)
+        for l in RunPaths(work).journal.read_text().splitlines()
+    ]
+    tasks_in_journal = [r["task"] for r in journal_records]
+    assert len(tasks_in_journal) == len(set(tasks_in_journal))
+    assert all(r["status"] == "done" for r in journal_records)
+
+
+def test_warm_rerun_without_journal_skips_converge_and_compile(
+    fake_world, capsys
+):
+    """The content-addressed warm path (provision/cache.py) is
+    independent of the journal: scrub the ledger, re-run, and the
+    converge + manifest compile are STILL no-ops — their content keys
+    (role tree, slice inventory view, endpoints, config) are unchanged —
+    while terraform re-converges normally."""
+    work, calls_log = fake_world
+    config_path = saved_config(work)
+    assert main(["--yes", "--config", str(config_path),
+                 "--workdir", str(work)]) == 0
+    paths = RunPaths(work)
+    paths.journal.unlink()  # the crash-resume evidence is gone...
+    capsys.readouterr()
+    assert main(["--yes", "--workdir", str(work)]) == 0
+    err = capsys.readouterr().err
+    calls = calls_log.read_text()
+    # ...so terraform re-applies (idempotent converge), but ansible and
+    # the manifest compile hit the warm cache and never run again
+    assert calls.count("terraform apply") == 2
+    assert calls.count("ansible-playbook -i hosts clusterUp.yml") == 1
+    assert "warm cache" in err
+    # mutate a role file: the slice's converge key changes -> ansible runs
+    (work / "ansible" / "clusterUp.yml").write_text("[]\n# edited\n")
+    paths.journal.unlink()
+    assert main(["--yes", "--workdir", str(work)]) == 0
+    assert calls_log.read_text().count(
+        "ansible-playbook -i hosts clusterUp.yml"
+    ) == 2
 
 
 def test_second_run_after_config_change_redoes_dirty_suffix(fake_world, capsys):
@@ -224,7 +275,7 @@ def test_kill_resume_drill_cli(fake_world, capsys):
     calls = calls_log.read_text()
     assert calls.count("terraform apply") == 1
     assert "ansible-playbook" not in calls  # died before the child ran
-    # the journal holds the crash signature: host-configuration `running`
+    # the journal holds the crash signature: configure-slice-0 `running`
     journal_lines = [
         json.loads(l)
         for l in RunPaths(work).journal.read_text().splitlines()
@@ -233,7 +284,7 @@ def test_kill_resume_drill_cli(fake_world, capsys):
     for r in journal_lines:
         by_task[r["task"]] = r["status"]
     assert by_task["terraform-apply"] == "done"
-    assert by_task["host-configuration"] == "running"
+    assert by_task["configure-slice-0"] == "running"
     # the lock was released on the way down (crash -> no live holder)
     capsys.readouterr()
 

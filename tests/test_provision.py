@@ -527,6 +527,76 @@ def test_poll_clamps_final_sleep_to_deadline():
     )
 
 
+def test_adaptive_poll_backs_off_while_stuck_and_resets_on_progress():
+    """Decorrelated-backoff polling: a repeating verdict grows the
+    interval toward max_interval (fewer probes against a slice that is
+    clearly minutes away), and the cadence snaps back to base the moment
+    the verdict changes — progress keeps the tail responsive."""
+    verdicts = ["booting", "booting", "booting", "ssh pending", ""]
+    sleeps = []
+    clock = {"t": 0.0}
+
+    def sleep(s):
+        sleeps.append(s)
+        clock["t"] += s
+
+    readiness.poll(
+        lambda: verdicts.pop(0), timeout=900.0, sleep=sleep,
+        echo=lambda line: None, clock=lambda: clock["t"],
+        adapt=readiness.AdaptiveInterval(base=5.0, max_interval=45.0,
+                                         rng=lambda: 1.0),
+    )
+    # first verdict: base; repeats: 5->15->45 (capped decorrelated
+    # growth); verdict change ("ssh pending"): reset to base
+    assert sleeps == [5.0, 15.0, 45.0, 5.0]
+
+
+def test_adaptive_interval_stays_within_bounds():
+    adapt = readiness.AdaptiveInterval(base=2.0, max_interval=15.0)
+    prev = adapt.base
+    for _ in range(20):
+        prev = adapt.next(prev)
+        assert 2.0 <= prev <= 15.0
+
+
+def test_fleet_snapshot_shares_one_listing_within_ttl():
+    """Satellite acceptance: N consumers inside one TTL window cost ONE
+    `tpu-vm list`; the TTL expiring (or invalidate()) refetches."""
+    config = cfg()
+    quiet = RecordingRunner(responses={("gcloud",): "n-0\tREADY\n"})
+    clock = {"t": 0.0}
+    snap = readiness.FleetSnapshot(config, run_quiet=quiet, ttl=10.0,
+                                   clock=lambda: clock["t"])
+    assert snap.states() == {"n-0": "READY"}
+    assert readiness.tpu_vm_probe(config, ["n-0"], snapshot=snap) == ""
+    assert snap.states() == {"n-0": "READY"}
+    assert len(quiet.calls) == 1 and snap.fetches == 1
+
+    clock["t"] = 11.0  # TTL lapsed: the next consumer refetches
+    snap.states()
+    assert len(quiet.calls) == 2
+
+    snap.invalidate()
+    snap.states()
+    assert len(quiet.calls) == 3
+
+
+def test_fleet_snapshot_failed_fetch_is_not_cached():
+    config = cfg()
+    state = {"fail": True}
+
+    def quiet(args, cwd=None, **kwargs):
+        if state["fail"]:
+            raise run_mod.CommandError(args, 1, tail="503")
+        return "n-0\tREADY\n"
+
+    snap = readiness.FleetSnapshot(config, run_quiet=quiet, ttl=1000.0)
+    with pytest.raises(run_mod.CommandError):
+        snap.states()
+    state["fail"] = False
+    assert snap.states() == {"n-0": "READY"}  # retried, not poisoned
+
+
 def test_run_streaming_timeout_kills_child_process_group():
     """A wedged child is killed (whole process group) and surfaces as
     rc 124 — the bench.py subprocess-probe lesson applied to

@@ -67,6 +67,70 @@ def test_fatal_patterns_beat_transient_mentions():
     assert got.verdict == retry.FATAL
 
 
+@pytest.mark.parametrize(
+    "tail",
+    [
+        # per-minute request quota, the 429 form gcloud/terraform surface
+        "Error 429: Quota exceeded for quota metric 'Read requests' "
+        "and limit 'Read requests per minute'",
+        # the gRPC form of the same throttle
+        "ERROR: (gcloud.compute.tpus) RESOURCE_EXHAUSTED: Quota exceeded",
+        "googleapi: Error 429: Too Many Requests, rateLimitExceeded",
+    ],
+)
+def test_quota_throttles_are_transient_with_long_backoff(tail):
+    """Pins the satellite verdict: HTTP 429 / RESOURCE_EXHAUSTED quota
+    errors are TRANSIENT (per-minute windows refill — unlike the fatal
+    resource-quota form) with a >= 30 s backoff floor, even though the
+    message mentions "quota"."""
+    got = retry.classify(err(tail))
+    assert got.verdict == retry.TRANSIENT
+    assert got.cause == "rate-limited"
+    assert got.min_delay == retry.QUOTA_BACKOFF_FLOOR == 30.0
+
+
+def test_resource_quota_without_throttle_marker_stays_fatal():
+    got = retry.classify(err(
+        "Error 403: Quota exceeded for quota metric "
+        "'TPUV5sLitePodPerProjectPerZone'"
+    ))
+    assert got.verdict == retry.FATAL
+    assert got.min_delay == 0.0
+
+
+def test_throttle_floor_applied_to_backoff_sleep():
+    """The runner sleeps at least the 30 s floor on a throttle — but the
+    policy's max_delay still caps it, so zeroed-delay drills stay
+    instant."""
+    sleeps = []
+    script = Script([err("Error 429: Too Many Requests")])
+    run = retry.retrying_runner(
+        script, retry.RetryPolicy(base_delay=0.5, max_delay=60.0),
+        sleep=sleeps.append, rng=lambda: 0.0, echo=lambda l: None,
+    )
+    assert run(["gcloud", "compute", "tpus"]) == "converged"
+    assert sleeps == [30.0]  # jitter said 0.5s; the floor won
+
+    sleeps.clear()
+    script = Script([err("Error 429: Too Many Requests")])
+    capped = retry.retrying_runner(
+        script, retry.RetryPolicy(base_delay=0.0, max_delay=0.0),
+        sleep=sleeps.append, rng=lambda: 0.0, echo=lambda l: None,
+    )
+    assert capped(["gcloud"]) == "converged"
+    assert sleeps == [0.0]  # operator-capped policy wins over the floor
+
+    # a plain connection fault keeps the ordinary jitter pace
+    sleeps.clear()
+    script = Script([err("connection reset")])
+    plain = retry.retrying_runner(
+        script, retry.RetryPolicy(base_delay=0.5, max_delay=60.0),
+        sleep=sleeps.append, rng=lambda: 0.0, echo=lambda l: None,
+    )
+    assert plain(["ssh"]) == "converged"
+    assert sleeps == [0.5]
+
+
 def test_classifier_reads_tail_not_command_line():
     """`-o ConnectTimeout=5` in the command must not read as a timeout."""
     e = CommandError(["ssh", "-o", "ConnectTimeout=5", "h", "true"], 2, tail="")

@@ -265,9 +265,12 @@ def test_provision_benchmark_dag_beats_sequential():
     assert result["sequential"]["wall_s"] == pytest.approx(
         result["sequential"]["work_s"]
     )
-    # critical path runs terraform -> one slice's probes -> ansible
+    # critical path runs terraform -> one slice's probes -> that
+    # slice's converge (the host-configuration barrier is gone)
     assert result["critical_path"][0] == "terraform-apply"
-    assert result["critical_path"][-1] == "host-configuration"
+    assert result["critical_path"][-1].startswith("configure-slice-")
+    # the pipelined shape beats the PR-2 barrier DAG too
+    assert result["dag"]["wall_s"] < result["barrier_dag"]["wall_s"]
 
 
 @pytest.mark.perf
@@ -281,6 +284,58 @@ def test_perf_smoke_critical_path_strictly_shorter_than_sum():
         assert result["dag"]["wall_s"] < result["sequential"]["wall_s"]
 
 
+@pytest.mark.perf
+def test_pipelined_cold_makespan_beats_barrier_and_target():
+    """The PR-4 tentpole acceptance: splitting the host-configuration
+    barrier into per-slice converges cuts the 4-slice cold makespan
+    below 480 s (the barrier DAG sat at 570 s), because one slice's
+    converge chain — not the whole fleet's — is the critical path."""
+    result = bench_provision.run_benchmark(num_slices=4)
+    assert result["barrier_dag"]["wall_s"] == pytest.approx(570.0)
+    assert result["dag"]["wall_s"] <= 480.0
+    assert result["pipeline_vs_barrier"] > 1.0
+
+
+@pytest.mark.perf
+def test_warm_rerun_under_ten_percent_of_cold_with_zero_converges():
+    """The warm-path acceptance: a no-op re-provision over a green
+    journal + cache executes NOTHING (zero converge tasks) and costs
+    <= 10% of the cold makespan (the digest-verification model)."""
+    warm = bench_provision.run_warm_drill(num_slices=4)
+    assert warm["warm_tasks_executed"] == 0
+    assert warm["warm_converge_tasks_executed"] == 0
+    assert warm["warm_ratio"] <= 0.10
+    assert warm["warm_wall_s"] < warm["cold_wall_s"]
+
+
+@pytest.mark.perf
+def test_bench_check_gate_passes_against_committed_baseline():
+    """Tier-1 perf-regression gate: the simulated makespans must stay
+    within 10% of the committed BENCH_provision.json. A DAG-edge or
+    cache regression trips this before it lands."""
+    assert bench_provision.main(["--check"]) == 0
+
+
+def test_bench_check_gate_fails_on_regression(tmp_path, capsys):
+    """The gate actually bites: against a baseline claiming far better
+    numbers than the model can produce, --check exits 1 and names the
+    regressed metric."""
+    baseline = tmp_path / "BENCH_provision.json"
+    baseline.write_text(json.dumps({
+        "num_slices": 4,
+        "dag": {"wall_s": 100.0},  # impossible: model floor is ~475s
+        "warm": {"warm_wall_s": 30.0},
+    }))
+    assert bench_provision.main(
+        ["--check", "--baseline", str(baseline)]
+    ) == 1
+    assert "REGRESSION" in capsys.readouterr().err
+    # a missing baseline is a loud failure, not a silent pass
+    assert bench_provision.main(
+        ["--check", "--baseline", str(tmp_path / "ghost.json")]
+    ) == 1
+
+
 def test_benchmark_json_document(tmp_path, capsys):
     out = tmp_path / "BENCH_provision.json"
     assert bench_provision.main(["--slices", "2", "--out", str(out)]) == 0
@@ -290,4 +345,18 @@ def test_benchmark_json_document(tmp_path, capsys):
     assert doc["value"] > 1.0
     assert "critical_path" in doc and doc["critical_path_s"] > 0
     assert "speedup" in doc["metric"] or "wall" in doc["metric"]
+    # cold-vs-warm lands in the same document (the acceptance record)
+    assert doc["warm"]["warm_converge_tasks_executed"] == 0
+    assert doc["warm"]["warm_ratio"] <= 0.10
     assert "provision" in capsys.readouterr().out
+
+
+def test_warm_benchmark_json_document(tmp_path, capsys):
+    out = tmp_path / "BENCH_warm.json"
+    assert bench_provision.main(
+        ["--warm", "--slices", "2", "--out", str(out)]
+    ) == 0
+    doc = json.loads(out.read_text())
+    assert doc["benchmark"] == "provision_warm"
+    assert doc["value"] == doc["warm_ratio"] <= 0.10
+    assert "warm re-provision" in capsys.readouterr().err

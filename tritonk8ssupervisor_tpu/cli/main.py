@@ -5,12 +5,24 @@ environment discovery → wizard → human verification gate → persist config 
 then the provisioning phases — terraform apply, host configuration
 (ansible), readiness wait, manifest compilation, probe job. Unlike the
 reference's strict line, the provisioning phases run as a dependency DAG
-(provision/scheduler.py): compile-manifests needs only the config and
-rides along terraform-apply/readiness-wait; everything else keeps its
-ordering edges. Every phase is timed with overlap-aware spans
-(utils/phases.py), since wall-clock-to-ready is the north-star metric
-and the DAG's makespan — not the sum of phases — is that number. See
-docs/performance.md for the graph and how to read the runlog.
+(provision/scheduler.py), and since PR 4 the tpu-vm pipeline is
+incremental along two axes:
+
+- **Per-slice pipelined convergence**: readiness and ansible run per
+  slice (`readiness-slice-N`, `configure-slice-N` after a short shared
+  `host-prep`), so slice 0 configures while slice 3 is still booting —
+  the old single `host-configuration` barrier waited for EVERY slice's
+  ssh before configuring ANY of them.
+- **Content-addressed warm path** (provision/cache.py): compile and
+  per-slice converge are no-ops when their content keys already
+  converged, and the durable journal (provision/journal.py) skips the
+  verified prefix on resume — provision, heal, and crash-resume share
+  one skip logic.
+
+Every phase is timed with overlap-aware spans (utils/phases.py), since
+wall-clock-to-ready is the north-star metric and the DAG's makespan —
+not the sum of phases — is that number. See docs/performance.md for the
+graph, the cold-vs-warm numbers, and how to read the runlog.
 
 `./setup.sh -c` dispatches to teardown (cleanRunner analogue,
 setup.sh:9-12, 484-521).
@@ -33,6 +45,7 @@ from tritonk8ssupervisor_tpu.config import store
 from tritonk8ssupervisor_tpu.config.schema import ClusterConfig, ConfigError
 from tritonk8ssupervisor_tpu.provision import (
     ansible as ansible_mod,
+    cache as cache_mod,
     heal as heal_mod,
     journal as journal_mod,
     readiness,
@@ -418,6 +431,11 @@ def provision(args, paths: state.RunPaths, prompter: Prompter) -> int:
             tasks, max_workers=scheduler_workers(), timer=timer,
             journal=journal,
         )
+        # Fully green: fold the append-only ledger down to its verified
+        # snapshot so heal cycles and daily converges don't grow it
+        # unboundedly. A failed run never reaches here, so the attempt
+        # history resume needs is still intact when it matters.
+        journal.compact()
 
     banner(config, results["terraform-apply"], results["compile-manifests"],
            prompter)
@@ -426,15 +444,16 @@ def provision(args, paths: state.RunPaths, prompter: Prompter) -> int:
 
 
 def scheduler_workers(environ: dict | None = None) -> int:
-    """Pool width for the provision DAG. 4 covers the widest graph today
-    (terraform + manifests overlapping, then probes fanned out inside
-    their task); TK8S_SCHED_WORKERS=1 degrades to the old strictly
-    sequential pipeline for debugging."""
+    """Pool width for the provision DAG. 8 covers the widest antichain of
+    the per-slice pipeline at the default 4-slice ceiling (a readiness
+    poll + a converge per slice, with terraform/manifests/host-prep done
+    by then); more slices queue harmlessly. TK8S_SCHED_WORKERS=1
+    degrades to the old strictly sequential pipeline for debugging."""
     env = os.environ if environ is None else environ
     try:
-        return max(1, int(env.get("TK8S_SCHED_WORKERS", "4")))
+        return max(1, int(env.get("TK8S_SCHED_WORKERS", "8")))
     except ValueError:
-        return 4
+        return 8
 
 
 def build_provision_dag(
@@ -446,18 +465,24 @@ def build_provision_dag(
     run_quiet: run_mod.RunFn,
     ssh_key: Path | str = "",
     ssh_user: str = "",
+    warm: "cache_mod.WarmCache | None" = None,
 ) -> list[Task]:
     """The provisioning phases as an explicit dependency graph.
 
     Edges encode real data/order constraints and nothing else:
 
-    - readiness/host-configuration need terraform's hosts;
-    - tpu-vm mode: readiness comes BEFORE host configuration — ansible
-      needs live sshd on every host (TPU state READY + SSH banner; the
+    - tpu-vm mode is per-slice pipelined: `readiness-slice-N` (TPU state
+      via a shared fleet snapshot, then authenticated SSH — the
       deterministic replacement for the reference's sleep-30 bootstrap,
-      terraform/master/main.tf:22). GKE keeps readiness after: the
-      gkejoin play itself fetches credentials, and node registration is
-      what the wait observes;
+      terraform/master/main.tf:22) needs only terraform's hosts, and
+      `configure-slice-N` (ansible --limit) needs only THAT slice's
+      readiness plus the short shared `host-prep` (inventory/vars/key
+      patch). Slice 0 configures while slice 3 is still booting; the
+      old `host-configuration` barrier waited for the whole fleet;
+    - GKE keeps the monolith: the gkejoin play drives gcloud/kubectl
+      from the control machine ([LOCAL] group — per-slice --limit has
+      no meaning there), and readiness comes after because node
+      registration is what the wait observes;
     - compile-manifests needs only the config, so it overlaps the whole
       cloud-facing pipeline (the DAG's free win);
     - the probe Job needs a ready cluster.
@@ -468,44 +493,20 @@ def build_provision_dag(
     recorded at done-time (tfstate, hosts.json, inventory, manifests),
     and a `restore` that recomputes the task's return value from those
     artifacts when a resume skips it. The probe Job carries none — a
-    health check is only meaningful re-run.
+    health check is only meaningful re-run. Independently of the
+    journal, compile-manifests and the per-slice converges consult the
+    content-addressed warm cache (provision/cache.py) INSIDE their task
+    body, so a warm re-run is a no-op even after the ledger is gone.
 
-    Diagram + measured overlap numbers: docs/performance.md.
+    Diagram + measured cold-vs-warm numbers: docs/performance.md.
     """
     cfg_fp = dataclasses.asdict(config)  # the config fingerprint
+    cache = warm if warm is not None else cache_mod.WarmCache(paths.warm_cache)
 
     def do_terraform(results: dict) -> state.ClusterHosts:
         if terraform_mod.already_applied(config, paths):
             prompter.say("terraform state present; converging existing deployment")
         return terraform_mod.apply(config, paths, run=run, run_quiet=run_quiet)
-
-    def do_readiness(results: dict) -> None:
-        if config.mode == "gke":
-            wait_ready(config, args.readiness_timeout, run_quiet=run_quiet)
-            return
-        # one shared budget for both polls — the user's timeout caps
-        # the whole phase, not each poll
-        hosts = results["terraform-apply"]
-        poll_start = time.monotonic()
-        wait_ready(config, args.readiness_timeout, run_quiet=run_quiet)
-        remaining = max(
-            0.0, args.readiness_timeout - (time.monotonic() - poll_start)
-        )
-        readiness.poll(
-            lambda: readiness.ssh_ready_probe(
-                hosts.flat_ips, ssh_user=ssh_user, ssh_key=str(ssh_key),
-                run_quiet=run_quiet,
-            ),
-            interval=5.0,
-            timeout=remaining,
-        )
-
-    def do_ansible(results: dict) -> None:
-        ansible_mod.write_runtime_configs(
-            config, results["terraform-apply"], paths,
-            ssh_key=ssh_key, ansible_user=ssh_user,
-        )
-        ansible_mod.run_playbook(paths, run=run)
 
     job_kwargs = {"image": args.bench_image} if args.bench_image else {}
     if args.checkpoint_dir:
@@ -523,8 +524,22 @@ def build_provision_dag(
     if args.independent_slices:
         job_kwargs["cross_slice"] = False
 
+    manifest_key = journal_mod.inputs_hash(
+        "compile-manifests", cfg_fp, job_kwargs
+    )
+
     def do_manifests(results: dict) -> list:
-        return compiler.write_manifests(config, paths.manifests_dir, **job_kwargs)
+        if cache.fresh("compile-manifests", manifest_key,
+                       artifacts=(paths.manifests_dir,)):
+            prompter.say("  compile-manifests: inputs unchanged "
+                         "(warm cache); reusing compiled manifests")
+            return sorted(paths.manifests_dir.glob("*.yaml"))
+        out = compiler.write_manifests(
+            config, paths.manifests_dir, **job_kwargs
+        )
+        cache.record("compile-manifests", manifest_key,
+                     artifacts=(paths.manifests_dir,))
+        return out
 
     def do_probe(results: dict) -> None:
         readiness.run_probe_job(
@@ -546,45 +561,150 @@ def build_provision_dag(
     )
     manifests_task = Task(
         "compile-manifests", do_manifests,
-        inputs_hash=journal_mod.inputs_hash(
-            "compile-manifests", cfg_fp, job_kwargs
-        ),
+        inputs_hash=manifest_key,
         artifacts=(paths.manifests_dir,),
         restore=lambda results: sorted(paths.manifests_dir.glob("*.yaml")),
     )
-    def readiness_task(after: tuple) -> Task:
-        return Task(
-            "readiness-wait", do_readiness, after=after,
+    tasks = [tf_task, manifests_task]
+
+    if config.mode == "tpu-vm":
+        tasks += build_slice_pipeline(
+            args, config, paths, cache,
+            run=run, run_quiet=run_quiet,
+            ssh_key=ssh_key, ssh_user=ssh_user, cfg_fp=cfg_fp,
+        )
+        return tasks
+
+    # ------------------------------------------------------------ gke mode
+
+    def do_ansible(results: dict) -> None:
+        ansible_mod.write_runtime_configs(
+            config, results["terraform-apply"], paths,
+            ssh_key=ssh_key, ansible_user=ssh_user,
+        )
+        ansible_mod.run_playbook(paths, run=run)
+
+    def do_readiness(results: dict) -> None:
+        wait_ready(config, args.readiness_timeout, run_quiet=run_quiet)
+
+    tasks.append(Task(
+        "host-configuration", do_ansible, after=("terraform-apply",),
+        inputs_hash=journal_mod.inputs_hash(
+            "host-configuration", cfg_fp, str(ssh_key), ssh_user
+        ),
+        artifacts=(paths.inventory, paths.hosts_file),
+    ))
+    ready_gate = "host-configuration"
+    if not args.skip_readiness:
+        tasks.append(Task(
+            "readiness-wait", do_readiness, after=("host-configuration",),
             inputs_hash=journal_mod.inputs_hash("readiness-wait", cfg_fp),
+            artifacts=(paths.hosts_file,),
+        ))
+        ready_gate = "readiness-wait"
+    if args.probe:
+        # no journal metadata: the probe is an acceptance test, and a
+        # resumed run must re-prove the cluster, not trust a record
+        tasks.append(Task("probe-job", do_probe, after=(ready_gate,)))
+    return tasks
+
+
+def build_slice_pipeline(
+    args,
+    config: ClusterConfig,
+    paths: state.RunPaths,
+    cache: "cache_mod.WarmCache",
+    run: run_mod.RunFn,
+    run_quiet: run_mod.RunFn,
+    ssh_key: Path | str,
+    ssh_user: str,
+    cfg_fp: dict,
+) -> list[Task]:
+    """The tpu-vm per-slice tail of the DAG: one shared `host-prep`
+    (runtime configs — seconds of local file writes) plus, per slice, a
+    `readiness-slice-N` (shared fleet snapshot + adaptive-backoff polls)
+    and a `configure-slice-N` (cache-aware `ansible --limit`). The only
+    cross-slice edge is host-prep; each slice's converge starts the
+    moment ITS hosts accept authenticated SSH."""
+    # one batched `tpu-vm list` per TTL window serves every slice's poll
+    snapshot = readiness.FleetSnapshot(config, run_quiet=run_quiet)
+
+    def do_host_prep(results: dict) -> None:
+        ansible_mod.write_runtime_configs(
+            config, results["terraform-apply"], paths,
+            ssh_key=ssh_key, ansible_user=ssh_user,
+        )
+
+    tasks = [Task(
+        "host-prep", do_host_prep, after=("terraform-apply",),
+        inputs_hash=journal_mod.inputs_hash(
+            "host-prep", cfg_fp, str(ssh_key), ssh_user
+        ),
+        artifacts=(paths.inventory,),
+    )]
+
+    def slice_readiness_task(i: int) -> Task:
+        name = f"readiness-slice-{i}"
+        node = f"{config.node_prefix}-{i}"
+
+        def fn(results: dict) -> None:
+            # one shared budget for both polls — the user's timeout caps
+            # the whole slice's wait, not each poll
+            hosts = results["terraform-apply"]
+            poll_start = time.monotonic()
+            readiness.poll(
+                lambda: readiness.tpu_vm_probe(
+                    config, [node], run_quiet, snapshot=snapshot
+                ),
+                timeout=args.readiness_timeout,
+                adapt=readiness.AdaptiveInterval(base=5.0, max_interval=45.0),
+            )
+            remaining = max(
+                0.0,
+                args.readiness_timeout - (time.monotonic() - poll_start),
+            )
+            slice_ips = (
+                hosts.host_ips[i] if i < len(hosts.host_ips) else []
+            )
+            readiness.poll(
+                lambda: readiness.ssh_ready_probe(
+                    slice_ips, ssh_user=ssh_user, ssh_key=str(ssh_key),
+                    run_quiet=run_quiet,
+                ),
+                timeout=remaining,
+                adapt=readiness.AdaptiveInterval(base=2.0, max_interval=15.0),
+            )
+
+        return Task(
+            name, fn, after=("terraform-apply",),
+            inputs_hash=journal_mod.inputs_hash(name, cfg_fp),
             artifacts=(paths.hosts_file,),
         )
 
-    def ansible_task(after: tuple) -> Task:
+    def slice_converge_task(i: int, after: tuple) -> Task:
+        name = f"configure-slice-{i}"
+
+        def fn(results: dict) -> bool:
+            return ansible_mod.converge_slice(
+                config, paths, results["terraform-apply"], i,
+                run=run, cache=cache,
+                ssh_key=ssh_key, ssh_user=ssh_user,
+            )
+
         return Task(
-            "host-configuration", do_ansible, after=after,
+            name, fn, after=after,
             inputs_hash=journal_mod.inputs_hash(
-                "host-configuration", cfg_fp, str(ssh_key), ssh_user
+                name, cfg_fp, str(ssh_key), ssh_user
             ),
-            artifacts=(paths.inventory, paths.hosts_file),
+            artifacts=(paths.inventory,),
         )
 
-    tasks = [tf_task, manifests_task]
-    ready_gate = "terraform-apply"
-    if config.mode == "tpu-vm":
+    for i in range(config.num_slices):
+        converge_after = ["host-prep"]
         if not args.skip_readiness:
-            tasks.append(readiness_task(("terraform-apply",)))
-            ready_gate = "readiness-wait"
-        tasks.append(ansible_task((ready_gate,)))
-    else:
-        tasks.append(ansible_task(("terraform-apply",)))
-        ready_gate = "host-configuration"
-        if not args.skip_readiness:
-            tasks.append(readiness_task(("host-configuration",)))
-            ready_gate = "readiness-wait"
-        if args.probe:
-            # no journal metadata: the probe is an acceptance test, and a
-            # resumed run must re-prove the cluster, not trust a record
-            tasks.append(Task("probe-job", do_probe, after=(ready_gate,)))
+            tasks.append(slice_readiness_task(i))
+            converge_after.append(f"readiness-slice-{i}")
+        tasks.append(slice_converge_task(i, tuple(converge_after)))
     return tasks
 
 

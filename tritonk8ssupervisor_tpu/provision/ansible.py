@@ -10,10 +10,12 @@ ansible.cfg at the discovered SSH key (the sed at setup.sh:133), then
 from __future__ import annotations
 
 import re
+import sys
 from pathlib import Path
 
 from tritonk8ssupervisor_tpu.config import compile as compiler
 from tritonk8ssupervisor_tpu.config.schema import ClusterConfig
+from tritonk8ssupervisor_tpu.provision import cache as cache_mod
 from tritonk8ssupervisor_tpu.provision import runner as run_mod
 from tritonk8ssupervisor_tpu.provision.state import ClusterHosts, RunPaths
 
@@ -67,3 +69,50 @@ def run_playbook(
         ["ansible-playbook", "-i", "hosts", "clusterUp.yml"] + (extra_args or []),
         cwd=paths.ansible_dir,
     )
+
+
+def converge_slice(
+    config: ClusterConfig,
+    paths: RunPaths,
+    hosts: ClusterHosts,
+    slice_index: int,
+    run: run_mod.RunFn = run_mod.run_streaming,
+    cache: "cache_mod.WarmCache | None" = None,
+    ssh_key: Path | str = "",
+    ssh_user: str = "",
+    echo=lambda line: print(line, file=sys.stderr, flush=True),
+) -> bool:
+    """Converge ONE slice's hosts: `ansible-playbook --limit <slice ips>`.
+
+    This is the per-slice unit both the provision DAG (configure-slice-N
+    tasks, cli/main.py) and `heal` (provision/heal.py) execute, so the
+    warm-path skip logic lives here once: with a `cache`, the converge is
+    a no-op when the slice's content key (role tree + its inventory view
+    + endpoints + SSH identity, provision/cache.py) already converged —
+    ansible would discover the same no-op itself, but only after minutes
+    of SSH round-trips per host. Returns True when ansible actually ran.
+    Call AFTER write_runtime_configs: the generated inventory and role
+    files are inputs of the key. An empty slice (degraded, emptied from
+    hosts.json) converges nothing and returns False.
+    """
+    slice_ips = (
+        list(hosts.host_ips[slice_index])
+        if slice_index < len(hosts.host_ips) else []
+    )
+    task = f"configure-slice-{slice_index}"
+    if not slice_ips:
+        echo(f"  {task}: no hosts recorded; nothing to converge")
+        return False
+    key = cache_mod.converge_key(
+        paths, slice_index, slice_ips,
+        ssh_key=str(ssh_key), ansible_user=ssh_user,
+    )
+    if cache is not None and cache.fresh(task, key):
+        echo(f"  {task}: converge inputs unchanged (warm cache); "
+             "skipping ansible")
+        return False
+    run_playbook(paths, run=run,
+                 extra_args=["--limit", ",".join(slice_ips)])
+    if cache is not None:
+        cache.record(task, key)
+    return True

@@ -38,6 +38,7 @@ import time
 
 from tritonk8ssupervisor_tpu.config.schema import ClusterConfig, ConfigError
 from tritonk8ssupervisor_tpu.provision import ansible as ansible_mod
+from tritonk8ssupervisor_tpu.provision import cache as cache_mod
 from tritonk8ssupervisor_tpu.provision import maintenance
 from tritonk8ssupervisor_tpu.provision import readiness
 from tritonk8ssupervisor_tpu.provision import runner as run_mod
@@ -136,17 +137,24 @@ def diagnose(
     ssh_user: str = "",
     ssh_key: str = "",
     check_drain: bool = True,
+    snapshot: "readiness.FleetSnapshot | None" = None,
 ) -> FleetHealth:
     """Readiness verdicts + the drain signal, folded into per-slice
     health. Probes are batched/concurrent the PR-2 way: one `tpu-vm
-    list` for the whole fleet, SSH fan-out per slice."""
+    list` for the whole fleet, SSH fan-out per slice. With `snapshot`
+    (readiness.FleetSnapshot) the listing is the run's shared TTL-cached
+    one — a heal that just polled readiness does not issue a second
+    `tpu-vm list` to diagnose the same fleet."""
     try:
         hosts = load_hosts(paths)
         host_ips = hosts.host_ips
     except MissingStateError:
         host_ips = []
     try:
-        listing = readiness.tpu_vm_states(config, run_quiet)
+        listing = (
+            snapshot.states() if snapshot is not None
+            else readiness.tpu_vm_states(config, run_quiet)
+        )
     except Exception:  # noqa: BLE001 - listing is advisory; SSH decides
         listing = {}
     ssh_verdicts = readiness.slice_ssh_verdicts(
@@ -225,6 +233,7 @@ def heal(
     timer=None,
     check_drain: bool = True,
     sleep=time.sleep,
+    cache: "cache_mod.WarmCache | None" = None,
 ) -> bool:
     """Diagnose and repair the fleet at slice granularity.
 
@@ -233,6 +242,14 @@ def heal(
     quarantined and emptied from hosts.json — N-of-M success). Breakage
     beyond the budget re-raises the readiness timeout; terraform/ansible
     failures raise through the normal error path, retries included.
+
+    Converge shares the provision pipeline's warm path
+    (provision/cache.py): each repaired slice's cache entry is
+    invalidated first (new endpoints MUST reconverge even if the key
+    collides) and re-recorded on success by the shared
+    `ansible_mod.converge_slice`, while the healthy slices' entries are
+    never touched — so a follow-up provision run warm-skips them, and
+    only the replaced slice's converge ever runs here.
     """
     if config.mode != "tpu-vm":
         raise ConfigError(
@@ -240,6 +257,11 @@ def heal(
             "self-repair (auto_repair) and gang-restart via the Job "
             "backoff budget — see docs/failure-modes.md"
         )
+    if cache is None:
+        cache = cache_mod.WarmCache(paths.warm_cache)
+    # one batched `tpu-vm list` snapshot feeds the diagnosis AND any
+    # readiness probes inside this run (satellite of the PR-2 batching)
+    snapshot = readiness.FleetSnapshot(config, run_quiet=run_quiet)
 
     def phase(name: str):
         return (timer.phase(name) if timer is not None
@@ -249,6 +271,7 @@ def heal(
         health = diagnose(
             config, paths, run_quiet=run_quiet,
             ssh_user=ssh_user, ssh_key=ssh_key, check_drain=check_drain,
+            snapshot=snapshot,
         )
     for line in health.summary():
         prompter.say(f"  {line}")
@@ -282,8 +305,18 @@ def heal(
         ansible_mod.write_runtime_configs(
             config, hosts, paths, ssh_key=ssh_key, ansible_user=ssh_user
         )
-        limit = ["--limit", ",".join(healed_ips)] if healed_ips else []
-        ansible_mod.run_playbook(paths, run=run, extra_args=limit)
+        # Per-slice converge through the SAME cache-aware unit the
+        # provision DAG runs: healthy slices keep their warm entries
+        # (nothing runs for them), repaired slices are forced cold first
+        # — a recycled IP must not fake a warm hit on a fresh VM.
+        for i in bad:
+            cache.invalidate(f"configure-slice-{i}")
+        for i in bad:
+            ansible_mod.converge_slice(
+                config, paths, hosts, i, run=run, cache=cache,
+                ssh_key=ssh_key, ssh_user=ssh_user,
+                echo=lambda line: prompter.say(line),
+            )
     still_bad: list = []
     with phase("heal-readiness"):
         try:
@@ -314,6 +347,9 @@ def heal(
                 hosts.host_ips[i] = []
             if i < len(hosts.internal_ips):
                 hosts.internal_ips[i] = []
+            # a degraded slice's converge record must not read as warm
+            # when the slice is later re-provisioned
+            cache.invalidate(f"configure-slice-{i}")
         hosts.save(paths.hosts_file)
         record_quarantine(paths, {
             i: {"state": DEGRADED,

@@ -285,6 +285,56 @@ class Journal:
                 return False
         return True
 
+    def compact(self) -> int:
+        """Rewrite the ledger down to the last verified snapshot: one
+        record per task, carrying its final transition (done records keep
+        their artifact digests; failed keeps the last error; a lingering
+        `running` — the crash signature — is preserved verbatim in
+        effect). Returns the number of records dropped.
+
+        The append-only ledger grows by a handful of lines per task per
+        run, forever — across heal cycles and daily converges that is
+        unbounded. After a fully-green run the history adds nothing the
+        snapshot doesn't already prove (resume only consults the LAST
+        transition plus digests), so cli/main.py compacts here. The
+        rewrite is a same-directory temp file + fsync + os.replace:
+        readers and a crash mid-compaction see the old ledger or the new
+        one, never a truncation. Attempt history resets (compaction
+        happens on green runs, where the history is spent anyway).
+        """
+        ledgers = self.replay()
+        if not self.path.exists():
+            return 0
+        before = sum(
+            1 for line in self.path.read_text().splitlines() if line.strip()
+        )
+        records = []
+        for task, ledger in ledgers.items():
+            record: dict = {
+                "v": SCHEMA_VERSION, "ts": self._clock(), "task": task,
+                "status": ledger.status, "inputs_hash": ledger.inputs_hash,
+            }
+            if ledger.status == DONE:
+                record["artifacts"] = ledger.artifacts
+            elif ledger.status == FAILED:
+                record["error"] = ledger.errors[-1] if ledger.errors else ""
+            elif ledger.status == RUNNING:
+                record["attempt"] = ledger.attempts
+            records.append(json.dumps(record, sort_keys=True) + "\n")
+        tmp = self.path.with_name(f".{self.path.name}.compact.tmp")
+        with self._mutex:
+            with tmp.open("w") as f:
+                f.writelines(records)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.path)
+        dropped = before - len(records)
+        if dropped > 0:
+            self._echo(
+                f"journal compacted: {before} records -> {len(records)}"
+            )
+        return dropped
+
     def scrub(self) -> None:
         """Delete the ledger and its lock — teardown's LAST act, so a
         clean that crashes halfway leaves the ledger (and with it the

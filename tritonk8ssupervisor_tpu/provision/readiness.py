@@ -11,7 +11,10 @@ smoke test over SSH for standalone slices — with bounded timeouts.
 
 from __future__ import annotations
 
+import dataclasses
 import json
+import random
+import threading
 import time
 from typing import Callable
 
@@ -23,6 +26,28 @@ class NotReadyError(RuntimeError):
     """Cluster did not become ready within the timeout."""
 
 
+@dataclasses.dataclass
+class AdaptiveInterval:
+    """Decorrelated-backoff probe cadence (the retry engine's jitter
+    formula, pointed at polling): while a probe keeps returning the SAME
+    "why not yet", the next interval is drawn from [base, 3*previous]
+    capped at `max_interval` — a slow slice stops being probed every few
+    seconds once it's clearly minutes away. The moment the verdict TEXT
+    changes (progress: fewer unready hosts, a new TPU state), the cadence
+    snaps back to `base` so the tail of the wait stays responsive. With N
+    per-slice polls sharing one API, the jitter also de-synchronises them
+    (thundering-herd control, same as provision/retry.py)."""
+
+    base: float = 5.0
+    max_interval: float = 45.0
+    rng: Callable[[], float] = random.random
+
+    def next(self, previous: float) -> float:
+        low = self.base
+        high = max(low, 3.0 * previous)
+        return min(self.max_interval, low + self.rng() * (high - low))
+
+
 def poll(
     probe: Callable[[], str],
     *,
@@ -31,18 +56,24 @@ def poll(
     sleep: Callable[[float], None] = time.sleep,
     echo: Callable[[str], None] = lambda line: print(line, flush=True),
     clock: Callable[[], float] = time.monotonic,
+    adapt: AdaptiveInterval | None = None,
 ) -> None:
     """Run `probe` until it returns "" (ready) or the timeout lapses.
 
     A non-empty return is the human-readable "why not yet" — echoed like
     the reference's progress ticker (setup.sh:62,80) but with content.
     Probe exceptions count as "not yet" (transient API errors mid-boot).
-    The 15 s cadence matches the reference's dashboard poll (setup.sh:66).
-    The final sleep is clamped to the time left so the deadline cannot
-    overshoot by a full interval; the last probe fires AT the deadline
-    (one genuine last chance) and its verdict decides.
+    The default fixed 15 s cadence matches the reference's dashboard poll
+    (setup.sh:66); passing `adapt` switches to the decorrelated-backoff
+    cadence above (per-slice pipelined readiness uses it so N concurrent
+    slice polls don't hammer the API at a fixed beat). The final sleep is
+    clamped to the time left so the deadline cannot overshoot by a full
+    interval; the last probe fires AT the deadline (one genuine last
+    chance) and its verdict decides.
     """
     deadline = clock() + timeout
+    current = interval if adapt is None else adapt.base
+    last_why: str | None = None
     while True:
         try:
             why_not = probe()
@@ -56,7 +87,10 @@ def poll(
         if now >= deadline:
             raise NotReadyError(f"timed out after {timeout:.0f}s: {why_not}")
         echo(f"  ... {why_not}")
-        sleep(min(interval, deadline - now))
+        if adapt is not None:
+            current = adapt.base if why_not != last_why else adapt.next(current)
+            last_why = why_not
+        sleep(min(current, deadline - now))
 
 
 # ------------------------------------------------------------------ GKE mode
@@ -211,10 +245,56 @@ def tpu_vm_states(
     return states
 
 
+class FleetSnapshot:
+    """ONE batched `tpu-vm list` shared by every consumer in a run.
+
+    Per-slice pipelined readiness runs N slice polls concurrently, and
+    `heal` diagnoses right after its own readiness checks — without
+    sharing, each would issue its own `tpu-vm list` (at ~1 s of gcloud
+    startup + API latency per call, N slices turn every poll beat into
+    N round-trips). The snapshot caches the listing for `ttl` seconds:
+    concurrent slice polls inside one beat see the same fetch, and the
+    TTL bounds staleness to less than a poll interval. Thread-safe; a
+    fetch that raises is never cached (the next caller retries), and
+    `fetches` counts real calls for tests/observability.
+    """
+
+    def __init__(
+        self,
+        config: ClusterConfig,
+        run_quiet: run_mod.RunFn = run_mod.run_capture,
+        ttl: float = 10.0,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self._config = config
+        self._run_quiet = run_quiet
+        self._ttl = ttl
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._states: dict[str, str] | None = None
+        self._fetched_at = 0.0
+        self.fetches = 0
+
+    def states(self, max_age: float | None = None) -> dict[str, str]:
+        ttl = self._ttl if max_age is None else max_age
+        with self._lock:
+            now = self._clock()
+            if self._states is None or now - self._fetched_at > ttl:
+                self._states = tpu_vm_states(self._config, self._run_quiet)
+                self._fetched_at = now
+                self.fetches += 1
+            return dict(self._states)
+
+    def invalidate(self) -> None:
+        with self._lock:
+            self._states = None
+
+
 def tpu_vm_probe(
     config: ClusterConfig,
     slice_names: list[str],
     run_quiet: run_mod.RunFn = run_mod.run_capture,
+    snapshot: FleetSnapshot | None = None,
 ) -> str:
     """Ready when every slice's Cloud TPU state is READY.
 
@@ -224,8 +304,13 @@ def tpu_vm_probe(
     the verdict names every slice still in flight. A slice absent from
     the listing reads CREATING: the QueuedResource has not materialised
     a node yet, which is the normal early-boot state, not an error.
+    With `snapshot`, concurrent per-slice polls share one TTL-cached
+    listing instead of each fetching their own.
     """
-    states = tpu_vm_states(config, run_quiet)
+    states = (
+        snapshot.states() if snapshot is not None
+        else tpu_vm_states(config, run_quiet)
+    )
     unready = [
         f"{name} is {states.get(name) or 'CREATING'}"
         for name in slice_names

@@ -46,9 +46,31 @@ FATAL = "fatal"
 class Classification:
     verdict: str  # TRANSIENT or FATAL
     cause: str  # short label for logs/runlog records, e.g. "rate-limited"
+    # a floor under the backoff delay: rate/quota throttles (429,
+    # RESOURCE_EXHAUSTED) refill on wall-clock windows measured in tens
+    # of seconds — retrying at the generic 2 s cadence just burns the
+    # attempt budget re-triggering the limiter. Capped by the policy's
+    # max_delay, so a drill that zeroes the delays stays instant.
+    min_delay: float = 0.0
 
 
-# Fatal patterns are checked FIRST: a quota error that happens to mention
+# Rate/quota throttling backs off at least this long between attempts
+# (GCP per-minute quota windows; AIP-194 recommends >= 30 s for
+# RESOURCE_EXHAUSTED). The policy's max_delay still caps it.
+QUOTA_BACKOFF_FLOOR = 30.0
+
+# Throttle patterns are checked before everything else: an HTTP 429 /
+# RESOURCE_EXHAUSTED is a *rate* verdict even when the message mentions
+# "quota" (per-minute request quotas refill; resource quotas do not) —
+# it must win over the fatal quota-exceeded pattern below, and it
+# carries the long-backoff floor.
+_THROTTLE_PATTERNS: list[tuple[re.Pattern, str]] = [
+    (re.compile(r"\b429\b|Too Many Requests|RESOURCE_EXHAUSTED|"
+                r"rateLimitExceeded|rate limit", re.IGNORECASE),
+     "rate-limited"),
+]
+
+# Fatal patterns are checked next: a quota error that happens to mention
 # an HTTP status must not be retried into a 10-minute backoff spiral —
 # when a failure is ambiguous, aborting loudly beats burning the phase
 # deadline on a fault no retry can fix.
@@ -66,8 +88,6 @@ _FATAL_PATTERNS: list[tuple[re.Pattern, str]] = [
 ]
 
 _TRANSIENT_PATTERNS: list[tuple[re.Pattern, str]] = [
-    (re.compile(r"\b429\b|Too Many Requests|rateLimitExceeded|"
-                r"rate limit", re.IGNORECASE), "rate-limited"),
     (re.compile(r"\b50[0234]\b|Internal Server Error|backendError|"
                 r"internal error|Service Unavailable|Bad Gateway",
                 re.IGNORECASE), "server-5xx"),
@@ -91,9 +111,16 @@ def classify(error: CommandError) -> Classification:
     the command line itself — `-o ConnectTimeout=5` must not read as a
     timeout). Unmatched failures default to FATAL: an error we cannot
     name is an error we cannot promise a retry will fix, and errexit
-    semantics are the safe fallback.
+    semantics are the safe fallback. HTTP 429 / RESOURCE_EXHAUSTED
+    throttles are transient-with-long-backoff: they retry, but no sooner
+    than QUOTA_BACKOFF_FLOOR (bounded by the policy's max_delay).
     """
     text = getattr(error, "tail", "") or ""
+    for pattern, cause in _THROTTLE_PATTERNS:
+        if pattern.search(text):
+            return Classification(
+                TRANSIENT, cause, min_delay=QUOTA_BACKOFF_FLOOR
+            )
     for pattern, cause in _FATAL_PATTERNS:
         if pattern.search(text):
             return Classification(FATAL, cause)
@@ -201,6 +228,11 @@ def retrying_runner(
                 if verdict.verdict == FATAL or attempt >= policy.max_attempts:
                     raise
                 delay = policy.next_delay(delay, rng)
+                if verdict.min_delay:
+                    # long-backoff floor (quota throttles), still capped
+                    # by the policy so zeroed-delay drills stay instant
+                    delay = max(delay, min(verdict.min_delay,
+                                           policy.max_delay))
                 if (
                     policy.deadline is not None
                     and clock() - start + delay > policy.deadline

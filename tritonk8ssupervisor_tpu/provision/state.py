@@ -94,6 +94,13 @@ class RunPaths:
         return self.root / "provision-journal.jsonl"
 
     @property
+    def warm_cache(self) -> Path:
+        # the content-addressed converge cache (provision/cache.py) —
+        # shared by provision, heal, and crash-resume, so it lives at
+        # the root next to the journal
+        return self.root / "provision-cache.json"
+
+    @property
     def quarantine_file(self) -> Path:
         # hosts/slices pulled from service by heal (provision/heal.py)
         return self.terraform_dir / "quarantine.json"

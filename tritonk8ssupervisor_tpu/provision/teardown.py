@@ -151,4 +151,8 @@ def _remove_generated_state(config: ClusterConfig | None, paths: RunPaths) -> No
     shutil.rmtree(paths.probe_dir, ignore_errors=True)
     paths.config_file.unlink(missing_ok=True)
     paths.runlog.unlink(missing_ok=True)
+    # the warm converge cache keys off content that no longer exists
+    # after the scrub above — a stale entry surviving teardown could
+    # never verify, but scrubbing it keeps "clean" meaning clean
+    paths.warm_cache.unlink(missing_ok=True)
     ansible_mod.reset_private_key(paths.ansible_cfg)

@@ -117,6 +117,23 @@ class SimClock:
             self._sleepers.remove(wake)
             self._cv.notify_all()
 
+    def charge(self, seconds: float) -> None:
+        """Advance virtual time by `seconds` from the DRIVER thread while
+        no actors are in flight — for costs that happen outside the task
+        graph proper, e.g. the per-task digest verification a warm resume
+        pays before the scheduler ever submits anything
+        (bench_provision.py --warm). Charging while actors are active
+        would corrupt their sleep accounting, so it raises instead."""
+        with self._cv:
+            if self._active or self._launched or self._sleepers:
+                raise SimClockStalled(
+                    "charge() while actors are in flight: "
+                    f"{self._active} active, {self._launched} launched, "
+                    f"{len(self._sleepers)} sleeping"
+                )
+            self._now += max(0.0, float(seconds))
+            self._cv.notify_all()
+
     def _maybe_advance(self) -> None:
         # caller holds self._cv
         if (

@@ -196,19 +196,41 @@ class PhaseTimer:
 #     advertisement of google.com/tpu (minutes after nodes boot).
 #   - host-configuration is ansible over SSH: jax[tpu] pip install
 #     dominates (~1 GB of wheels per host, parallel across hosts).
-#   - The budgets sum to 870 s — inside the 900 s target with margin
-#     for the prompts-excluded phases. Under the DAG scheduler the WALL
-#     verdict is judged on the makespan, so overlapped phases (e.g.
-#     compile-manifests riding along terraform-apply) don't eat margin.
+#   - Under the DAG scheduler the WALL verdict is judged on the
+#     makespan, not the sum, so overlapped phases (compile-manifests
+#     riding along terraform-apply, per-slice readiness/converge fanned
+#     across slices) don't eat margin; each per-phase ceiling bounds one
+#     phase in isolation and the 900 s target judges the whole run.
 PHASE_BUDGETS: dict[str, float] = {
     "discover-environment": 20.0,
     "terraform-apply": 480.0,
-    "host-configuration": 180.0,
+    "host-configuration": 180.0,  # gke's monolithic ansible phase
+    "host-prep": 20.0,  # tpu-vm shared prep: inventory/vars/key patch
     "readiness-wait": 120.0,
     "compile-manifests": 20.0,
     "probe-job": 50.0,
 }
+# Per-slice pipelined phases (tpu-vm since the host-configuration split)
+# carry a slice index in their name — budget them by prefix. These run
+# overlapped across slices, so the WALL verdict, not the sum, judges the
+# run; each ceiling bounds ONE slice's wait/converge.
+PHASE_PREFIX_BUDGETS: dict[str, float] = {
+    "readiness-slice-": 120.0,
+    "configure-slice-": 150.0,  # one slice's ansible --limit converge
+}
 TOTAL_BUDGET_SECONDS = 900.0  # the BASELINE.md north star
+
+
+def phase_budget(name: str) -> float | None:
+    """Budget for a phase name: exact match first (provision, then heal),
+    then the per-slice prefixes; unknown phases have no budget."""
+    budget = PHASE_BUDGETS.get(name, HEAL_PHASE_BUDGETS.get(name))
+    if budget is not None:
+        return budget
+    for prefix, ceiling in PHASE_PREFIX_BUDGETS.items():
+        if name.startswith(prefix):
+            return ceiling
+    return None
 
 # Slice-granular repair (provision/heal.py) is a SEPARATE run from
 # provision, so its budgets live outside the 900 s sum invariant above
@@ -301,9 +323,7 @@ def analyze_runlog(path: Path) -> list[dict]:
     on_path = set(_critical_path(rows))
     out = []
     for row in rows.values():
-        budget = PHASE_BUDGETS.get(
-            row["phase"], HEAL_PHASE_BUDGETS.get(row["phase"])
-        )
+        budget = phase_budget(row["phase"])
         row["budget"] = budget
         row["over"] = budget is not None and row["seconds"] > budget
         row["crit"] = row["phase"] in on_path
