@@ -33,15 +33,26 @@ mid-DAG SIGKILL resumed from the durable journal (MTTR + redo ratio),
 and a single-slice loss repaired via `heal` with the warm cache leaving
 healthy slices' converge untouched.
 
+PR 5's supervisor drills (`--supervise`) measure UNATTENDED repair: a
+slice preempted at t=300 s with the resident reconcile loop running
+(provision/supervisor.py) is detected, flap-confirmed, and healed with
+zero human input; the recorded MTTR is judged against the PR-4
+manual-heal baseline (120 s, an operator already at the keyboard) plus
+one reconcile interval. A second drill proves the safety rails: heals
+that never stick are spaced by the token bucket, trip the breaker, and
+end in degraded-hold — never a replace-loop.
+
 `--check` is the perf-regression gate: re-simulate and fail (exit 1) if
-the cold or warm makespan regressed more than 10% against the committed
-BENCH_provision.json — wired as a tier-1 `perf` test.
+the cold or warm makespan — or the unattended MTTR — regressed more
+than 10% against the committed BENCH_provision.json /
+BENCH_supervise.json — wired as a tier-1 `perf` test.
 
 Usage::
 
     python bench_provision.py [--slices 4] [--out BENCH_provision.json]
     python bench_provision.py --warm
     python bench_provision.py --resilience [--out BENCH_resilience.json]
+    python bench_provision.py --supervise [--out BENCH_supervise.json]
     python bench_provision.py --check [--baseline BENCH_provision.json]
 """
 
@@ -81,6 +92,12 @@ SIM_SECONDS = {
     "converge-slice": 55.0,  # per slice: ansible --limit, full forks
     "host-configuration": 150.0,  # the pre-split whole-fleet monolith
     "verify-task": 2.0,  # warm path: digest re-check of one task
+    # One slice's end-to-end scoped heal (replace -> ready -> converge,
+    # overlapped the way the live path overlaps boot and converge): the
+    # PR-4 measured manual-heal MTTR (BENCH_resilience.json
+    # crash_resume.mttr_wall_s) — the baseline the supervisor's
+    # unattended MTTR is judged against.
+    "heal-slice": 120.0,
 }
 
 
@@ -572,20 +589,280 @@ def run_resilience_benchmark(num_slices: int = 4) -> dict:
     }
 
 
+# ------------------------------------------------------- supervise drills
+
+
+class SuperviseSim:
+    """Scripted fleet for the supervisor drills (the bench twin of the
+    tests' FleetSim): slice health is a function of virtual time, and a
+    `terraform apply -replace` costs SIM_SECONDS['heal-slice'] on the
+    clock before the slice returns (unless `heal_works=False`)."""
+
+    def __init__(self, root: Path, clock, num_slices=4, heal_works=True):
+        from tritonk8ssupervisor_tpu.config.schema import ClusterConfig
+        from tritonk8ssupervisor_tpu.provision.state import (
+            ClusterHosts,
+            RunPaths,
+        )
+
+        self.paths = RunPaths(root)
+        self.paths.terraform_module("tpu-vm").mkdir(parents=True,
+                                                    exist_ok=True)
+        self.config = ClusterConfig(
+            project="sim-proj", zone="us-west4-a", generation="v5e",
+            topology="4x4", mode="tpu-vm", num_slices=num_slices,
+        )
+        self.clock = clock
+        self.heal_works = heal_works
+        self.num_slices = num_slices
+        self.down: set = set()
+        self.down_at: list = []
+        self.applies: list = []
+        self.ips = {i: f"10.0.{i}.1" for i in range(num_slices)}
+        ClusterHosts(
+            host_ips=[[self.ips[i]] for i in range(num_slices)],
+            internal_ips=[[f"10.1.{i}.1"] for i in range(num_slices)],
+            coordinator_ip="10.1.0.1",
+        ).save(self.paths.hosts_file)
+        self.paths.tfstate("tpu-vm").write_text(json.dumps(
+            {"resources": [{"index": i} for i in range(num_slices)]}
+        ))
+
+    def preempt(self, slice_index, at):
+        self.down_at.append((at, slice_index))
+
+    def _sync(self):
+        now = self.clock.time()
+        for at, i in list(self.down_at):
+            if now >= at:
+                self.down.add(i)
+                self.down_at.remove((at, i))
+
+    def run(self, args, cwd=None, **kwargs):
+        self._sync()
+        if list(args[:2]) == ["terraform", "apply"]:
+            replaced = [int(str(a).split("[")[1].rstrip("]"))
+                        for a in args if str(a).startswith("-replace=")]
+            self.applies.append(replaced)
+            self.clock.sleep(SIM_SECONDS["heal-slice"])
+            if self.heal_works:
+                for i in replaced:
+                    self.down.discard(i)
+                    self.ips[i] = f"10.9.{i}.1"
+        return ""
+
+    def run_quiet(self, args, cwd=None, **kwargs):
+        from tritonk8ssupervisor_tpu.provision.runner import CommandError
+
+        self._sync()
+        if list(args[:3]) == ["terraform", "output", "-json"]:
+            return json.dumps({
+                "host_ips": {"value": [
+                    [self.ips[i]] for i in range(self.num_slices)
+                ]},
+                "internal_ips": {"value": [
+                    [f"10.1.{i}.1"] for i in range(self.num_slices)
+                ]},
+            })
+        if args and args[0] == "gcloud":
+            return "\n".join(
+                f"{self.config.node_prefix}-{i}\tREADY"
+                for i in range(self.num_slices) if i not in self.down
+            )
+        if args and args[0] == "ssh":
+            ip = args[-2]
+            index = next((i for i, x in self.ips.items() if x == ip), None)
+            if "cat" in args[-1]:
+                return ""
+            if index in self.down:
+                raise CommandError(list(args), 255)
+            return ""
+        return ""
+
+
+def _supervise_run(world, policy, ticks, readiness_timeout=60.0):
+    """Drive the supervisor as the virtual clock's single actor and
+    return the replayed event ledger."""
+    from tritonk8ssupervisor_tpu.provision import events as events_mod
+    from tritonk8ssupervisor_tpu.provision import supervisor as sup_mod
+
+    ledger = events_mod.EventLedger(
+        world.paths.events, clock=world.clock.time, echo=lambda line: None
+    )
+    supervisor = sup_mod.Supervisor(
+        world.config, world.paths, _Say(),
+        run=world.run, run_quiet=world.run_quiet, policy=policy,
+        ledger=ledger, clock=world.clock.time, sleep=world.clock.sleep,
+        rng=lambda: 0.0, readiness_timeout=readiness_timeout,
+    )
+    world.clock.begin()
+    try:
+        supervisor.run(ticks=ticks)
+    finally:
+        world.clock.release()
+    return ledger.replay()
+
+
+def run_supervise_mttr_drill(
+    num_slices: int = 4,
+    interval: float = 30.0,
+    preempt_at: float = 300.0,
+    workdir: Path | None = None,
+) -> dict:
+    """The unattended-MTTR datapoint: one slice preempted at
+    `preempt_at`; the resident loop detects it (one tick), confirms it
+    (the flap threshold's second tick), and heals it with ZERO human
+    input. MTTR is measured preemption -> heal-done on the ledger."""
+    from tritonk8ssupervisor_tpu.provision import events as events_mod
+    from tritonk8ssupervisor_tpu.provision import supervisor as sup_mod
+
+    own_tmp = workdir is None
+    root = Path(workdir) if workdir is not None else Path(
+        tempfile.mkdtemp(prefix="tk8s-supervise-drill-")
+    )
+    try:
+        clock = SimClock()
+        world = SuperviseSim(root, clock, num_slices=num_slices)
+        lost = num_slices // 2
+        world.preempt(lost, at=preempt_at)
+        policy = sup_mod.SupervisePolicy(interval=interval,
+                                         flap_threshold=2)
+        records = _supervise_run(world, policy, ticks=16)
+        done = [r for r in records if r["kind"] == events_mod.HEAL_DONE]
+        detected = [r for r in records
+                    if r["kind"] == events_mod.VERDICT
+                    and r.get("slice") == lost
+                    and r.get("state") != "healthy"]
+        status = json.loads(world.paths.fleet_status.read_text())
+        assert world.applies == [[lost]], "expected exactly one scoped heal"
+        assert status["verdict"] == "healthy", "fleet must end healthy"
+        mttr = done[0]["ts"] - preempt_at
+        return {
+            "num_slices": num_slices,
+            "interval_s": interval,
+            "preempt_at_s": preempt_at,
+            "lost_slice": lost,
+            "detect_s": detected[0]["ts"] - preempt_at,
+            "confirm_ticks": 2,  # the flap threshold
+            "heal_s": done[0]["seconds"],
+            "unattended_mttr_s": mttr,
+            "heals_attempted": status["heals"]["attempted"],
+            "heals_succeeded": status["heals"]["succeeded"],
+            "end_verdict": status["verdict"],
+        }
+    finally:
+        if own_tmp:
+            shutil.rmtree(root, ignore_errors=True)
+
+
+def run_supervise_breaker_drill(
+    num_slices: int = 4,
+    workdir: Path | None = None,
+) -> dict:
+    """The acceptance's second leg: a slice whose heal never sticks.
+    The token bucket spaces the attempts, the breaker trips after 3
+    windowed failures, and the run ENDS in degraded-hold within the
+    --max-degraded budget — never a replace-loop."""
+    from tritonk8ssupervisor_tpu.provision import events as events_mod
+    from tritonk8ssupervisor_tpu.provision import supervisor as sup_mod
+
+    own_tmp = workdir is None
+    root = Path(workdir) if workdir is not None else Path(
+        tempfile.mkdtemp(prefix="tk8s-breaker-drill-")
+    )
+    try:
+        clock = SimClock()
+        world = SuperviseSim(root, clock, num_slices=num_slices,
+                             heal_works=False)
+        world.preempt(num_slices - 1, at=0.0)
+        policy = sup_mod.SupervisePolicy(
+            interval=30.0, flap_threshold=2, heal_burst=2,
+            heal_refill_s=600.0, breaker_threshold=3,
+            breaker_window_s=3600.0, breaker_cooldown_s=600.0,
+            max_degraded=1,
+        )
+        records = _supervise_run(world, policy, ticks=30,
+                                 readiness_timeout=60.0)
+        kinds = [r["kind"] for r in records]
+        status = json.loads(world.paths.fleet_status.read_text())
+        return {
+            "heals_attempted": kinds.count(events_mod.HEAL_START),
+            "heals_failed": status["heals"]["failed"],
+            "rate_limited": status["heals"]["rate_limited"],
+            "held_ticks": status["heals"]["held_ticks"],
+            "breaker_trips": status["breaker"]["trips"],
+            "breaker_state": status["breaker"]["state"],
+            "end_verdict": status["verdict"],
+            "degraded": status["degraded"],
+            "max_degraded": policy.max_degraded,
+            "rate_limit_respected": (
+                kinds.count(events_mod.HEAL_START) == len(world.applies)
+                and kinds.count(events_mod.RATE_LIMITED) >= 1
+            ),
+            "ends_in_degraded_hold": (
+                status["verdict"] == "degraded-hold"
+                and len(status["degraded"]) <= policy.max_degraded
+            ),
+        }
+    finally:
+        if own_tmp:
+            shutil.rmtree(root, ignore_errors=True)
+
+
+def run_supervise_benchmark(num_slices: int = 4) -> dict:
+    """The PR-5 acceptance datapoint, one BENCH-style JSON document:
+    unattended MTTR vs. the PR-4 manual-heal baseline (which assumed an
+    operator already at the keyboard — at 3am the realistic manual
+    response is a page + minutes of context; the supervisor's budget is
+    nonetheless judged against the OPTIMISTIC baseline plus one
+    reconcile interval), plus the breaker storm drill."""
+    mttr = run_supervise_mttr_drill(num_slices)
+    breaker = run_supervise_breaker_drill(num_slices)
+    manual_mttr = SIM_SECONDS["heal-slice"]  # operator already typing
+    budget = manual_mttr + mttr["interval_s"]
+    return {
+        "benchmark": "provision_supervise",
+        "metric": "unattended_mttr_s",
+        "unit": "seconds from slice preemption to healed, zero human "
+                "input (simulated; budget = manual-heal MTTR + one "
+                "reconcile interval)",
+        "num_slices": num_slices,
+        "model_seconds": dict(SIM_SECONDS),
+        "value": mttr["unattended_mttr_s"],
+        "unattended_mttr_s": mttr["unattended_mttr_s"],
+        "mttr": mttr,
+        "manual_mttr_s": manual_mttr,
+        "mttr_budget_s": budget,
+        "breaker_drill": breaker,
+        "passes": bool(
+            mttr["unattended_mttr_s"] <= budget
+            and mttr["heals_attempted"] == 1
+            and breaker["ends_in_degraded_hold"]
+            and breaker["rate_limit_respected"]
+        ),
+    }
+
+
 # ------------------------------------------------------ the regression gate
 
 
 DEFAULT_BASELINE = Path(__file__).resolve().parent / "BENCH_provision.json"
+SUPERVISE_BASELINE = Path(__file__).resolve().parent / "BENCH_supervise.json"
 
 
 def run_check(
-    baseline: Path = DEFAULT_BASELINE, tolerance: float = 0.10
+    baseline: Path = DEFAULT_BASELINE,
+    tolerance: float = 0.10,
+    supervise_baseline: Path = SUPERVISE_BASELINE,
 ) -> tuple[bool, list[str], dict]:
-    """Re-simulate against the committed BENCH_provision.json: fail when
-    the cold (pipelined DAG) or warm makespan regressed more than
-    `tolerance` — the gate that keeps a DAG-edge or cache regression
-    from landing silently. Improvements always pass; the committed file
-    is only rewritten by an explicit `--out` run."""
+    """Re-simulate against the committed BENCH_provision.json and
+    BENCH_supervise.json: fail when the cold (pipelined DAG) or warm
+    makespan — or the supervisor's unattended MTTR — regressed more
+    than `tolerance`, or when unattended MTTR no longer beats the
+    manual-heal budget (manual MTTR + one reconcile interval). The gate
+    that keeps a DAG-edge, cache, or reconcile-loop regression from
+    landing silently. Improvements always pass; the committed files are
+    only rewritten by explicit `--out` runs."""
     baseline = Path(baseline)
     if not baseline.exists():
         return False, [f"baseline {baseline} missing"], {}
@@ -607,6 +884,30 @@ def run_check(
     compare("warm makespan",
             committed.get("warm", {}).get("warm_wall_s"),
             current["warm"]["warm_wall_s"])
+
+    supervise_baseline = Path(supervise_baseline)
+    if not supervise_baseline.exists():
+        problems.append(f"baseline {supervise_baseline} missing")
+    else:
+        committed_sup = json.loads(supervise_baseline.read_text())
+        current_sup = run_supervise_benchmark(
+            int(committed_sup.get("num_slices", 4))
+        )
+        current["supervise"] = current_sup
+        compare("unattended MTTR",
+                committed_sup.get("unattended_mttr_s",
+                                  committed_sup.get("value")),
+                current_sup["value"])
+        if current_sup["value"] > current_sup["mttr_budget_s"]:
+            problems.append(
+                f"unattended MTTR {current_sup['value']:.0f}s no longer "
+                f"beats the manual-heal budget "
+                f"{current_sup['mttr_budget_s']:.0f}s"
+            )
+        if not current_sup["breaker_drill"]["ends_in_degraded_hold"]:
+            problems.append(
+                "breaker storm drill no longer ends in degraded-hold"
+            )
     return not problems, problems, current
 
 
@@ -619,6 +920,11 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--warm", action="store_true",
                         help="run only the cold-vs-warm drill (journal + "
                         "cache verified no-op re-provision)")
+    parser.add_argument("--supervise", action="store_true",
+                        help="run the supervisor drills: unattended MTTR "
+                        "for a slice preemption vs the manual-heal "
+                        "baseline, plus the breaker storm ending in "
+                        "degraded-hold")
     parser.add_argument("--check", action="store_true",
                         help="perf-regression gate: fail if the simulated "
                         "cold/warm makespan regressed >10%% vs the "
@@ -644,6 +950,8 @@ def main(argv: list[str] | None = None) -> int:
         return 0 if ok else 1
     if args.resilience:
         result = run_resilience_benchmark(args.slices)
+    elif args.supervise:
+        result = run_supervise_benchmark(args.slices)
     elif args.warm:
         result = {
             "benchmark": "provision_warm",
@@ -673,6 +981,23 @@ def main(argv: list[str] | None = None) -> int:
             f"healthy-untouched="
             f"{result['slice_loss']['healthy_tfstate_untouched']} "
             f"converge-runs={result['slice_loss']['ansible_runs']}",
+            file=sys.stderr,
+        )
+        return 0 if result["passes"] else 1
+    if args.supervise:
+        mttr = result["mttr"]
+        breaker = result["breaker_drill"]
+        print(
+            f"\n{args.slices}-slice supervise (simulated): slice "
+            f"{mttr['lost_slice']} preempted at t={mttr['preempt_at_s']:.0f}"
+            f"s -> detected +{mttr['detect_s']:.0f}s, healed unattended in "
+            f"{result['unattended_mttr_s']:.0f}s (manual baseline "
+            f"{result['manual_mttr_s']:.0f}s + {mttr['interval_s']:.0f}s "
+            f"interval = budget {result['mttr_budget_s']:.0f}s); breaker "
+            f"storm: {breaker['heals_attempted']} attempts, "
+            f"{breaker['rate_limited']} rate-limited, trips "
+            f"{breaker['breaker_trips']}, ends "
+            f"{breaker['end_verdict']} -> passes={result['passes']}",
             file=sys.stderr,
         )
         return 0 if result["passes"] else 1

@@ -1,0 +1,208 @@
+"""Supervisor event ledger (provision/events.py): durability discipline
+(fsync'd appends, torn-final-line truncation, forward-compat schema
+skips), the replay fold a restarted supervisor resumes from, the fleet
+status document, and the shared pid lock (state.PidLock)."""
+
+import json
+import os
+
+import pytest
+
+from tritonk8ssupervisor_tpu.provision import events as ev
+from tritonk8ssupervisor_tpu.provision.state import (
+    LockHeldError,
+    PidLock,
+)
+
+
+def quiet_ledger(tmp_path, clock=None, name="events.jsonl"):
+    kwargs = {"echo": lambda line: None}
+    if clock is not None:
+        kwargs["clock"] = clock
+    return ev.EventLedger(tmp_path / name, **kwargs)
+
+
+# --------------------------------------------------------- append + replay
+
+
+def test_append_replay_roundtrip(tmp_path):
+    led = quiet_ledger(tmp_path, clock=lambda: 42.0)
+    led.append(ev.TICK, tick=1, states={"0": "healthy"})
+    led.append(ev.VERDICT, slice=0, state="missing", detail="gone")
+    records = led.replay()
+    assert [r["kind"] for r in records] == [ev.TICK, ev.VERDICT]
+    assert all(r["v"] == ev.SCHEMA_VERSION and r["ts"] == 42.0
+               for r in records)
+    assert records[0]["states"] == {"0": "healthy"}
+
+
+def test_torn_final_line_truncated_mid_corruption_fatal(tmp_path):
+    led = quiet_ledger(tmp_path)
+    led.append(ev.TICK, tick=1)
+    led.append(ev.HEAL_START, id="h1", slices=[2])
+    with led.path.open("a") as f:
+        f.write('{"v": 1, "kind": "heal-do')  # the interrupted write
+    records = led.replay()
+    assert [r["kind"] for r in records] == [ev.TICK, ev.HEAL_START]
+    # physically truncated: later appends produce a parseable ledger
+    lines = led.path.read_text().splitlines()
+    assert len(lines) == 2 and json.loads(lines[-1])["kind"] == ev.HEAL_START
+    led.append(ev.HEAL_DONE, id="h1", slices=[2])
+    assert led.replay()[-1]["kind"] == ev.HEAL_DONE
+
+    bad = quiet_ledger(tmp_path, name="corrupt.jsonl")
+    bad.append(ev.TICK, tick=1)
+    raw = bad.path.read_text()
+    bad.path.write_text("GARBAGE\n" + raw)
+    with pytest.raises(ev.EventLedgerError, match="corrupt at line 1"):
+        bad.replay()
+
+
+def test_newer_schema_records_skipped(tmp_path):
+    led = quiet_ledger(tmp_path)
+    led.append(ev.TICK, tick=1)
+    with led.path.open("a") as f:
+        f.write(json.dumps({"v": ev.SCHEMA_VERSION + 1,
+                            "kind": "quantum-verdict"}) + "\n")
+    assert [r["kind"] for r in led.replay()] == [ev.TICK]
+
+
+def test_missing_ledger_replays_empty_and_scrub_idempotent(tmp_path):
+    led = quiet_ledger(tmp_path)
+    assert led.replay() == []
+    led.scrub()  # nothing to delete: never an error
+    led.append(ev.SUPERVISOR_START, pid=1)
+    led.scrub()
+    assert not led.path.exists()
+
+
+# ------------------------------------------------------------------- fold
+
+
+def seeded_records():
+    """A supervisor lifetime: start, preemption verdict, one successful
+    heal, one failed heal, a rate-limit refusal, a breaker trip."""
+    return [
+        {"ts": 0.0, "kind": ev.SUPERVISOR_START, "pid": 7},
+        {"ts": 30.0, "kind": ev.TICK, "tick": 1,
+         "states": {"0": "healthy", "1": "healthy"}},
+        {"ts": 60.0, "kind": ev.VERDICT, "slice": 1, "state": "missing",
+         "detail": "absent from the Cloud TPU listing", "streak": 1},
+        {"ts": 90.0, "kind": ev.HEAL_START, "id": "h1", "slices": [1]},
+        {"ts": 240.0, "kind": ev.HEAL_DONE, "id": "h1", "slices": [1],
+         "seconds": 150.0, "mttr_s": [180.0]},
+        {"ts": 300.0, "kind": ev.VERDICT, "slice": 1, "state": "healthy",
+         "detail": "", "streak": 0},
+        {"ts": 390.0, "kind": ev.VERDICT, "slice": 0, "state": "unready",
+         "detail": "10.0.0.1 (rc 255)", "streak": 2},
+        {"ts": 400.0, "kind": ev.HEAL_START, "id": "h2", "slices": [0]},
+        {"ts": 460.0, "kind": ev.HEAL_FAILED, "id": "h2", "slices": [0],
+         "error": "timed out"},
+        {"ts": 500.0, "kind": ev.RATE_LIMITED, "slice": 0,
+         "retry_at": 700.0},
+        {"ts": 700.0, "kind": ev.BREAKER_OPEN, "failures": 3,
+         "reopen_at": 1300.0, "trip": 1},
+        {"ts": 730.0, "kind": ev.DEGRADED_HOLD, "slices": [0]},
+    ]
+
+
+def test_fold_counters_states_and_breaker():
+    view = ev.fold(seeded_records())
+    assert view.started == 0.0 and view.stopped is None
+    assert view.ticks == 1
+    assert view.heals_attempted == 2
+    assert view.heals_succeeded == 1 and view.heals_failed == 1
+    assert view.rate_limited == 1 and view.held_ticks == 1
+    assert view.mttr_samples == [180.0]
+    assert view.breaker_state == "open"
+    assert view.breaker_reopen_at == 1300.0
+    assert view.breaker_failures == [460.0]
+    assert view.open_heals == []  # both heals completed
+    assert view.slices[1].state == "healthy"
+    assert view.slices[1].heal_starts == [90.0]
+    assert view.slices[1].heals_succeeded == 1
+
+
+def test_fold_orphaned_heal_start_is_the_crash_signature():
+    records = seeded_records()[:4]  # ends inside heal h1
+    view = ev.fold(records)
+    assert len(view.open_heals) == 1
+    assert view.open_heals[0]["id"] == "h1"
+    assert view.slices[1].heal_starts == [90.0]  # spent either way
+
+
+def test_breaker_close_clears_failure_window():
+    records = seeded_records() + [
+        {"ts": 1400.0, "kind": ev.HEAL_START, "id": "h3", "slices": [0]},
+        {"ts": 1500.0, "kind": ev.HEAL_DONE, "id": "h3", "slices": [0],
+         "mttr_s": [1100.0]},
+        {"ts": 1500.0, "kind": ev.BREAKER_CLOSE},
+    ]
+    view = ev.fold(records)
+    assert view.breaker_state == "closed"
+    assert view.breaker_failures == []
+    assert view.breaker_reopen_at is None
+    assert view.breaker_trips == 1  # history survives the close
+
+
+# ----------------------------------------------------------- fleet status
+
+
+def test_fleet_status_document_shape():
+    doc = ev.fleet_status(ev.fold(seeded_records()), now=800.0, pid=7)
+    assert doc["supervisor"]["running"] is True
+    assert doc["supervisor"]["uptime_s"] == 800.0
+    assert doc["verdict"] == "degraded-hold"  # breaker open
+    assert doc["slices"]["1"]["state"] == "healthy"
+    assert doc["slices"]["1"]["heals_succeeded"] == 1
+    assert doc["heals"] == {
+        "attempted": 2, "succeeded": 1, "failed": 1,
+        "rate_limited": 1, "held_ticks": 1, "in_flight": 0,
+    }
+    assert doc["mttr_s"]["mean"] == 180.0
+    assert doc["breaker"]["state"] == "open"
+    assert doc["degraded"] == [0]  # slice 0's last verdict was unready
+
+
+def test_fleet_status_healthy_and_stopped():
+    records = [
+        {"ts": 0.0, "kind": ev.SUPERVISOR_START, "pid": 7},
+        {"ts": 30.0, "kind": ev.TICK, "tick": 1,
+         "states": {"0": "healthy"}},
+        {"ts": 60.0, "kind": ev.SUPERVISOR_STOP, "pid": 7, "ticks": 1},
+    ]
+    doc = ev.fleet_status(ev.fold(records), now=100.0)
+    assert doc["verdict"] == "healthy"
+    assert doc["supervisor"]["running"] is False
+    assert doc["supervisor"]["uptime_s"] is None
+    assert doc["degraded"] == []
+
+
+def test_write_fleet_status_atomic(tmp_path):
+    path = tmp_path / "sub" / "fleet-status.json"
+    ev.write_fleet_status(path, {"verdict": "healthy"})
+    assert json.loads(path.read_text()) == {"verdict": "healthy"}
+    assert [p.name for p in path.parent.iterdir()] == ["fleet-status.json"]
+
+
+# ---------------------------------------------------------------- PidLock
+
+
+def test_pidlock_excludes_live_holder_and_steals_dead(tmp_path):
+    lock_path = tmp_path / "supervisor.pid"
+    first = PidLock(lock_path)
+    with first:
+        second = PidLock(lock_path)
+        with pytest.raises(LockHeldError) as info:
+            second.acquire()
+        assert info.value.pid == os.getpid()
+    # released on exit: now acquirable
+    with PidLock(lock_path):
+        assert lock_path.read_text().strip() == str(os.getpid())
+    # a dead holder's lock is stolen, not fatal
+    lock_path.write_text("99999999\n")
+    stolen = []
+    with PidLock(lock_path, echo=stolen.append):
+        assert lock_path.read_text().strip() == str(os.getpid())
+    assert any("taking over" in line for line in stolen)
+    assert PidLock(tmp_path / "ghost.pid").holder() is None

@@ -240,6 +240,48 @@ def test_heal_shares_one_tpu_vm_listing_for_diagnosis(tmp_path):
     assert len(listings) == 1
 
 
+def test_heal_with_precomputed_health_and_only_slices(tmp_path):
+    """The supervisor's calling convention: a pre-computed FleetHealth
+    (no second diagnose probe round) and an explicit repair subset —
+    slice 2's drain is expected maintenance and must NOT be replaced
+    even though it is degraded, while slice 1 heals."""
+    paths, hosts = seed_world(tmp_path)
+    hosts.host_ips[1] = []
+    hosts.internal_ips[1] = []
+    hosts.save(paths.hosts_file)
+    health = heal_mod.FleetHealth([
+        heal_mod.SliceHealth(0, heal_mod.HEALTHY, hosts=("10.0.0.1",)),
+        heal_mod.SliceHealth(1, heal_mod.MISSING, "no hosts recorded"),
+        heal_mod.SliceHealth(2, heal_mod.DRAINING,
+                             "10.0.2.1: maintenance-event: TERMINATE",
+                             hosts=("10.0.2.1",)),
+    ])
+    world = HealWorld(paths)
+    assert heal_mod.heal(
+        cfg(), paths, Say(), run=world.run, run_quiet=world.run_quiet,
+        readiness_timeout=10.0, sleep=lambda s: None,
+        health=health, only_slices=[1],
+    ) is True
+    # no diagnose probes ran: the one tpu-vm listing belongs to the
+    # terraform/readiness leg, not a second diagnosis
+    applies = [c for c in world.calls if c.startswith("terraform apply")]
+    assert len(applies) == 1
+    assert "-replace=google_tpu_v2_vm.slice[1]" in applies[0]
+    assert "slice[2]" not in applies[0]  # draining: expected, untouched
+    # quarantine records only the healed subset, and clears on success
+    q = json.loads(paths.quarantine_file.read_text())
+    assert q["slices"] == {}
+    # a subset that excludes every degraded slice is a no-op
+    world2 = HealWorld(paths)
+    say = Say()
+    assert heal_mod.heal(
+        cfg(), paths, say, run=world2.run, run_quiet=world2.run_quiet,
+        health=health, only_slices=[0],
+    ) is True
+    assert not any(c.startswith("terraform apply") for c in world2.calls)
+    assert "nothing to heal" in say.text().lower()
+
+
 def test_heal_healthy_fleet_is_a_noop(tmp_path):
     paths, _ = seed_world(tmp_path)
     world = HealWorld(paths)

@@ -332,6 +332,109 @@ def test_cli_heal_without_deployment_is_friendly(fake_world, capsys):
     assert "ERROR:" in err and "provision first" in err
 
 
+def test_cli_supervise_one_tick_smoke_and_status(fake_world, capsys):
+    """Tier-1 smoke: one full supervise reconcile tick at the CLI over a
+    healthy deployment — the event ledger records the observation,
+    fleet-status.json is written atomically, and `status`/`status
+    --json` render it (exit 0 = healthy)."""
+    work, _ = fake_world
+    assert main(["--yes", "--config", str(saved_config(work)),
+                 "--workdir", str(work)]) == 0
+    capsys.readouterr()
+    assert main(["supervise", "--yes", "--workdir", str(work),
+                 "--ticks", "1", "--interval", "0.01"]) == 0
+    out = capsys.readouterr().out
+    assert "supervising 1 slice(s)" in out
+    paths = RunPaths(work)
+    records = [json.loads(l)
+               for l in paths.events.read_text().splitlines()]
+    kinds = [r["kind"] for r in records]
+    assert "supervisor-start" in kinds
+    assert "tick" in kinds and "supervisor-stop" in kinds
+    status = json.loads(paths.fleet_status.read_text())
+    assert status["verdict"] == "healthy"
+    assert status["slices"]["0"]["state"] == "healthy"
+    # the pid lock was released on clean exit
+    assert not paths.supervisor_pid.exists()
+
+    assert main(["status", "--workdir", str(work)]) == 0
+    out = capsys.readouterr().out
+    assert "fleet: healthy" in out and "slice 0: healthy" in out
+    assert main(["status", "--json", "--workdir", str(work)]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["verdict"] == "healthy"
+
+
+def test_cli_supervise_heals_lost_slice_unattended(fake_world, capsys):
+    """The acceptance drill at the CLI: a lost slice with the reconcile
+    loop running is confirmed over two ticks and healed with zero human
+    input — scoped terraform replace, hosts.json restored, status and
+    MTTR on the record."""
+    work, calls_log = fake_world
+    assert main(["--yes", "--config", str(saved_config(work)),
+                 "--workdir", str(work)]) == 0
+    paths = RunPaths(work)
+    record = json.loads(paths.hosts_file.read_text())
+    record["host_ips"] = [[]]  # the slice vanished
+    record["internal_ips"] = []
+    paths.hosts_file.write_text(json.dumps(record))
+    calls_log.write_text("")
+    capsys.readouterr()
+
+    assert main(["supervise", "--yes", "--workdir", str(work),
+                 "--ticks", "3", "--interval", "0.01"]) == 0
+    calls = calls_log.read_text()
+    assert "-replace=google_tpu_v2_vm.slice[0]" in calls
+    assert calls.count("terraform apply") == 1  # healed exactly once
+    healed = json.loads(paths.hosts_file.read_text())
+    assert healed["host_ips"] == [["10.0.0.1", "10.0.0.2"]]
+    status = json.loads(paths.fleet_status.read_text())
+    assert status["verdict"] == "healthy"
+    assert status["heals"] == {
+        "attempted": 1, "succeeded": 1, "failed": 0,
+        "rate_limited": 0, "held_ticks": 0, "in_flight": 0,
+    }
+    assert status["mttr_s"]["count"] == 1
+    assert main(["status", "--workdir", str(work)]) == 0
+    assert "heals: 1/1 succeeded" in capsys.readouterr().out
+
+
+def test_cli_supervise_without_deployment_is_friendly(fake_world, capsys):
+    work, _ = fake_world
+    assert main(["supervise", "--yes", "--workdir", str(work)]) == 1
+    err = capsys.readouterr().err
+    assert "ERROR:" in err and "provision first" in err
+
+
+def test_cli_status_without_supervisor_is_friendly(fake_world, capsys):
+    work, _ = fake_world
+    assert main(["status", "--workdir", str(work)]) == 1
+    err = capsys.readouterr().err
+    assert "ERROR:" in err and "supervise" in err
+
+
+def test_clean_stops_supervisor_and_scrubs_event_ledger(fake_world, capsys):
+    """Teardown's supervisor contract: a (stale) supervisor pid lockfile
+    is cleared, and the event ledger + fleet status are scrubbed LAST —
+    after the journal — so an interrupted clean keeps the flight
+    record."""
+    work, _ = fake_world
+    assert main(["--yes", "--config", str(saved_config(work)),
+                 "--workdir", str(work)]) == 0
+    capsys.readouterr()
+    assert main(["supervise", "--yes", "--workdir", str(work),
+                 "--ticks", "1", "--interval", "0.01"]) == 0
+    paths = RunPaths(work)
+    paths.supervisor_pid.write_text("99999999\n")  # crashed supervisor
+    assert paths.events.exists() and paths.fleet_status.exists()
+    capsys.readouterr()
+    assert main(["-c", "--yes", "--workdir", str(work)]) == 0
+    assert not paths.supervisor_pid.exists()
+    assert not paths.events.exists()
+    assert not paths.fleet_status.exists()
+    assert not paths.journal.exists()
+
+
 def test_clean_without_config_is_noop(fake_world, capsys):
     work, _ = fake_world
     assert main(["-c", "--yes", "--workdir", str(work)]) == 0

@@ -101,6 +101,75 @@ def test_watch_backs_off_on_repeated_fetch_errors(tmp_path):
     assert not (tmp_path / "d3").exists()
 
 
+def test_watch_on_event_sink_sees_event_before_drain_file(tmp_path):
+    """The supervisor-facing observation hook: on_event fires with every
+    successfully polled value (NONE included) BEFORE the drain file is
+    touched — scheduled maintenance is visible the instant the metadata
+    server announces it, not one poll interval later when the file
+    lands. A sink that raises is logged, never fatal."""
+    drain = tmp_path / "drain"
+    seen = []
+
+    def sink(event):
+        # the drain file must not exist yet when the pending event is
+        # first observed — the sink IS the earlier signal
+        seen.append((event, drain.exists()))
+
+    assert mt.watch(drain, once=True, fetch=lambda u, t: "NONE",
+                    on_event=sink, log=lambda m: None) is False
+    assert mt.watch(drain, once=True, fetch=lambda u, t: "TERMINATE",
+                    on_event=sink, log=lambda m: None) is True
+    assert seen == [("NONE", False), ("TERMINATE", False)]
+    assert drain.exists()  # written AFTER the sink saw the event
+
+    # an exploding sink is logged and the watchdog carries on: the
+    # drain file (the load-bearing signal) still lands
+    logs = []
+
+    def bad_sink(event):
+        raise RuntimeError("sink exploded")
+
+    drain2 = tmp_path / "drain2"
+    assert mt.watch(drain2, once=True, fetch=lambda u, t: "TERMINATE",
+                    on_event=bad_sink, log=logs.append) is True
+    assert drain2.exists()
+    assert any("sink failed" in line for line in logs)
+
+
+def test_watch_survives_and_logs_errors_past_the_backoff_cap(tmp_path):
+    """The satellite bugfix: before this, `interval * 2.0**errors`
+    overflowed after ~1000 consecutive fetch failures and CRASHED the
+    watchdog exactly when the metadata server had been down longest.
+    Past the cap the delay clamps to max_backoff and every failure is
+    still logged — with the consecutive count, so hours of outage read
+    as one ongoing incident, not a fresh blip."""
+    drain = tmp_path / "drain"
+    failures = 1500
+    calls = [0]
+
+    def fetch(url, timeout):
+        calls[0] += 1
+        raise OSError("conn refused")
+
+    sleeps = []
+    logs = []
+
+    def sleeper(s):
+        sleeps.append(s)
+        if len(sleeps) >= failures:
+            raise StopIteration
+
+    with pytest.raises(StopIteration):
+        mt.watch(drain, interval=10.0, fetch=fetch, sleep=sleeper,
+                 log=logs.append, max_backoff=300.0)
+    assert len(sleeps) == failures  # no OverflowError anywhere
+    assert all(s <= 300.0 for s in sleeps)
+    assert sleeps[-1] == 300.0
+    assert len(logs) == failures  # logged, not swallowed
+    assert f"failed {failures} consecutive" in logs[-1]
+    assert "capped" in logs[-1]
+
+
 def test_drain_requested_contract(tmp_path, monkeypatch):
     drain = tmp_path / "drain"
     monkeypatch.setenv(mt.DRAIN_FILE_VAR, str(drain))

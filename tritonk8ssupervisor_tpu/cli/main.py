@@ -34,6 +34,7 @@ import argparse
 import dataclasses
 import os
 import shlex
+import signal
 import sys
 import time
 from pathlib import Path
@@ -46,12 +47,14 @@ from tritonk8ssupervisor_tpu.config.schema import ClusterConfig, ConfigError
 from tritonk8ssupervisor_tpu.provision import (
     ansible as ansible_mod,
     cache as cache_mod,
+    events as events_mod,
     heal as heal_mod,
     journal as journal_mod,
     readiness,
     retry,
     runner as run_mod,
     state,
+    supervisor as supervisor_mod,
     teardown,
     terraform as terraform_mod,
 )
@@ -69,13 +72,16 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument(
         "command",
         nargs="?",
-        choices=["heal"],
+        choices=["heal", "supervise", "status"],
         metavar="command",
         help="optional subcommand: `heal` diagnoses per-slice fleet "
         "health (missing / unready / draining) and repairs ONLY the "
         "broken slices — scoped terraform replace, ansible --limit, "
-        "scoped readiness — leaving healthy slices untouched "
-        "(docs/failure-modes.md, crash & repair runbook)",
+        "scoped readiness — leaving healthy slices untouched; "
+        "`supervise` runs the resident reconcile loop (detect drift, "
+        "rate-limited auto-heal, circuit breaker, durable event ledger); "
+        "`status` renders the machine-readable fleet status "
+        "(docs/failure-modes.md, running-unattended runbook)",
     )
     parser.add_argument(
         "-c", "--clean", action="store_true", help="destroy the cluster and all state"
@@ -92,6 +98,57 @@ def build_parser() -> argparse.ArgumentParser:
     )
     parser.add_argument(
         "--yes", action="store_true", help="skip confirmation gates (CI use)"
+    )
+    # ------------------------------------------------- supervise / status
+    # Defaults of None mean "take the SupervisePolicy default (or its
+    # TK8S_SUPERVISE_* env override)"; an explicit flag always wins.
+    parser.add_argument(
+        "--interval", type=float, default=None, metavar="SECONDS",
+        help="supervise: seconds between reconcile ticks (default 30; "
+        "env TK8S_SUPERVISE_INTERVAL)",
+    )
+    parser.add_argument(
+        "--ticks", type=int, default=0, metavar="N",
+        help="supervise: run exactly N reconcile ticks then exit "
+        "(default 0 = run until SIGTERM/SIGINT; teardown stops a "
+        "running supervisor via its pid lockfile)",
+    )
+    parser.add_argument(
+        "--flap-threshold", type=int, default=None, metavar="N",
+        help="supervise: consecutive unhealthy snapshots before a slice "
+        "is heal-eligible (default 2 — one transient SSH blip or stale "
+        "snapshot never triggers a terraform replace)",
+    )
+    parser.add_argument(
+        "--heal-burst", type=int, default=None, metavar="N",
+        help="supervise: per-slice heal token-bucket capacity "
+        "(default 2)",
+    )
+    parser.add_argument(
+        "--heal-refill", type=float, default=None, metavar="SECONDS",
+        help="supervise: seconds to mint one heal token per slice "
+        "(default 600)",
+    )
+    parser.add_argument(
+        "--breaker-threshold", type=int, default=None, metavar="K",
+        help="supervise: failed heals within --breaker-window that trip "
+        "the global circuit breaker to degraded-hold (default 3)",
+    )
+    parser.add_argument(
+        "--breaker-window", type=float, default=None, metavar="SECONDS",
+        help="supervise: sliding window for breaker failures "
+        "(default 1800)",
+    )
+    parser.add_argument(
+        "--breaker-cooldown", type=float, default=None, metavar="SECONDS",
+        help="supervise: base breaker cooldown before a half-open probe "
+        "heal; grows between consecutive trips with the retry engine's "
+        "decorrelated jitter (default 300)",
+    )
+    parser.add_argument(
+        "--json", action="store_true",
+        help="status: print the raw fleet-status JSON document instead "
+        "of the human summary",
     )
     parser.add_argument(
         "--config",
@@ -231,6 +288,10 @@ def main(argv: list[str] | None = None, prompter: Prompter | None = None) -> int
             return clean(args, paths, prompter)
         if args.command == "heal":
             return heal_cmd(args, paths, prompter)
+        if args.command == "supervise":
+            return supervise_cmd(args, paths, prompter)
+        if args.command == "status":
+            return status_cmd(args, paths, prompter)
         if args.show_config:
             return show_config(args, paths, prompter)
         return provision(args, paths, prompter)
@@ -242,6 +303,8 @@ def main(argv: list[str] | None = None, prompter: Prompter | None = None) -> int
         run_mod.CommandError,
         faults.FaultPlanError,
         journal_mod.JournalError,
+        events_mod.EventLedgerError,
+        supervisor_mod.SupervisorError,
         EndOfInput,
     ) as e:
         print(f"ERROR: {e}", file=sys.stderr)
@@ -338,6 +401,126 @@ def heal_cmd(args, paths: state.RunPaths, prompter: Prompter) -> int:
     )
     timer.report()
     return 0
+
+
+def supervise_policy_from_args(args) -> supervisor_mod.SupervisePolicy:
+    """TK8S_SUPERVISE_* env defaults, overridden by explicit flags."""
+    policy = supervisor_mod.SupervisePolicy.from_env()
+    overrides = {
+        "interval": args.interval,
+        "flap_threshold": args.flap_threshold,
+        "heal_burst": args.heal_burst,
+        "heal_refill_s": args.heal_refill,
+        "breaker_threshold": args.breaker_threshold,
+        "breaker_window_s": args.breaker_window,
+        "breaker_cooldown_s": args.breaker_cooldown,
+        "max_degraded": max(0, args.max_degraded) or None,
+    }
+    for field, value in overrides.items():
+        if value is not None:
+            setattr(policy, field, value)
+    return policy
+
+
+def supervise_cmd(args, paths: state.RunPaths, prompter: Prompter) -> int:
+    """`./setup.sh supervise` — the resident reconcile loop
+    (provision/supervisor.py): each tick diagnoses the fleet and drives
+    it back to spec through the slice-scoped heal path, governed by the
+    flap filter, the per-slice heal rate limiter, and the global circuit
+    breaker; every observation/verdict/heal/breaker transition lands in
+    the durable event ledger, and fleet-status.json is rewritten
+    atomically for scrapers. Runs until SIGTERM/SIGINT (or --ticks N);
+    teardown stops it via the pid lockfile."""
+    source = args.config or paths.config_file
+    if not source.exists():
+        raise state.MissingStateError(
+            f"no configuration at {source} — supervise watches an "
+            "existing deployment; run ./setup.sh to provision first"
+        )
+    config = store.load_config_file(source)
+    config.validate()
+    timer = PhaseTimer(logfile=paths.runlog)
+    run, run_quiet = build_runners(args.fault_plan, timer)
+    ssh_key: Path | str = ""
+    ssh_user = ""
+    if config.mode == "tpu-vm":
+        ssh_key = discovery.find_ssh_key()
+        ssh_user = discovery.ssh_username()
+    sup = supervisor_mod.Supervisor(
+        config, paths, prompter,
+        run=run, run_quiet=run_quiet,
+        policy=supervise_policy_from_args(args),
+        ssh_key=str(ssh_key), ssh_user=ssh_user,
+        timer=timer,
+        readiness_timeout=args.readiness_timeout,
+    )
+    # a signalled stop finishes the current tick, appends supervisor-stop,
+    # and releases the pid lock — what teardown's SIGTERM relies on
+    try:
+        signal.signal(signal.SIGTERM, lambda *_: sup.request_stop())
+    except ValueError:
+        pass  # not the main thread (tests): --ticks bounds the loop
+    return sup.run(ticks=max(0, args.ticks))
+
+
+def status_cmd(args, paths: state.RunPaths, prompter: Prompter) -> int:
+    """`./setup.sh status [--json]` — the machine-readable fleet status.
+    Prefers the atomically rewritten fleet-status.json (cheap, what
+    scrapers poll); falls back to folding the event ledger when the
+    status file is missing (e.g. the supervisor died before its first
+    publish). Exit code 0 = healthy, 2 = degraded/holding."""
+    import json as json_mod
+    import time as time_mod
+
+    if paths.fleet_status.exists():
+        doc = json_mod.loads(paths.fleet_status.read_text())
+    elif paths.events.exists():
+        ledger = events_mod.EventLedger(paths.events)
+        doc = events_mod.fleet_status(
+            events_mod.fold(ledger.replay()), time_mod.time()
+        )
+    else:
+        raise state.MissingStateError(
+            f"no fleet status at {paths.fleet_status} and no event "
+            f"ledger at {paths.events} — run ./setup.sh supervise to "
+            "start the reconcile loop"
+        )
+    if args.json:
+        prompter.say(json_mod.dumps(doc, indent=2, sort_keys=True))
+    else:
+        sup = doc.get("supervisor", {})
+        prompter.say(f"fleet: {doc.get('verdict', 'unknown')}")
+        running = "running" if sup.get("running") else "stopped"
+        uptime = sup.get("uptime_s")
+        prompter.say(
+            f"supervisor: {running}"
+            + (f" (pid {sup.get('pid')}, up {uptime:.0f}s, "
+               f"{sup.get('ticks', 0)} ticks)"
+               if sup.get("running") and uptime is not None else "")
+        )
+        for index, entry in sorted(doc.get("slices", {}).items()):
+            detail = f" ({entry['detail']})" if entry.get("detail") else ""
+            prompter.say(f"  slice {index}: {entry.get('state')}{detail}")
+        heals = doc.get("heals", {})
+        prompter.say(
+            f"heals: {heals.get('succeeded', 0)}/"
+            f"{heals.get('attempted', 0)} succeeded, "
+            f"{heals.get('failed', 0)} failed, "
+            f"{heals.get('rate_limited', 0)} rate-limited"
+        )
+        mttr = doc.get("mttr_s", {})
+        if mttr.get("count"):
+            prompter.say(
+                f"mttr: mean {mttr['mean']:.0f}s over {mttr['count']} "
+                f"incident(s) (last {mttr['last']:.0f}s)"
+            )
+        breaker = doc.get("breaker", {})
+        prompter.say(
+            f"breaker: {breaker.get('state', 'closed')}"
+            + (f" (reopen at {breaker.get('reopen_at'):.0f})"
+               if breaker.get("reopen_at") else "")
+        )
+    return 0 if doc.get("verdict") == "healthy" else 2
 
 
 def provision(args, paths: state.RunPaths, prompter: Prompter) -> int:

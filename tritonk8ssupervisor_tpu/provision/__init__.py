@@ -9,4 +9,11 @@ is testable with stub binaries (SURVEY.md §4: fake-cluster harness).
 scheduler.py executes these runners as a dependency DAG instead of the
 reference's straight line — independent phases overlap, probes fan out,
 and the runlog records the schedule (docs/performance.md).
+
+supervisor.py + events.py are the resident layer on top: a continuous
+reconcile loop (`./setup.sh supervise`) that detects drift each tick
+and drives the fleet back to spec through the heal path — flap
+suppression, per-slice heal rate limiting, a circuit breaker that
+holds degraded, all decisions on a durable event ledger powering
+`./setup.sh status` and fleet-status.json (docs/failure-modes.md).
 """

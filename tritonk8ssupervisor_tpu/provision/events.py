@@ -1,0 +1,335 @@
+"""Durable supervisor event ledger + machine-readable fleet status.
+
+The provisioning journal (provision/journal.py) records what the DAG
+*did*; this ledger records what the fleet *was* — the supervisor's
+(provision/supervisor.py) flight recorder. Every observation, per-slice
+verdict change, heal attempt/outcome, rate-limit refusal, and circuit-
+breaker transition is appended as one JSONL record with the same
+durability discipline as the journal:
+
+- append + flush + fsync, so every record survives a SIGKILL landing on
+  the next instruction;
+- a torn FINAL line (the one write a kill interrupted) is detected and
+  physically truncated on replay, never fatal; mid-file corruption with
+  valid records after it raises;
+- records from a newer schema version are skipped, not misread.
+
+Replaying the ledger is how a restarted supervisor resumes without
+amnesia: `fold()` rebuilds the per-slice heal history (token-bucket
+consumption), the breaker's failure window and state, the counters, and
+any heal-start without a matching done/failed — the crash signature a
+restart must treat as an attempt already spent, so a kill mid-heal can
+never buy a slice extra heals past the rate limit.
+
+The same fold powers `./setup.sh status [--json]` and the periodically
+rewritten `fleet-status.json` (state.RunPaths.fleet_status, atomic
+temp+replace) that external scrapers poll: uptime, per-slice state,
+heals attempted/succeeded, MTTR, breaker state.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import sys
+import threading
+import time
+from pathlib import Path
+
+SCHEMA_VERSION = 1
+
+# Event kinds. One vocabulary shared by the writer (supervisor), the
+# replay fold, and the docs (docs/failure-modes.md "running unattended").
+SUPERVISOR_START = "supervisor-start"
+SUPERVISOR_STOP = "supervisor-stop"
+TICK = "tick"  # one reconcile observation: per-slice states
+VERDICT = "verdict"  # a slice's state CHANGED (healthy -> missing, ...)
+MAINTENANCE = "maintenance"  # a slice began draining for maintenance
+HEAL_START = "heal-start"
+HEAL_DONE = "heal-done"
+HEAL_FAILED = "heal-failed"
+RATE_LIMITED = "rate-limited"  # heal wanted, token bucket said no
+BREAKER_OPEN = "breaker-open"
+BREAKER_HALF_OPEN = "breaker-half-open"
+BREAKER_CLOSE = "breaker-close"
+DEGRADED_HOLD = "degraded-hold"  # breaker open: observing, not healing
+
+
+class EventLedgerError(RuntimeError):
+    """The ledger itself is unusable (mid-file corruption, bad schema)."""
+
+
+class EventLedger:
+    """Append-only fsync'd JSONL event log. The supervisor holds the
+    workdir's pid lock (state.PidLock) while writing; `replay()` is
+    read-only and lock-free (the status command reads a live ledger)."""
+
+    def __init__(
+        self,
+        path: Path,
+        clock=time.time,
+        echo=lambda line: print(line, file=sys.stderr, flush=True),
+    ) -> None:
+        self.path = Path(path)
+        self._clock = clock
+        self._echo = echo
+        self._mutex = threading.Lock()
+
+    def append(self, kind: str, **fields) -> dict:
+        record = {"v": SCHEMA_VERSION, "ts": self._clock(), "kind": kind,
+                  **fields}
+        line = json.dumps(record, sort_keys=True) + "\n"
+        with self._mutex:
+            self.path.parent.mkdir(parents=True, exist_ok=True)
+            with self.path.open("a") as f:
+                f.write(line)
+                f.flush()
+                os.fsync(f.fileno())
+        return record
+
+    def replay(self) -> list[dict]:
+        """All records in append order — torn final line truncated away
+        (the interrupted write), mid-file corruption fatal, newer-schema
+        records skipped (forward compat)."""
+        if not self.path.exists():
+            return []
+        raw = self.path.read_text()
+        records: list[dict] = []
+        lines = raw.splitlines(keepends=True)
+        good_bytes = 0
+        for i, line in enumerate(lines):
+            stripped = line.strip()
+            if not stripped:
+                good_bytes += len(line)
+                continue
+            try:
+                record = json.loads(stripped)
+                if not isinstance(record, dict) or "kind" not in record:
+                    raise ValueError("record is not an event")
+            except (json.JSONDecodeError, ValueError) as e:
+                if i == len(lines) - 1:
+                    self._echo(
+                        f"event ledger {self.path}: torn final line "
+                        f"(interrupted write) truncated: {stripped[:60]!r}"
+                    )
+                    with self.path.open("r+") as f:
+                        f.truncate(good_bytes)
+                    break
+                raise EventLedgerError(
+                    f"event ledger {self.path} corrupt at line {i + 1} "
+                    f"with valid records after it: {e}"
+                ) from e
+            good_bytes += len(line)
+            if record.get("v", 0) > SCHEMA_VERSION:
+                continue  # a newer supervisor's record: opaque, skip
+            records.append(record)
+        return records
+
+    def scrub(self) -> None:
+        """Delete the ledger — teardown's LAST act (after even the
+        journal), so a clean that crashes halfway leaves the full flight
+        record of what the supervisor saw and did."""
+        self.path.unlink(missing_ok=True)
+
+
+# ------------------------------------------------------------ replay fold
+
+
+@dataclasses.dataclass
+class SliceView:
+    """One slice's folded history: last verdict + heal bookkeeping."""
+
+    index: int
+    state: str = "unknown"
+    detail: str = ""
+    since: float | None = None  # ts of the last state CHANGE
+    streak: int = 0  # consecutive unhealthy observations (last run's)
+    heal_starts: list = dataclasses.field(default_factory=list)  # ts list
+    heals_succeeded: int = 0
+    heals_failed: int = 0
+
+
+@dataclasses.dataclass
+class LedgerView:
+    """The replayed ledger folded into what a restart (and the status
+    command) needs. `open_heals` are heal-starts without a matching
+    done/failed — the crash signature: the supervisor died mid-heal, and
+    those attempts are SPENT against the rate limit on resume."""
+
+    started: float | None = None
+    stopped: float | None = None
+    ticks: int = 0
+    slices: dict = dataclasses.field(default_factory=dict)  # int -> SliceView
+    heals_attempted: int = 0
+    heals_succeeded: int = 0
+    heals_failed: int = 0
+    rate_limited: int = 0
+    held_ticks: int = 0  # degraded-hold observations
+    breaker_state: str = "closed"
+    breaker_since: float | None = None
+    breaker_reopen_at: float | None = None
+    breaker_trips: int = 0
+    breaker_failures: list = dataclasses.field(default_factory=list)  # ts
+    open_heals: list = dataclasses.field(default_factory=list)  # records
+    # heal-start id -> record, until a done/failed closes it (the list
+    # above is kept in sync — it is the public face, this is the index)
+    pending_heals: dict = dataclasses.field(default_factory=dict)
+    mttr_samples: list = dataclasses.field(default_factory=list)  # seconds
+    last_ts: float | None = None
+
+    def slice_view(self, index: int) -> SliceView:
+        return self.slices.setdefault(int(index), SliceView(int(index)))
+
+
+def apply(view: LedgerView, record: dict) -> LedgerView:
+    """Fold ONE event into the view. The supervisor applies each record
+    as it appends it, so a week-long reconcile loop keeps an O(1)-per-
+    tick live view instead of re-reading its whole ledger every status
+    publish; `fold()` is the same function looped over a replay."""
+    kind = record.get("kind", "")
+    ts = record.get("ts")
+    view.last_ts = ts
+    if kind == SUPERVISOR_START:
+        view.started = ts
+        view.stopped = None
+    elif kind == SUPERVISOR_STOP:
+        view.stopped = ts
+    elif kind == TICK:
+        view.ticks += 1
+        for index, state in (record.get("states") or {}).items():
+            view.slice_view(int(index)).state = state
+    elif kind == VERDICT:
+        sv = view.slice_view(record.get("slice", -1))
+        sv.state = record.get("state", "unknown")
+        sv.detail = record.get("detail", "")
+        sv.since = ts
+        sv.streak = record.get("streak", 0)
+    elif kind == HEAL_START:
+        view.heals_attempted += 1
+        view.pending_heals[record.get("id",
+                                      len(view.pending_heals))] = record
+        view.open_heals = list(view.pending_heals.values())
+        for index in record.get("slices", []):
+            view.slice_view(index).heal_starts.append(ts)
+    elif kind in (HEAL_DONE, HEAL_FAILED):
+        view.pending_heals.pop(record.get("id", -1), None)
+        view.open_heals = list(view.pending_heals.values())
+        if kind == HEAL_DONE:
+            view.heals_succeeded += 1
+            for index in record.get("slices", []):
+                view.slice_view(index).heals_succeeded += 1
+            for sample in record.get("mttr_s", []):
+                view.mttr_samples.append(sample)
+        else:
+            view.heals_failed += 1
+            view.breaker_failures.append(ts)
+            for index in record.get("slices", []):
+                view.slice_view(index).heals_failed += 1
+    elif kind == RATE_LIMITED:
+        view.rate_limited += 1
+    elif kind == DEGRADED_HOLD:
+        view.held_ticks += 1
+    elif kind == BREAKER_OPEN:
+        view.breaker_state = "open"
+        view.breaker_since = ts
+        view.breaker_reopen_at = record.get("reopen_at")
+        view.breaker_trips += 1
+    elif kind == BREAKER_HALF_OPEN:
+        view.breaker_state = "half-open"
+        view.breaker_since = ts
+    elif kind == BREAKER_CLOSE:
+        view.breaker_state = "closed"
+        view.breaker_since = ts
+        view.breaker_reopen_at = None
+        view.breaker_failures = []
+    return view
+
+
+def fold(records: list[dict]) -> LedgerView:
+    """One pass over the replayed ledger. Counters span the ledger's whole
+    lifetime (restarts included); breaker/open-heal state is last-wins."""
+    view = LedgerView()
+    for record in records:
+        apply(view, record)
+    return view
+
+
+# ------------------------------------------------------------ fleet status
+
+
+def fleet_status(view: LedgerView, now: float, pid: int | None = None) -> dict:
+    """The machine-readable status document. Written atomically to
+    fleet-status.json every reconcile tick and rendered by
+    `./setup.sh status [--json]`; schema documented in
+    docs/failure-modes.md (running unattended)."""
+    from tritonk8ssupervisor_tpu.provision import heal as heal_mod
+
+    degraded = sorted(
+        sv.index for sv in view.slices.values()
+        if sv.state not in (heal_mod.HEALTHY, "unknown")
+    )
+    healing = bool(view.open_heals)
+    if view.breaker_state != "closed":
+        verdict = "degraded-hold"
+    elif degraded:
+        verdict = "recovering" if healing else "degraded"
+    else:
+        verdict = "healthy"
+    mttr = view.mttr_samples
+    return {
+        "v": SCHEMA_VERSION,
+        "updated": now,
+        "supervisor": {
+            "pid": pid,
+            "running": view.started is not None and view.stopped is None,
+            "started": view.started,
+            "uptime_s": (
+                round(now - view.started, 3)
+                if view.started is not None and view.stopped is None
+                else None
+            ),
+            "ticks": view.ticks,
+        },
+        "verdict": verdict,
+        "slices": {
+            str(sv.index): {
+                "state": sv.state,
+                "detail": sv.detail,
+                "since": sv.since,
+                "heals_attempted": len(sv.heal_starts),
+                "heals_succeeded": sv.heals_succeeded,
+                "heals_failed": sv.heals_failed,
+            }
+            for sv in sorted(view.slices.values(), key=lambda s: s.index)
+        },
+        "degraded": degraded,
+        "heals": {
+            "attempted": view.heals_attempted,
+            "succeeded": view.heals_succeeded,
+            "failed": view.heals_failed,
+            "rate_limited": view.rate_limited,
+            "held_ticks": view.held_ticks,
+            "in_flight": len(view.open_heals),
+        },
+        "mttr_s": {
+            "count": len(mttr),
+            "mean": round(sum(mttr) / len(mttr), 3) if mttr else None,
+            "last": mttr[-1] if mttr else None,
+        },
+        "breaker": {
+            "state": view.breaker_state,
+            "since": view.breaker_since,
+            "reopen_at": view.breaker_reopen_at,
+            "trips": view.breaker_trips,
+            "failures_on_record": len(view.breaker_failures),
+        },
+    }
+
+
+def write_fleet_status(path: Path, status: dict) -> None:
+    from tritonk8ssupervisor_tpu.provision.state import atomic_write_text
+
+    atomic_write_text(
+        Path(path), json.dumps(status, indent=2, sort_keys=True) + "\n"
+    )

@@ -233,7 +233,10 @@ def heal(
     timer=None,
     check_drain: bool = True,
     sleep=time.sleep,
+    clock=time.monotonic,
     cache: "cache_mod.WarmCache | None" = None,
+    health: "FleetHealth | None" = None,
+    only_slices=None,
 ) -> bool:
     """Diagnose and repair the fleet at slice granularity.
 
@@ -242,6 +245,17 @@ def heal(
     quarantined and emptied from hosts.json — N-of-M success). Breakage
     beyond the budget re-raises the readiness timeout; terraform/ansible
     failures raise through the normal error path, retries included.
+
+    `health` supplies a pre-computed diagnosis instead of probing again —
+    the supervisor (provision/supervisor.py) diagnoses every reconcile
+    tick and must not pay (or race) a second probe round inside the heal
+    it then orders. `only_slices` restricts the repair to that subset of
+    the degraded slices: the supervisor's flap filter and drain verdicts
+    decide WHAT is heal-eligible (a slice draining for maintenance is
+    expected, not broken), the rate limiter decides WHEN, and this
+    function only executes the order. Manual `./setup.sh heal` passes
+    neither and keeps repairing everything degraded, draining included —
+    an operator typing `heal` has decided the drain is over.
 
     Converge shares the provision pipeline's warm path
     (provision/cache.py): each repaired slice's cache entry is
@@ -259,23 +273,27 @@ def heal(
         )
     if cache is None:
         cache = cache_mod.WarmCache(paths.warm_cache)
-    # one batched `tpu-vm list` snapshot feeds the diagnosis AND any
-    # readiness probes inside this run (satellite of the PR-2 batching)
-    snapshot = readiness.FleetSnapshot(config, run_quiet=run_quiet)
 
     def phase(name: str):
         return (timer.phase(name) if timer is not None
                 else contextlib.nullcontext())
 
-    with phase("heal-diagnose"):
-        health = diagnose(
-            config, paths, run_quiet=run_quiet,
-            ssh_user=ssh_user, ssh_key=ssh_key, check_drain=check_drain,
-            snapshot=snapshot,
-        )
+    if health is None:
+        # one batched `tpu-vm list` snapshot feeds the diagnosis AND any
+        # readiness probes inside this run (satellite of the PR-2 batching)
+        snapshot = readiness.FleetSnapshot(config, run_quiet=run_quiet)
+        with phase("heal-diagnose"):
+            health = diagnose(
+                config, paths, run_quiet=run_quiet,
+                ssh_user=ssh_user, ssh_key=ssh_key, check_drain=check_drain,
+                snapshot=snapshot,
+            )
     for line in health.summary():
         prompter.say(f"  {line}")
     bad = health.degraded
+    if only_slices is not None:
+        wanted = {int(i) for i in only_slices}
+        bad = [i for i in bad if i in wanted]
     if not bad:
         prompter.say("Fleet healthy; nothing to heal.")
         return True
@@ -285,7 +303,7 @@ def heal(
     record_quarantine(paths, {
         s.index: {"state": s.state, "detail": s.detail,
                   "hosts": list(s.hosts)}
-        for s in health.slices if s.state != HEALTHY
+        for s in health.slices if s.index in bad
     })
     prompter.say(
         f"Healing slice(s) {', '.join(str(i) for i in bad)} "
@@ -328,6 +346,11 @@ def heal(
                 interval=5.0,
                 timeout=readiness_timeout,
                 sleep=sleep,
+                clock=clock,
+                # progress through the prompter: the supervisor's drills
+                # (and bench JSON consumers) capture say(), and the CLI's
+                # prompter prints — same visibility, no stray stdout
+                echo=lambda line: prompter.say(line),
             )
         except readiness.NotReadyError:
             verdicts = readiness.slice_ssh_verdicts(

@@ -34,9 +34,10 @@ interrupted) is detectable and truncatable, never fatal. On re-run,
   dirties the whole suffix).
 
 Everything else — the dirty suffix — re-executes, with attempt numbers
-continuing the recorded history. A lockfile (pid-stamped, O_EXCL) rejects
-a second concurrent supervisor: two writers interleaving an append-only
-log would corrupt the one artifact whose integrity resume depends on.
+continuing the recorded history. A lockfile (pid-stamped, O_EXCL —
+state.PidLock, shared with the event ledger) rejects a second concurrent
+supervisor: two writers interleaving an append-only log would corrupt
+the one artifact whose integrity resume depends on.
 """
 
 from __future__ import annotations
@@ -50,6 +51,8 @@ import threading
 import time
 from pathlib import Path
 from typing import Iterable
+
+from tritonk8ssupervisor_tpu.provision.state import LockHeldError, PidLock
 
 SCHEMA_VERSION = 1
 
@@ -118,57 +121,34 @@ class Journal:
         self._clock = clock
         self._echo = echo
         self._mutex = threading.Lock()  # scheduler workers append concurrently
-        self._locked = False
+        self._lock = PidLock(
+            self.lock_path,
+            echo=lambda line: self._echo(
+                f"stale journal lock {self.lock_path} (holder dead); "
+                "taking over"
+            ),
+        )
 
     # ------------------------------------------------------------- locking
 
     def acquire(self) -> "Journal":
-        """Take the single-writer lock. A live pid in the lockfile means a
-        second supervisor is running — reject; a dead pid is the residue
-        of a crash (exactly the case resume exists for) and is stolen."""
-        self.path.parent.mkdir(parents=True, exist_ok=True)
-        while True:
-            try:
-                fd = os.open(self.lock_path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
-            except FileExistsError:
-                holder = self._lock_holder()
-                if holder is not None:
-                    raise JournalLockedError(
-                        f"journal {self.path} is locked by live supervisor "
-                        f"pid {holder} ({self.lock_path}); two concurrent "
-                        "provision runs over one workdir would corrupt the "
-                        "ledger — wait for it or kill it first"
-                    )
-                self._echo(
-                    f"stale journal lock {self.lock_path} (holder dead); "
-                    "taking over"
-                )
-                self.lock_path.unlink(missing_ok=True)
-                continue
-            os.write(fd, f"{os.getpid()}\n".encode())
-            os.close(fd)
-            self._locked = True
-            return self
-
-    def _lock_holder(self) -> int | None:
-        """Pid in the lockfile when that process is still alive, else None
-        (stale lock or unreadable file — both safe to steal)."""
+        """Take the single-writer lock (state.PidLock). A live pid in the
+        lockfile means a second supervisor is running — reject; a dead pid
+        is the residue of a crash (exactly the case resume exists for)
+        and is stolen."""
         try:
-            pid = int(self.lock_path.read_text().strip())
-        except (OSError, ValueError):
-            return None
-        try:
-            os.kill(pid, 0)
-        except ProcessLookupError:
-            return None
-        except PermissionError:
-            return pid  # alive, just not ours to signal
-        return pid
+            self._lock.acquire()
+        except LockHeldError as e:
+            raise JournalLockedError(
+                f"journal {self.path} is locked by live supervisor "
+                f"pid {e.pid} ({self.lock_path}); two concurrent "
+                "provision runs over one workdir would corrupt the "
+                "ledger — wait for it or kill it first"
+            ) from e
+        return self
 
     def release(self) -> None:
-        if self._locked:
-            self.lock_path.unlink(missing_ok=True)
-            self._locked = False
+        self._lock.release()
 
     def __enter__(self) -> "Journal":
         return self.acquire()

@@ -128,6 +128,7 @@ def watch(
     sleep: Callable[[float], None] = time.sleep,
     log: Callable[[str], None] = print,
     max_backoff: float = 300.0,
+    on_event: Callable[[str], None] | None = None,
 ) -> bool:
     """Poll the metadata server, owning the drain file's lifecycle:
     write it while an event is pending, REMOVE it once the event clears
@@ -136,11 +137,24 @@ def watch(
     window). once=True polls a single time and returns whether a drain
     was requested; the continuous mode never returns.
 
+    `on_event` is the observation sink: called with every successfully
+    polled value (including "NONE") BEFORE the drain file is touched, so
+    a supervisor embedding the watchdog sees scheduled maintenance the
+    instant the metadata server announces it — not one poll interval
+    later when the drain file lands on disk. A sink that raises is
+    logged and never kills the watchdog (the drain file is the
+    load-bearing signal; the sink is advisory).
+
     Repeated fetch failures back off exponentially (doubling from
     `interval` up to `max_backoff`) instead of hammering a struggling
     metadata server at full cadence, and an errored poll leaves the
     drain file untouched — "cannot ask" must not clear a pending drain
-    the way a genuine NONE does."""
+    the way a genuine NONE does. The error count feeds the log line so
+    a metadata server that has been unreachable for hours reads as "has
+    failed N consecutive times", not as a fresh one-off — and the
+    doubling is clamped once the cap is reached (an unbounded exponent
+    would overflow after ~1000 consecutive failures and crash the
+    watchdog exactly when it is needed most)."""
     drain_file = Path(drain_file)
     fired = False
     consecutive_errors = 0
@@ -151,11 +165,25 @@ def watch(
             if once:
                 return fired
             consecutive_errors += 1
-            delay = min(max_backoff, interval * (2.0 ** consecutive_errors))
-            log(f"metadata fetch failed ({e}); backing off {delay:.0f}s")
+            # clamp the exponent: past the cap the delay is max_backoff
+            # anyway, and 2.0**1024 raises OverflowError
+            delay = min(max_backoff,
+                        interval * (2.0 ** min(consecutive_errors, 30)))
+            if delay >= max_backoff:
+                log(f"metadata fetch has failed {consecutive_errors} "
+                    f"consecutive time(s) ({e}); backing off "
+                    f"{delay:.0f}s (capped)")
+            else:
+                log(f"metadata fetch failed ({e}); backing off "
+                    f"{delay:.0f}s")
             sleep(delay)
             continue
         consecutive_errors = 0
+        if on_event is not None:
+            try:
+                on_event(event)
+            except Exception as e:  # noqa: BLE001 - sink is advisory
+                log(f"maintenance event sink failed ({e}); continuing")
         if event != "NONE":
             if not fired or not drain_file.exists():
                 log(f"maintenance event pending: {event}; requesting drain")

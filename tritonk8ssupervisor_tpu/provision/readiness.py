@@ -274,13 +274,26 @@ class FleetSnapshot:
         self._states: dict[str, str] | None = None
         self._fetched_at = 0.0
         self.fetches = 0
+        # Failed fetches are never cached, but a LONG-RUNNING consumer
+        # (the supervisor's reconcile loop) needs to see that its
+        # listings are erroring — a fleet that "looks healthy" because
+        # every listing failed is the opposite of supervised.
+        self.fetch_errors = 0
+        self.last_error = ""
 
     def states(self, max_age: float | None = None) -> dict[str, str]:
         ttl = self._ttl if max_age is None else max_age
         with self._lock:
             now = self._clock()
             if self._states is None or now - self._fetched_at > ttl:
-                self._states = tpu_vm_states(self._config, self._run_quiet)
+                try:
+                    self._states = tpu_vm_states(
+                        self._config, self._run_quiet
+                    )
+                except Exception as e:  # noqa: BLE001 - count, then raise
+                    self.fetch_errors += 1
+                    self.last_error = str(e)
+                    raise
                 self._fetched_at = now
                 self.fetches += 1
             return dict(self._states)

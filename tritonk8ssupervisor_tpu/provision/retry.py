@@ -190,6 +190,36 @@ class RetryPolicy:
         return min(self.max_delay, low + rng() * (high - low))
 
 
+class Cooldown:
+    """The decorrelated-jitter backoff sequence as reusable state.
+
+    `RetryPolicy.next_delay` lives inside one retry loop; some consumers
+    back off across EVENTS instead — the supervisor's circuit breaker
+    (provision/supervisor.py) grows its cooldown between consecutive
+    trips with exactly this formula (each delay drawn from
+    [base, 3*previous], capped) so repeated breaker trips against a
+    still-broken fleet space themselves out the way retried commands do.
+    `reset()` snaps back to base after a confirmed recovery."""
+
+    def __init__(
+        self,
+        base: float,
+        cap: float,
+        rng: Callable[[], float] = random.random,
+    ) -> None:
+        self._policy = RetryPolicy(base_delay=base, max_delay=cap)
+        self._rng = rng
+        self._previous = base
+
+    def next(self) -> float:
+        delay = self._policy.next_delay(self._previous, self._rng)
+        self._previous = delay
+        return delay
+
+    def reset(self) -> None:
+        self._previous = self._policy.base_delay
+
+
 def retrying_runner(
     run: RunFn,
     policy: RetryPolicy | None = None,

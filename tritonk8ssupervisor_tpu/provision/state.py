@@ -30,6 +30,81 @@ def atomic_write_text(path: Path, text: str) -> None:
     os.replace(tmp, path)
 
 
+class LockHeldError(RuntimeError):
+    """Another LIVE process holds the pid lockfile. Carries the holder's
+    pid so callers can name it (or, for teardown, signal it)."""
+
+    def __init__(self, path: Path, pid: int) -> None:
+        super().__init__(f"{path} is held by live pid {pid}")
+        self.path = Path(path)
+        self.pid = pid
+
+
+class PidLock:
+    """Single-writer pid lockfile: O_CREAT|O_EXCL with the owner's pid
+    inside. A LIVE pid in an existing lockfile means a second writer is
+    running — acquire raises LockHeldError; a dead pid is the residue of
+    a crash and the lock is stolen (exactly the case crash-resume exists
+    for). Shared by the provisioning journal (provision/journal.py) and
+    the supervisor's event ledger (provision/events.py): both are
+    append-only files whose integrity two interleaved writers would
+    destroy."""
+
+    def __init__(
+        self,
+        path: Path,
+        echo=lambda line: None,
+    ) -> None:
+        self.path = Path(path)
+        self._echo = echo
+        self._locked = False
+
+    def holder(self) -> int | None:
+        """Pid in the lockfile when that process is still alive, else None
+        (stale lock or unreadable file — both safe to steal)."""
+        try:
+            pid = int(self.path.read_text().strip())
+        except (OSError, ValueError):
+            return None
+        try:
+            os.kill(pid, 0)
+        except ProcessLookupError:
+            return None
+        except PermissionError:
+            return pid  # alive, just not ours to signal
+        return pid
+
+    def acquire(self) -> "PidLock":
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        while True:
+            try:
+                fd = os.open(self.path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            except FileExistsError:
+                pid = self.holder()
+                if pid is not None:
+                    raise LockHeldError(self.path, pid)
+                self._echo(
+                    f"stale lock {self.path} (holder dead); taking over"
+                )
+                self.path.unlink(missing_ok=True)
+                continue
+            os.write(fd, f"{os.getpid()}\n".encode())
+            os.close(fd)
+            self._locked = True
+            return self
+
+    def release(self) -> None:
+        if self._locked:
+            self.path.unlink(missing_ok=True)
+            self._locked = False
+
+    def __enter__(self) -> "PidLock":
+        return self.acquire()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
 @dataclasses.dataclass(frozen=True)
 class RunPaths:
     """All paths the pipeline reads/writes, rooted at the repo checkout."""
@@ -104,6 +179,26 @@ class RunPaths:
     def quarantine_file(self) -> Path:
         # hosts/slices pulled from service by heal (provision/heal.py)
         return self.terraform_dir / "quarantine.json"
+
+    @property
+    def events(self) -> Path:
+        # the supervisor's durable event ledger (provision/events.py):
+        # every observation / verdict / heal attempt / breaker transition,
+        # replayed on restart so a killed supervisor resumes its rate
+        # limiter and breaker state instead of forgetting them
+        return self.root / "supervisor-events.jsonl"
+
+    @property
+    def fleet_status(self) -> Path:
+        # atomically rewritten machine-readable status document for
+        # external scrapers (./setup.sh status reads it too)
+        return self.root / "fleet-status.json"
+
+    @property
+    def supervisor_pid(self) -> Path:
+        # the running supervisor's pid lockfile — one resident reconcile
+        # loop per workdir, and what teardown signals to stop it
+        return self.root / "supervisor.pid"
 
 
 @dataclasses.dataclass

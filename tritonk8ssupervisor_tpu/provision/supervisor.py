@@ -1,0 +1,659 @@
+"""Continuous supervisor: the resident reconcile loop.
+
+Everything built so far — retry engine, DAG scheduler, journal/resume,
+slice heal, warm cache — runs when a human types `./setup.sh provision`
+or `./setup.sh heal`; a slice lost at 3am stayed lost until morning.
+Podracer-style TPU orchestration (PAPERS.md, 2104.06272) assumes a
+resident control loop that detects drift and repairs it autonomously.
+This module is that loop, surfaced as `./setup.sh supervise`:
+
+each tick it takes one shared `FleetSnapshot`, runs `heal.diagnose`
+(TPU listing + per-slice SSH + drain files), and drives the fleet back
+to spec through the existing slice-scoped heal path — governed by:
+
+- a **flap filter**: a slice must be unhealthy for N consecutive
+  snapshots (default 2) before it is heal-eligible, so one stale
+  snapshot TTL window or transient SSH blip can never trigger a
+  `terraform apply -replace`;
+- **drain awareness**: a DRAINING slice (the maintenance watchdog's
+  file is present — provision/maintenance.py) is *expected* downtime,
+  never heal-eligible; it becomes eligible only when maintenance ends
+  in a missing/unready slice;
+- a per-slice **token-bucket rate limiter**: at most `heal_burst` heals
+  per slice, refilling one token per `heal_refill_s` — a flapping slice
+  cannot be terraform-replaced in a tight loop;
+- a global **circuit breaker**: after `breaker_threshold` failed heals
+  inside `breaker_window_s` it trips OPEN and the loop holds in
+  degraded-hold (observing and reporting, not healing — the fleet runs
+  on the healthy slices per `--max-degraded` semantics) for a cooldown
+  that grows between consecutive trips with the retry engine's
+  decorrelated-jitter formula (retry.Cooldown), then HALF-OPENs for one
+  probe heal;
+- a durable **event ledger** (provision/events.py): every observation,
+  verdict change, heal attempt, rate-limit refusal, and breaker
+  transition is fsync'd, and a restarted supervisor REPLAYS it — heal
+  tokens already spent stay spent, the breaker stays tripped, and a
+  kill mid-heal can never buy the fleet extra heals (no double-heal).
+
+Every tick atomically rewrites `fleet-status.json` for external
+scrapers; `./setup.sh status [--json]` renders the same document.
+Deterministic under testing/simclock.py + testing/faults.py; measured
+by `bench_provision.py --supervise` (unattended MTTR vs. the PR-4
+manual-heal baseline, BENCH_supervise.json).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import os
+import random
+import signal
+import time
+from typing import Callable
+
+from tritonk8ssupervisor_tpu.config.schema import ClusterConfig, ConfigError
+from tritonk8ssupervisor_tpu.provision import events as events_mod
+from tritonk8ssupervisor_tpu.provision import heal as heal_mod
+from tritonk8ssupervisor_tpu.provision import readiness
+from tritonk8ssupervisor_tpu.provision import retry
+from tritonk8ssupervisor_tpu.provision import runner as run_mod
+from tritonk8ssupervisor_tpu.provision.state import (
+    LockHeldError,
+    PidLock,
+    RunPaths,
+)
+
+
+class SupervisorError(RuntimeError):
+    """The supervisor cannot run (already running, bad mode, ...)."""
+
+
+# ------------------------------------------------------------ rate limiter
+
+
+class TokenBucket:
+    """Per-slice heal budget: `capacity` tokens, one minted every
+    `refill_seconds`. Clock-free — callers pass `now` — so the same
+    arithmetic runs on wall time and on the virtual clock, and the
+    ledger restore can replay consumption at recorded timestamps."""
+
+    def __init__(self, capacity: int, refill_seconds: float) -> None:
+        self.capacity = max(1, int(capacity))
+        self.refill_seconds = max(0.0, float(refill_seconds))
+        self.tokens = float(self.capacity)
+        self.updated: float | None = None
+
+    def _refill(self, now: float) -> None:
+        if self.updated is None:
+            self.updated = now
+            return
+        if self.refill_seconds <= 0:
+            self.tokens = float(self.capacity)
+        elif now > self.updated:
+            self.tokens = min(
+                float(self.capacity),
+                self.tokens + (now - self.updated) / self.refill_seconds,
+            )
+        self.updated = max(self.updated, now)
+
+    def try_take(self, now: float) -> bool:
+        self._refill(now)
+        if self.tokens >= 1.0:
+            self.tokens -= 1.0
+            return True
+        return False
+
+    def retry_at(self, now: float) -> float:
+        """When the next token lands (== now when one is available)."""
+        self._refill(now)
+        if self.tokens >= 1.0:
+            return now
+        return now + (1.0 - self.tokens) * self.refill_seconds
+
+    def consume_at(self, ts: float) -> None:
+        """Restore path: account a heal the LEDGER says happened at `ts`
+        — refill up to then, then spend (floor 0, never negative)."""
+        self._refill(ts)
+        self.tokens = max(0.0, self.tokens - 1.0)
+
+
+# ---------------------------------------------------------- circuit breaker
+
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half-open"
+
+
+class CircuitBreaker:
+    """Global heal circuit breaker: `threshold` failed heals inside
+    `window_s` trip it OPEN; after a cooldown (retry.Cooldown — grows
+    between consecutive trips, resets on recovery) it HALF-OPENs for one
+    probe heal whose outcome closes or re-opens it."""
+
+    def __init__(
+        self,
+        threshold: int,
+        window_s: float,
+        cooldown: retry.Cooldown,
+    ) -> None:
+        self.threshold = max(1, int(threshold))
+        self.window_s = float(window_s)
+        self.cooldown = cooldown
+        self.state = CLOSED
+        self.failures: list[float] = []  # failure timestamps in window
+        self.reopen_at: float | None = None
+        self.trips = 0
+
+    def _prune(self, now: float) -> None:
+        cutoff = now - self.window_s
+        self.failures = [ts for ts in self.failures if ts > cutoff]
+
+    def allow(self, now: float) -> bool:
+        """May a heal run now? OPEN past its cooldown transitions to
+        HALF-OPEN (one probe heal allowed); OPEN inside it refuses."""
+        if self.state == OPEN:
+            if self.reopen_at is not None and now >= self.reopen_at:
+                self.state = HALF_OPEN
+                return True
+            return False
+        return True
+
+    def record_failure(self, now: float) -> bool:
+        """Returns True when this failure TRIPS the breaker (closed ->
+        open on the Kth windowed failure, or half-open probe failed)."""
+        self.failures.append(now)
+        self._prune(now)
+        if self.state == HALF_OPEN or (
+            self.state == CLOSED and len(self.failures) >= self.threshold
+        ):
+            self.state = OPEN
+            self.trips += 1
+            self.reopen_at = now + self.cooldown.next()
+            return True
+        return False
+
+    def record_success(self, now: float) -> bool:
+        """Returns True when this success CLOSES a tripped breaker."""
+        closed_it = self.state != CLOSED
+        self.state = CLOSED
+        self.failures = []
+        self.reopen_at = None
+        self.cooldown.reset()
+        return closed_it
+
+
+# -------------------------------------------------------------- flap filter
+
+
+class FlapFilter:
+    """A slice is heal-eligible only after `threshold` CONSECUTIVE
+    unhealthy snapshots (default 2): one stale FleetSnapshot TTL window
+    or a transient SSH blip must never cost a `terraform apply
+    -replace`. DRAINING is expected downtime (maintenance), so it
+    neither builds a streak nor resets one: only missing/unready
+    observations grow it, only a healthy observation clears it."""
+
+    def __init__(self, threshold: int = 2) -> None:
+        self.threshold = max(1, int(threshold))
+        self.streaks: dict[int, int] = {}
+
+    def observe(self, health: "heal_mod.FleetHealth") -> list[int]:
+        """Update streaks from one diagnosis; return the heal-eligible
+        slice indices (unhealthy, not draining, streak >= threshold)."""
+        eligible: list[int] = []
+        for s in health.slices:
+            if s.state == heal_mod.HEALTHY:
+                self.streaks[s.index] = 0
+            elif s.state == heal_mod.DRAINING:
+                pass  # expected downtime: hold the streak, don't grow it
+            else:
+                streak = self.streaks.get(s.index, 0) + 1
+                self.streaks[s.index] = streak
+                if streak >= self.threshold:
+                    eligible.append(s.index)
+        return eligible
+
+
+# ------------------------------------------------------------------ policy
+
+
+@dataclasses.dataclass
+class SupervisePolicy:
+    """Knobs for the reconcile loop. Every field has a TK8S_SUPERVISE_*
+    env override so a live drill can tune a running deployment's next
+    start without a code change (same convention as TK8S_RETRY_*)."""
+
+    interval: float = 30.0  # seconds between reconcile ticks
+    flap_threshold: int = 2  # consecutive bad snapshots before heal
+    heal_burst: int = 2  # token-bucket capacity per slice
+    heal_refill_s: float = 600.0  # seconds to mint one heal token
+    breaker_threshold: int = 3  # failed heals in window -> OPEN
+    breaker_window_s: float = 1800.0
+    breaker_cooldown_s: float = 300.0  # base cooldown (grows per trip)
+    breaker_cooldown_cap_s: float = 3600.0
+    max_degraded: int = 0  # N-of-M budget the hold verdict respects
+
+    _ENV = {
+        "interval": ("TK8S_SUPERVISE_INTERVAL", float),
+        "flap_threshold": ("TK8S_SUPERVISE_FLAP_THRESHOLD", int),
+        "heal_burst": ("TK8S_SUPERVISE_HEAL_BURST", int),
+        "heal_refill_s": ("TK8S_SUPERVISE_HEAL_REFILL", float),
+        "breaker_threshold": ("TK8S_SUPERVISE_BREAKER_THRESHOLD", int),
+        "breaker_window_s": ("TK8S_SUPERVISE_BREAKER_WINDOW", float),
+        "breaker_cooldown_s": ("TK8S_SUPERVISE_BREAKER_COOLDOWN", float),
+        "breaker_cooldown_cap_s": ("TK8S_SUPERVISE_BREAKER_COOLDOWN_CAP",
+                                   float),
+    }
+
+    @classmethod
+    def from_env(cls, environ: dict | None = None) -> "SupervisePolicy":
+        env = os.environ if environ is None else environ
+        kwargs = {}
+        for field, (name, cast) in cls._ENV.items():
+            raw = env.get(name, "")
+            if raw != "":
+                kwargs[field] = cast(raw)
+        return cls(**kwargs)
+
+
+# -------------------------------------------------------------- supervisor
+
+
+class Supervisor:
+    """The reconcile loop. One instance per run; `run()` holds the
+    workdir's supervisor pid lock and loops `tick()` until the tick
+    budget or a stop request. Injectable clock/sleep/rng make the loop a
+    pure function of the scripted world under testing/simclock.py."""
+
+    def __init__(
+        self,
+        config: ClusterConfig,
+        paths: RunPaths,
+        prompter,
+        run: run_mod.RunFn = run_mod.run_streaming,
+        run_quiet: run_mod.RunFn = run_mod.run_capture,
+        policy: SupervisePolicy | None = None,
+        ssh_user: str = "",
+        ssh_key: str = "",
+        ledger: events_mod.EventLedger | None = None,
+        clock: Callable[[], float] = time.time,
+        sleep: Callable[[float], None] = time.sleep,
+        rng: Callable[[], float] = random.random,
+        timer=None,
+        readiness_timeout: float = 900.0,
+        heal_fn=heal_mod.heal,
+    ) -> None:
+        if config.mode != "tpu-vm":
+            raise ConfigError(
+                "supervise drives the tpu-vm heal path; GKE node pools "
+                "self-repair (auto_repair) — see docs/failure-modes.md"
+            )
+        self.config = config
+        self.paths = paths
+        self.prompter = prompter
+        self._run = run
+        self._run_quiet = run_quiet
+        self.policy = policy or SupervisePolicy()
+        self._ssh_user = ssh_user
+        self._ssh_key = ssh_key
+        self.ledger = ledger or events_mod.EventLedger(
+            paths.events, clock=clock
+        )
+        self._clock = clock
+        self._sleep = sleep
+        self._timer = timer
+        self._readiness_timeout = readiness_timeout
+        self._heal_fn = heal_fn
+        self._stop = False
+        # the shared batched listing: ttl under the tick interval so every
+        # tick observes fresh state, while the probes INSIDE one tick
+        # (diagnose + any heal readiness) share a single fetch
+        self.snapshot = readiness.FleetSnapshot(
+            config, run_quiet=run_quiet,
+            ttl=min(10.0, max(0.0, self.policy.interval / 2.0)),
+        )
+        self.flaps = FlapFilter(self.policy.flap_threshold)
+        self.buckets: dict[int, TokenBucket] = {}
+        self.breaker = CircuitBreaker(
+            self.policy.breaker_threshold,
+            self.policy.breaker_window_s,
+            retry.Cooldown(self.policy.breaker_cooldown_s,
+                           self.policy.breaker_cooldown_cap_s, rng=rng),
+        )
+        self.ticks = 0
+        self._heal_seq = 0
+        self._last_states: dict[int, str] = {}
+        self._incidents: dict[int, float] = {}  # slice -> first-bad ts
+        self._view = events_mod.LedgerView()  # folded history (restored)
+
+    # ----------------------------------------------------------- plumbing
+
+    def _bucket(self, index: int) -> TokenBucket:
+        if index not in self.buckets:
+            self.buckets[index] = TokenBucket(
+                self.policy.heal_burst, self.policy.heal_refill_s
+            )
+        return self.buckets[index]
+
+    def request_stop(self) -> None:
+        self._stop = True
+
+    def _record(self, kind: str, **fields) -> dict:
+        """Append to the durable ledger AND fold into the live view —
+        the status publish then costs O(view), not O(ledger): a
+        week-long loop never re-reads its own history per tick."""
+        record = self.ledger.append(kind, **fields)
+        events_mod.apply(self._view, record)
+        return record
+
+    def say(self, text: str) -> None:
+        self.prompter.say(text)
+
+    # ------------------------------------------------------------ restore
+
+    def restore(self) -> events_mod.LedgerView:
+        """Resume from the event ledger: heal tokens spent before the
+        restart stay spent (heal-start timestamps replayed into the
+        buckets — including ORPHANED starts, the kill-mid-heal crash
+        signature, so a crash can never mint extra heals), the breaker's
+        windowed failures and open/cooldown state survive, and counters
+        continue instead of resetting. Slice streaks deliberately do NOT
+        survive: a restarted supervisor must re-confirm unhealth with
+        fresh snapshots before it replaces anything."""
+        view = events_mod.fold(self.ledger.replay())
+        for sv in view.slices.values():
+            bucket = self._bucket(sv.index)
+            for ts in sv.heal_starts:
+                bucket.consume_at(ts)
+        self.breaker.failures = list(view.breaker_failures)
+        if view.breaker_state == OPEN:
+            self.breaker.state = OPEN
+            self.breaker.reopen_at = view.breaker_reopen_at
+            self.breaker.trips = view.breaker_trips
+        elif view.breaker_state == HALF_OPEN:
+            self.breaker.state = HALF_OPEN
+            self.breaker.trips = view.breaker_trips
+        self._view = view
+        if view.open_heals:
+            slices = sorted(
+                {i for r in view.open_heals for i in r.get("slices", [])}
+            )
+            self.say(
+                f"resuming after a crash mid-heal of slice(s) "
+                f"{', '.join(str(i) for i in slices)}: those attempts "
+                "stay charged against the rate limit; re-confirming "
+                "fleet state before any new heal"
+            )
+        return view
+
+    # --------------------------------------------------------------- tick
+
+    def tick(self) -> dict:
+        """One reconcile pass: observe -> judge -> (maybe) heal ->
+        publish status. Returns the observation summary."""
+        now = self._clock()
+        self.ticks += 1
+        self.snapshot.invalidate()  # every tick sees fresh fleet state
+        health = heal_mod.diagnose(
+            self.config, self.paths, run_quiet=self._run_quiet,
+            ssh_user=self._ssh_user, ssh_key=self._ssh_key,
+            snapshot=self.snapshot,
+        )
+        states = {str(s.index): s.state for s in health.slices}
+        self._record(events_mod.TICK, tick=self.ticks, states=states)
+        for s in health.slices:
+            if self._last_states.get(s.index) != s.state:
+                self._record(
+                    events_mod.VERDICT, slice=s.index, state=s.state,
+                    detail=s.detail,
+                    streak=self.flaps.streaks.get(s.index, 0),
+                )
+                if s.state == heal_mod.DRAINING:
+                    # seen BEFORE the node disappears: expected downtime,
+                    # logged, never healed
+                    self._record(events_mod.MAINTENANCE,
+                                       slice=s.index, detail=s.detail)
+                    self.say(f"  slice {s.index} draining for maintenance "
+                             f"({s.detail}); holding, not healing")
+                self._last_states[s.index] = s.state
+            # incident bookkeeping for MTTR: opened at the FIRST bad
+            # observation, closed by a heal-done or a healthy observation
+            if s.state == heal_mod.HEALTHY:
+                self._incidents.pop(s.index, None)
+            else:
+                self._incidents.setdefault(s.index, now)
+
+        eligible = self.flaps.observe(health)
+        summary = {
+            "tick": self.ticks, "ts": now, "states": states,
+            "eligible": list(eligible), "healed": [], "held": False,
+        }
+        if eligible:
+            summary.update(self._reconcile(eligible, health, now))
+        elif health.degraded:
+            pending = [
+                s.index for s in health.slices
+                if s.state not in (heal_mod.HEALTHY, heal_mod.DRAINING)
+            ]
+            if pending:
+                self.say(
+                    f"  slice(s) {', '.join(str(i) for i in pending)} "
+                    "unhealthy; awaiting confirmation "
+                    f"(flap threshold {self.policy.flap_threshold})"
+                )
+        self._publish(now)
+        return summary
+
+    def _reconcile(self, eligible: list[int], health, now: float) -> dict:
+        out: dict = {"healed": [], "held": False, "rate_limited": []}
+        if not self.breaker.allow(now):
+            self._record(
+                events_mod.DEGRADED_HOLD, slices=sorted(eligible),
+                reopen_at=self.breaker.reopen_at,
+                max_degraded=self.policy.max_degraded,
+            )
+            over = len(eligible) > self.policy.max_degraded
+            self.say(
+                f"  breaker OPEN: holding degraded on slice(s) "
+                f"{', '.join(str(i) for i in eligible)} "
+                f"(retry at t={self.breaker.reopen_at:.0f}"
+                f"{'; OVER --max-degraded budget' if over else ''})"
+            )
+            out["held"] = True
+            return out
+        if self.breaker.state == HALF_OPEN:
+            self._record(events_mod.BREAKER_HALF_OPEN,
+                               slices=sorted(eligible))
+            self.say("  breaker half-open: one probe heal")
+        to_heal: list[int] = []
+        for index in sorted(eligible):
+            if self._bucket(index).try_take(now):
+                to_heal.append(index)
+            else:
+                retry_at = self._bucket(index).retry_at(now)
+                self._record(events_mod.RATE_LIMITED, slice=index,
+                                   retry_at=retry_at)
+                self.say(
+                    f"  slice {index}: heal rate-limited "
+                    f"(burst {self.policy.heal_burst} per "
+                    f"{self.policy.heal_refill_s:.0f}s; next token at "
+                    f"t={retry_at:.0f})"
+                )
+                out["rate_limited"].append(index)
+        if to_heal:
+            if self._heal(to_heal, health, now):
+                out["healed"] = to_heal
+        return out
+
+    def _heal(self, slices: list[int], health, now: float) -> bool:
+        """One heal order through the existing slice-scoped path. The
+        heal-start record is fsync'd BEFORE any repair runs: a kill
+        anywhere inside leaves the attempt on the ledger (spent token on
+        resume — no double-heal)."""
+        self._heal_seq += 1
+        heal_id = f"heal-{int(now)}-{self._heal_seq}"
+        self._record(events_mod.HEAL_START, id=heal_id,
+                           slices=sorted(slices), attempt=self._heal_seq)
+        started = self._clock()
+        phase = (self._timer.phase("supervise-heal")
+                 if self._timer is not None else contextlib.nullcontext())
+        try:
+            with phase:
+                self._heal_fn(
+                    self.config, self.paths, self.prompter,
+                    run=self._run, run_quiet=self._run_quiet,
+                    ssh_key=self._ssh_key, ssh_user=self._ssh_user,
+                    max_degraded=0,
+                    readiness_timeout=self._readiness_timeout,
+                    sleep=self._sleep, clock=self._clock,
+                    health=health, only_slices=slices,
+                )
+        except Exception as e:  # noqa: BLE001 - a BaseException (SIGKILL
+            # stand-in, KeyboardInterrupt) must sail through UNrecorded:
+            # the orphaned heal-start IS the crash signature resume reads.
+            done = self._clock()
+            self._record(
+                events_mod.HEAL_FAILED, id=heal_id, slices=sorted(slices),
+                seconds=round(done - started, 3), error=str(e)[:500],
+            )
+            self.say(f"  heal of slice(s) "
+                     f"{', '.join(str(i) for i in slices)} FAILED: {e}")
+            if self.breaker.record_failure(done):
+                self._record(
+                    events_mod.BREAKER_OPEN,
+                    failures=len(self.breaker.failures),
+                    window_s=self.policy.breaker_window_s,
+                    reopen_at=self.breaker.reopen_at,
+                    trip=self.breaker.trips,
+                )
+                self.say(
+                    f"  circuit breaker OPEN (trip {self.breaker.trips}: "
+                    f"{len(self.breaker.failures)} failed heal(s) in "
+                    f"{self.policy.breaker_window_s:.0f}s); degraded-hold "
+                    f"until t={self.breaker.reopen_at:.0f}"
+                )
+            return False
+        done = self._clock()
+        mttr = [round(done - self._incidents.get(i, now), 3)
+                for i in sorted(slices)]
+        for i in slices:
+            self._incidents.pop(i, None)
+            self.flaps.streaks[i] = 0  # healed: demand fresh evidence
+        self._record(
+            events_mod.HEAL_DONE, id=heal_id, slices=sorted(slices),
+            seconds=round(done - started, 3), mttr_s=mttr,
+        )
+        if self.breaker.record_success(done):
+            self._record(events_mod.BREAKER_CLOSE)
+            self.say("  circuit breaker closed (heal succeeded)")
+        return True
+
+    # ------------------------------------------------------------- status
+
+    def _publish(self, now: float) -> None:
+        events_mod.write_fleet_status(
+            self.paths.fleet_status, self.status_doc(now)
+        )
+
+    def status_doc(self, now: float) -> dict:
+        """The live view = restored history + every record this run
+        appended (folded incrementally by `_record`) — identical to
+        re-folding the ledger, which is what the status command does
+        out-of-process, without re-reading the file every tick."""
+        return events_mod.fleet_status(self._view, now, pid=os.getpid())
+
+    # ---------------------------------------------------------------- run
+
+    def run(self, ticks: int = 0) -> int:
+        """Hold the pid lock and reconcile every `interval` seconds.
+        `ticks=0` runs until `request_stop()` (SIGTERM/SIGINT in the
+        CLI); a positive budget runs exactly that many ticks — what the
+        drills and the tier-1 smoke use."""
+        lock = PidLock(self.paths.supervisor_pid, echo=self.say)
+        try:
+            lock.acquire()
+        except LockHeldError as e:
+            raise SupervisorError(
+                f"a supervisor is already running (pid {e.pid}, "
+                f"{self.paths.supervisor_pid}); one reconcile loop per "
+                "deployment — stop it first (teardown does this "
+                "automatically)"
+            ) from e
+        try:
+            self.restore()
+            self._record(
+                events_mod.SUPERVISOR_START, pid=os.getpid(),
+                interval=self.policy.interval,
+                flap_threshold=self.policy.flap_threshold,
+                heal_burst=self.policy.heal_burst,
+                heal_refill_s=self.policy.heal_refill_s,
+                breaker_threshold=self.policy.breaker_threshold,
+                max_degraded=self.policy.max_degraded,
+            )
+            self.say(
+                f"supervising {self.config.num_slices} slice(s) every "
+                f"{self.policy.interval:.0f}s (flap threshold "
+                f"{self.policy.flap_threshold}, heal burst "
+                f"{self.policy.heal_burst}/{self.policy.heal_refill_s:.0f}s"
+                f", breaker {self.policy.breaker_threshold} fails/"
+                f"{self.policy.breaker_window_s:.0f}s); status in "
+                f"{self.paths.fleet_status}"
+            )
+            done = 0
+            while not self._stop:
+                self.tick()
+                done += 1
+                if ticks and done >= ticks:
+                    break
+                self._sleep(self.policy.interval)
+            self._record(events_mod.SUPERVISOR_STOP,
+                               pid=os.getpid(), ticks=done)
+            self._publish(self._clock())
+            return 0
+        finally:
+            lock.release()
+
+
+# ----------------------------------------------------- teardown's stop hook
+
+
+def stop_running(
+    paths: RunPaths,
+    echo: Callable[[str], None] = lambda line: None,
+    kill: Callable[[int, int], None] = os.kill,
+    sleep: Callable[[float], None] = time.sleep,
+    grace_s: float = 5.0,
+) -> bool:
+    """Stop a running supervisor via its pid lockfile — teardown's FIRST
+    act: a live reconcile loop would watch teardown delete slices and
+    dutifully heal them back. SIGTERM first (the loop exits cleanly and
+    records supervisor-stop), SIGKILL after the grace period; a stale
+    lockfile (dead pid) is just removed. Returns True when a live
+    supervisor was signalled."""
+    lock = PidLock(paths.supervisor_pid)
+    pid = lock.holder()
+    if pid is None:
+        paths.supervisor_pid.unlink(missing_ok=True)
+        return False
+    echo(f"stopping running supervisor (pid {pid})")
+    try:
+        kill(pid, signal.SIGTERM)
+    except (ProcessLookupError, PermissionError):
+        paths.supervisor_pid.unlink(missing_ok=True)
+        return False
+    waited = 0.0
+    while waited < grace_s:
+        sleep(0.2)
+        waited += 0.2
+        if lock.holder() is None:
+            paths.supervisor_pid.unlink(missing_ok=True)
+            return True
+    echo(f"supervisor pid {pid} ignored SIGTERM for {grace_s:.0f}s; "
+         "sending SIGKILL")
+    try:
+        kill(pid, signal.SIGKILL)
+    except (ProcessLookupError, PermissionError):
+        pass
+    paths.supervisor_pid.unlink(missing_ok=True)
+    return True

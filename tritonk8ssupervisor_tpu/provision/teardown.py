@@ -13,8 +13,10 @@ import shutil
 from tritonk8ssupervisor_tpu.cli.io import Prompter
 from tritonk8ssupervisor_tpu.config.schema import ClusterConfig
 from tritonk8ssupervisor_tpu.provision import ansible as ansible_mod
+from tritonk8ssupervisor_tpu.provision import events as events_mod
 from tritonk8ssupervisor_tpu.provision import journal as journal_mod
 from tritonk8ssupervisor_tpu.provision import runner as run_mod
+from tritonk8ssupervisor_tpu.provision import supervisor as supervisor_mod
 from tritonk8ssupervisor_tpu.provision import terraform as terraform_mod
 from tritonk8ssupervisor_tpu.provision.state import (
     ClusterHosts,
@@ -58,6 +60,12 @@ def clean(
         prompter.say("Aborted; nothing was changed.")
         return False
 
+    # Stop any resident supervisor FIRST: a live reconcile loop would
+    # watch the destroy delete slices and dutifully heal them back
+    # (provision/supervisor.py stop_running: SIGTERM, grace, SIGKILL;
+    # a stale pid lockfile from a crashed supervisor is just removed).
+    supervisor_mod.stop_running(paths, echo=prompter.say)
+
     # Destroy EVERY mode holding terraform state, not just config.mode: a
     # mode switch via --config leaves the previous mode's tfstate behind,
     # and the state scrub below would otherwise orphan those resources.
@@ -80,11 +88,16 @@ def clean(
             )
     _scrub_known_hosts(paths, run)
     _remove_generated_state(config, paths)
-    # The journal goes LAST: every earlier step is individually idempotent
+    # The ledgers go LAST: every earlier step is individually idempotent
     # (unlink missing_ok, destroy keyed off tfstate existence), so a clean
-    # that crashes anywhere above leaves the ledger behind and the re-run
-    # simply does the remaining work — a crashed clean is itself resumable.
+    # that crashes anywhere above leaves them behind and the re-run simply
+    # does the remaining work — a crashed clean is itself resumable. The
+    # supervisor's EVENT ledger goes after even the journal: it is the
+    # flight record of what the fleet was and what ran, the last evidence
+    # an interrupted clean would want preserved.
     journal_mod.Journal(paths.journal).scrub()
+    paths.fleet_status.unlink(missing_ok=True)
+    events_mod.EventLedger(paths.events).scrub()
     prompter.say("Clean. Re-run ./setup.sh to provision again.")
     return True
 

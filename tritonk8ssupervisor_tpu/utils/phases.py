@@ -222,9 +222,13 @@ TOTAL_BUDGET_SECONDS = 900.0  # the BASELINE.md north star
 
 
 def phase_budget(name: str) -> float | None:
-    """Budget for a phase name: exact match first (provision, then heal),
-    then the per-slice prefixes; unknown phases have no budget."""
-    budget = PHASE_BUDGETS.get(name, HEAL_PHASE_BUDGETS.get(name))
+    """Budget for a phase name: exact match first (provision, heal, then
+    supervise), then the per-slice prefixes; unknown phases have no
+    budget."""
+    budget = PHASE_BUDGETS.get(
+        name,
+        HEAL_PHASE_BUDGETS.get(name, SUPERVISE_PHASE_BUDGETS.get(name)),
+    )
     if budget is not None:
         return budget
     for prefix, ceiling in PHASE_PREFIX_BUDGETS.items():
@@ -245,6 +249,17 @@ HEAL_PHASE_BUDGETS: dict[str, float] = {
     "heal-apply": 300.0,
     "heal-configure": 180.0,
     "heal-readiness": 120.0,
+}
+
+# The supervisor's reconcile loop (provision/supervisor.py) runs heals
+# unattended, so its end-to-end heal — diagnosis already paid by the
+# tick, then the scoped heal-apply/configure/readiness chain — carries
+# one summed ceiling: an unattended heal that exceeds it is wedged, not
+# slow, and the breaker/rate-limiter telemetry (fleet-status.json) is
+# where the operator looks first. The ceiling is the HEAL_PHASE_BUDGETS
+# sum minus the diagnose the supervisor amortises into its tick.
+SUPERVISE_PHASE_BUDGETS: dict[str, float] = {
+    "supervise-heal": 600.0,
 }
 
 
