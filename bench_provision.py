@@ -843,26 +843,229 @@ def run_supervise_benchmark(num_slices: int = 4) -> dict:
     }
 
 
+# --------------------------------------------------------- elastic drill
+
+
+class _SimTrainCkpt:
+    """Duck-typed checkpoint store for the elastic drill (the
+    ElasticCheckpoint surface over plain dict states)."""
+
+    def __init__(self):
+        self.store: dict = {}
+        self.saves: list = []
+
+    def latest_step(self):
+        return max(self.store) if self.store else None
+
+    def save(self, step, state, wait=False):
+        self.store[step] = dict(state)
+        self.saves.append(step)
+
+    def restore(self, state, shardings, step=None):
+        chosen = max(self.store) if step is None else step
+        return dict(self.store[chosen])
+
+
+def run_elastic_drill(
+    num_slices: int = 4,
+    interval: float = 30.0,
+    preempt_at: float = 300.0,
+    step_s: float = 1.5,
+    checkpoint_every: int = 30,
+    total_steps: int = 400,
+    workdir: Path | None = None,
+) -> dict:
+    """One fault-to-training-resumed story with BOTH halves real: the
+    resident supervisor (provision/supervisor.py) reconciles a scripted
+    fleet on the virtual clock while a real ElasticTrainer
+    (parallel/elastic.py) trains a simulated workload against the
+    supervisor's actual fleet-status.json. The preemption at
+    `preempt_at` kills the trainer's collective mid-step; the
+    supervisor detects (one tick), confirms (flap threshold), and heals
+    (SIM_SECONDS['heal-slice']); the trainer acknowledges through
+    job-ack.json, waits out the heal, and resumes from its last durable
+    checkpoint. Measured: steps lost (bounded by one checkpoint
+    interval) and time-to-training-resumed, with the job-notified ->
+    job-resumed MTTR attribution read back off the REAL event ledger."""
+    import threading
+
+    from tritonk8ssupervisor_tpu.parallel import elastic as elastic_mod
+    from tritonk8ssupervisor_tpu.provision import events as events_mod
+    from tritonk8ssupervisor_tpu.provision import supervisor as sup_mod
+
+    own_tmp = workdir is None
+    root = Path(workdir) if workdir is not None else Path(
+        tempfile.mkdtemp(prefix="tk8s-elastic-drill-")
+    )
+    try:
+        clock = SimClock()
+        world = SuperviseSim(root, clock, num_slices=num_slices)
+        lost = num_slices - 1
+        world.preempt(lost, at=preempt_at)
+        policy = sup_mod.SupervisePolicy(interval=interval,
+                                         flap_threshold=2)
+        ledger = events_mod.EventLedger(
+            world.paths.events, clock=clock.time, echo=lambda line: None
+        )
+        supervisor = sup_mod.Supervisor(
+            world.config, world.paths, _Say(),
+            run=world.run, run_quiet=world.run_quiet, policy=policy,
+            ledger=ledger, clock=clock.time, sleep=clock.sleep,
+            rng=lambda: 0.0, readiness_timeout=60.0,
+        )
+        sup_ticks = int(total_steps * step_s / interval) + 4
+
+        clock.launch()
+
+        def sup_body():
+            clock.begin()
+            try:
+                supervisor.run(ticks=sup_ticks)
+            finally:
+                clock.release()
+
+        thread = threading.Thread(target=sup_body, daemon=True)
+        thread.start()
+
+        # ---- the trainer: a modeled workload through the REAL loop
+        def step_fn(state, *batch):
+            clock.sleep(step_s)
+            world._sync()
+            if world.down:
+                raise RuntimeError(
+                    "collective peer lost (slice preempted)"
+                )
+            return {"n": state["n"] + 1}, {}
+
+        ckpt = _SimTrainCkpt()
+        trainer = elastic_mod.ElasticTrainer(
+            lambda: elastic_mod.TrainSession({"n": 0}, None, step_fn),
+            lambda session, i: (),
+            checkpoint=ckpt,
+            health=elastic_mod.FileHealthSource(world.paths.fleet_status),
+            # poll cadence 17s: deliberately off the 30s tick lattice so
+            # a trainer poll never lands on the same virtual instant as
+            # a status publish (a same-instant read would race on thread
+            # order and jitter the measured resume time)
+            policy=elastic_mod.ElasticPolicy(
+                checkpoint_every=checkpoint_every, poll_every=1,
+                wait_base_s=17.0, wait_cap_s=17.0, max_wait_s=900.0,
+                max_degraded=0,
+            ),
+            ack=elastic_mod.JobAck(world.paths.job_ack, clock=clock.time),
+            init_fn=lambda: None, shutdown_fn=lambda: None,
+            drain_fn=None,
+            clock=clock.time, sleep=clock.sleep, rng=lambda: 0.0,
+            echo=lambda line: None,
+        )
+        clock.launch()
+        clock.begin()
+        try:
+            report = trainer.run(total_steps)
+        finally:
+            clock.release()
+        thread.join(timeout=60)
+
+        records = ledger.replay()
+        notified = [r for r in records
+                    if r["kind"] == events_mod.JOB_NOTIFIED]
+        resumed = [r for r in records
+                   if r["kind"] == events_mod.JOB_RESUMED]
+        # the LAST resume is when training sustainably restarted
+        resume = report["resumes"][-1] if report["resumes"] else {}
+        time_to_resumed = (resume.get("ts", 0.0) - preempt_at
+                           if resume else None)
+        # budget: detect (one interval) + confirm (flap threshold's
+        # second interval) + the scoped heal + the trainer's poll slack
+        budget = (policy.flap_threshold * interval
+                  + SIM_SECONDS["heal-slice"] + 45.0)
+        return {
+            "num_slices": num_slices,
+            "interval_s": interval,
+            "preempt_at_s": preempt_at,
+            "lost_slice": lost,
+            "step_s": step_s,
+            "checkpoint_every_steps": checkpoint_every,
+            "checkpoint_interval_s": checkpoint_every * step_s,
+            "total_steps": total_steps,
+            "final_step": report["final_step"],
+            "steps_lost": report["steps_lost"],
+            "resumes": len(report["resumes"]),
+            "resume_degraded": bool(resume.get("degraded")),
+            "waited_s": resume.get("waited_s"),
+            "time_to_training_resumed_s": time_to_resumed,
+            "budget_s": budget,
+            "heal_applies": list(world.applies),
+            "ledger": {
+                "job_notified": len(notified),
+                "job_resumed": len(resumed),
+                "job_mttr_s": (resumed[0].get("mttr_s")
+                               if resumed else None),
+            },
+        }
+    finally:
+        if own_tmp:
+            shutil.rmtree(root, ignore_errors=True)
+
+
+def run_elastic_benchmark(num_slices: int = 4) -> dict:
+    """The elastic-training acceptance datapoint, one BENCH-style JSON
+    document: a t=300s preemption costs at most one checkpoint interval
+    of steps and training is resumed — at the healed world — within the
+    detect+confirm+heal budget, with the job-notified -> job-resumed
+    attribution on the event ledger."""
+    drill = run_elastic_drill(num_slices)
+    return {
+        "benchmark": "provision_elastic",
+        "metric": "time_to_training_resumed_s",
+        "unit": "seconds from slice preemption to the training job "
+                "stepping again (simulated; supervisor + ElasticTrainer "
+                "as virtual-clock co-actors)",
+        "num_slices": num_slices,
+        "model_seconds": dict(SIM_SECONDS),
+        "value": drill["time_to_training_resumed_s"],
+        "steps_lost": drill["steps_lost"],
+        "checkpoint_every_steps": drill["checkpoint_every_steps"],
+        "budget_s": drill["budget_s"],
+        "ledger": drill["ledger"],
+        "drill": drill,
+        "passes": bool(
+            drill["resumes"] >= 1
+            and drill["final_step"] == drill["total_steps"]
+            and drill["steps_lost"] <= drill["checkpoint_every_steps"]
+            and drill["time_to_training_resumed_s"] is not None
+            and drill["time_to_training_resumed_s"] <= drill["budget_s"]
+            and drill["heal_applies"] == [[drill["lost_slice"]]]
+            and drill["ledger"]["job_notified"] >= 1
+            and drill["ledger"]["job_resumed"] >= 1
+        ),
+    }
+
+
 # ------------------------------------------------------ the regression gate
 
 
 DEFAULT_BASELINE = Path(__file__).resolve().parent / "BENCH_provision.json"
 SUPERVISE_BASELINE = Path(__file__).resolve().parent / "BENCH_supervise.json"
+ELASTIC_BASELINE = Path(__file__).resolve().parent / "BENCH_elastic.json"
 
 
 def run_check(
     baseline: Path = DEFAULT_BASELINE,
     tolerance: float = 0.10,
     supervise_baseline: Path = SUPERVISE_BASELINE,
+    elastic_baseline: Path = ELASTIC_BASELINE,
 ) -> tuple[bool, list[str], dict]:
-    """Re-simulate against the committed BENCH_provision.json and
-    BENCH_supervise.json: fail when the cold (pipelined DAG) or warm
-    makespan — or the supervisor's unattended MTTR — regressed more
-    than `tolerance`, or when unattended MTTR no longer beats the
-    manual-heal budget (manual MTTR + one reconcile interval). The gate
-    that keeps a DAG-edge, cache, or reconcile-loop regression from
-    landing silently. Improvements always pass; the committed files are
-    only rewritten by explicit `--out` runs."""
+    """Re-simulate against the committed BENCH_provision.json,
+    BENCH_supervise.json, and BENCH_elastic.json: fail when the cold
+    (pipelined DAG) or warm makespan — or the supervisor's unattended
+    MTTR, or the elastic drill's time-to-training-resumed / steps lost
+    — regressed more than `tolerance`, or when a drill no longer meets
+    its structural budget (MTTR beats manual + one interval; steps lost
+    within one checkpoint interval). The gate that keeps a DAG-edge,
+    cache, reconcile-loop, or elastic-resume regression from landing
+    silently. Improvements always pass; the committed files are only
+    rewritten by explicit `--out` runs."""
     baseline = Path(baseline)
     if not baseline.exists():
         return False, [f"baseline {baseline} missing"], {}
@@ -908,6 +1111,26 @@ def run_check(
             problems.append(
                 "breaker storm drill no longer ends in degraded-hold"
             )
+
+    elastic_baseline = Path(elastic_baseline)
+    if not elastic_baseline.exists():
+        problems.append(f"baseline {elastic_baseline} missing (elastic)")
+    else:
+        committed_el = json.loads(elastic_baseline.read_text())
+        current_el = run_elastic_benchmark(
+            int(committed_el.get("num_slices", 4))
+        )
+        current["elastic"] = current_el
+        compare("elastic time-to-training-resumed",
+                committed_el.get("value"), current_el["value"])
+        compare("elastic steps lost", committed_el.get("steps_lost"),
+                current_el["steps_lost"])
+        if not current_el["passes"]:
+            problems.append(
+                "elastic drill no longer passes (steps lost within one "
+                "checkpoint interval, resume within budget, "
+                "job-notified/job-resumed on the ledger)"
+            )
     return not problems, problems, current
 
 
@@ -925,6 +1148,13 @@ def main(argv: list[str] | None = None) -> int:
                         "for a slice preemption vs the manual-heal "
                         "baseline, plus the breaker storm ending in "
                         "degraded-hold")
+    parser.add_argument("--elastic", action="store_true",
+                        help="run the elastic-training drill: a real "
+                        "supervisor and a real ElasticTrainer as "
+                        "virtual-clock co-actors; a t=300s preemption "
+                        "costs <= one checkpoint interval of steps and "
+                        "training resumes within the detect+confirm+heal "
+                        "budget (BENCH_elastic.json)")
     parser.add_argument("--check", action="store_true",
                         help="perf-regression gate: fail if the simulated "
                         "cold/warm makespan regressed >10%% vs the "
@@ -952,6 +1182,8 @@ def main(argv: list[str] | None = None) -> int:
         result = run_resilience_benchmark(args.slices)
     elif args.supervise:
         result = run_supervise_benchmark(args.slices)
+    elif args.elastic:
+        result = run_elastic_benchmark(args.slices)
     elif args.warm:
         result = {
             "benchmark": "provision_warm",
@@ -998,6 +1230,21 @@ def main(argv: list[str] | None = None) -> int:
             f"{breaker['rate_limited']} rate-limited, trips "
             f"{breaker['breaker_trips']}, ends "
             f"{breaker['end_verdict']} -> passes={result['passes']}",
+            file=sys.stderr,
+        )
+        return 0 if result["passes"] else 1
+    if args.elastic:
+        drill = result["drill"]
+        print(
+            f"\n{args.slices}-slice elastic training (simulated): slice "
+            f"{drill['lost_slice']} preempted at "
+            f"t={drill['preempt_at_s']:.0f}s mid-step -> trainer lost "
+            f"{result['steps_lost']} step(s) (<= "
+            f"{result['checkpoint_every_steps']} per interval), resumed "
+            f"training {result['value']:.0f}s after the preemption "
+            f"(budget {result['budget_s']:.0f}s), ledger job MTTR "
+            f"{result['ledger']['job_mttr_s']}s -> "
+            f"passes={result['passes']}",
             file=sys.stderr,
         )
         return 0 if result["passes"] else 1

@@ -56,6 +56,100 @@ def test_save_restore_round_trip(tmp_path):
     assert int(resumed.step) == 3
 
 
+def toy_state_and_shardings(step=1, fill=0.0):
+    """A tiny TrainState + replicated shardings: the crash-safety
+    contract doesn't depend on the model, and skipping the ResNet init
+    keeps these in the default tier's time budget."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = make_mesh()
+    state = train_lib.TrainState(
+        step=jnp.asarray(step, jnp.int32),
+        params={"w": jnp.full((4, 4), fill, jnp.float32)},
+        batch_stats={},
+        opt_state=(),
+    )
+    shardings = jax.tree_util.tree_map(
+        lambda _: NamedSharding(mesh, P()), state
+    )
+    return state, shardings
+
+
+def _corrupt_step_dir(step_dir):
+    """Simulate a save a crash tore mid-write: every file in the step
+    dir is truncated to garbage."""
+    for f in step_dir.rglob("*"):
+        if f.is_file() and f.stat().st_size > 0:
+            f.write_bytes(b"x")
+
+
+def test_unmarked_torn_step_skipped_on_restore(tmp_path):
+    """Crash-safety satellite: a save the process died inside (step dir
+    present, commit marker absent — the marker lands only after the
+    write finished) is skipped entirely: latest_step reports the
+    previous complete step and restore returns its values."""
+    from tritonk8ssupervisor_tpu.parallel.checkpoint import COMMIT_DIR
+
+    state1, shardings = toy_state_and_shardings(step=1, fill=1.0)
+    state2, _ = toy_state_and_shardings(step=2, fill=7.0)
+    ckpt = TrainCheckpointer(tmp_path / "ckpt")
+    ckpt.save(1, state1, wait=True)
+    ckpt.save(2, state2, wait=True)
+    assert ckpt.latest_step() == 2
+    ckpt.close()
+    # the kill-mid-save signature: no commit marker, torn files
+    (tmp_path / "ckpt" / COMMIT_DIR / "2").unlink()
+    _corrupt_step_dir(tmp_path / "ckpt" / "2")
+
+    reopened = TrainCheckpointer(tmp_path / "ckpt")
+    assert reopened.latest_step() == 1
+    restored = reopened.restore(abstract_like(state1, shardings))
+    reopened.close()
+    assert int(restored.step) == 1
+    np.testing.assert_array_equal(
+        np.asarray(restored.params["w"]), np.full((4, 4), 1.0)
+    )
+
+
+def test_marked_but_torn_step_falls_back_on_restore(tmp_path):
+    """Belt and braces: even a COMMITTED step that fails to read (bit
+    rot, torn copy) falls back to the previous complete step instead of
+    killing the resume."""
+    state1, shardings = toy_state_and_shardings(step=1, fill=1.0)
+    state2, _ = toy_state_and_shardings(step=2, fill=7.0)
+    ckpt = TrainCheckpointer(tmp_path / "ckpt")
+    ckpt.save(1, state1, wait=True)
+    ckpt.save(2, state2, wait=True)
+    ckpt.close()
+    _corrupt_step_dir(tmp_path / "ckpt" / "2")  # marker intact
+
+    reopened = TrainCheckpointer(tmp_path / "ckpt")
+    restored = reopened.restore(abstract_like(state1, shardings))
+    reopened.close()
+    assert int(restored.step) == 1
+
+
+def test_legacy_checkpoints_without_markers_stay_restorable(tmp_path):
+    """A checkpoint dir written before the commit-marker layer existed
+    has no markers at all: orbax's own record is trusted wholesale
+    rather than discarded."""
+    import shutil
+
+    from tritonk8ssupervisor_tpu.parallel.checkpoint import COMMIT_DIR
+
+    state1, shardings = toy_state_and_shardings(step=1, fill=3.0)
+    ckpt = TrainCheckpointer(tmp_path / "ckpt")
+    ckpt.save(1, state1, wait=True)
+    ckpt.close()
+    shutil.rmtree(tmp_path / "ckpt" / COMMIT_DIR)
+
+    reopened = TrainCheckpointer(tmp_path / "ckpt")
+    assert reopened.latest_step() == 1
+    restored = reopened.restore(abstract_like(state1, shardings))
+    reopened.close()
+    assert int(restored.step) == 1
+
+
 def test_restore_without_checkpoint_raises(tmp_path):
     # a toy TrainState: the missing-checkpoint contract doesn't depend
     # on the model, and skipping the ResNet init keeps this in the

@@ -145,6 +145,58 @@ def test_breaker_close_clears_failure_window():
     assert view.breaker_trips == 1  # history survives the close
 
 
+def test_fold_membership_generation_counts_leave_and_return():
+    """The elastic contract's clock: the generation bumps when a slice
+    leaves the serving set and again when it returns (replaced hosts —
+    the job must re-form even though the verdict is green). Drain
+    notices, repeated observations of the same state, and the first
+    unknown->healthy observations never bump it."""
+    view = ev.fold(seeded_records())
+    # slice 1 left (60) and returned (300); slice 0 left (390): 1+3 = 4
+    assert view.membership_generation == 4
+    # repeated TICKs of the same states: no movement
+    ev.apply(view, {"ts": 800.0, "kind": ev.TICK, "tick": 2,
+                    "states": {"0": "unready", "1": "healthy"}})
+    assert view.membership_generation == 4
+    # healthy -> draining is a notice, not a loss
+    ev.apply(view, {"ts": 810.0, "kind": ev.VERDICT, "slice": 1,
+                    "state": "draining", "detail": "maintenance"})
+    assert view.membership_generation == 4
+    # draining -> missing IS the loss
+    ev.apply(view, {"ts": 820.0, "kind": ev.VERDICT, "slice": 1,
+                    "state": "missing"})
+    assert view.membership_generation == 5
+
+
+def test_fold_job_ack_events_and_suppression():
+    records = seeded_records() + [
+        {"ts": 750.0, "kind": ev.JOB_NOTIFIED, "generation": 4,
+         "step": 120, "reason": "generation 3 -> 4"},
+        {"ts": 760.0, "kind": ev.HEAL_SUPPRESSED, "slice": 0},
+        {"ts": 780.0, "kind": ev.DEGRADED_ACK, "slices": [0],
+         "generation": 4, "step": 120},
+        {"ts": 790.0, "kind": ev.JOB_RESUMED, "generation": 4,
+         "step": 120, "world": 3, "degraded": True, "mttr_s": 40.0},
+    ]
+    view = ev.fold(records)
+    assert view.job_phase == "degraded"
+    assert view.job_generation == 4 and view.job_step == 120
+    assert view.job_notified_ts == 750.0 and view.job_resumed_ts == 790.0
+    assert view.job_mttr_samples == [40.0]
+    assert view.acked_degraded == {0}
+    assert view.heals_suppressed == 1
+    doc = ev.fleet_status(view, now=800.0)
+    assert doc["job"]["phase"] == "degraded"
+    assert doc["job"]["acked_degraded"] == [0]
+    assert doc["job"]["mttr_s"]["last"] == 40.0
+    assert doc["heals"]["suppressed"] == 1
+    assert doc["membership"]["generation"] == view.membership_generation
+    # a healthy observation folds the slice back in
+    ev.apply(view, {"ts": 900.0, "kind": ev.VERDICT, "slice": 0,
+                    "state": "healthy"})
+    assert view.acked_degraded == set()
+
+
 # ----------------------------------------------------------- fleet status
 
 
@@ -157,7 +209,8 @@ def test_fleet_status_document_shape():
     assert doc["slices"]["1"]["heals_succeeded"] == 1
     assert doc["heals"] == {
         "attempted": 2, "succeeded": 1, "failed": 1,
-        "rate_limited": 1, "held_ticks": 1, "in_flight": 0,
+        "rate_limited": 1, "held_ticks": 1, "suppressed": 0,
+        "in_flight": 0,
     }
     assert doc["mttr_s"]["mean"] == 180.0
     assert doc["breaker"]["state"] == "open"

@@ -393,6 +393,7 @@ def test_cli_supervise_heals_lost_slice_unattended(fake_world, capsys):
     assert status["heals"] == {
         "attempted": 1, "succeeded": 1, "failed": 0,
         "rate_limited": 0, "held_ticks": 0, "in_flight": 0,
+        "suppressed": 0,
     }
     assert status["mttr_s"]["count"] == 1
     assert main(["status", "--workdir", str(work)]) == 0

@@ -4,18 +4,22 @@ via initialize_from_env (the exact code path the tpuhost role's
 
 This exercises jax.distributed for real — the SURVEY.md §4 suggestion that
 multi-host logic be tested with jax.distributed.initialize across local
-processes.
+processes. The launcher lives in testing/localcluster.py (shared with
+the elastic-training chaos drill); failed or timed-out drills
+process-group-SIGKILL every worker so no rendezvous'd JAX process is
+ever orphaned holding the coordinator port.
 """
 
-import os
-import socket
-import subprocess
-import sys
 import textwrap
-from pathlib import Path
-import pytest
 
-REPO = Path(__file__).resolve().parent.parent
+from tritonk8ssupervisor_tpu.testing.localcluster import (  # noqa: F401 -
+    # re-exported: other tests (and the elastic chaos drill) import the
+    # shared launcher through this module's historical names
+    REPO,
+    free_port,
+    run_cluster,
+)
+import pytest
 
 WORKER = textwrap.dedent(
     """
@@ -38,80 +42,6 @@ WORKER = textwrap.dedent(
     print(f"OK process {env.process_id}", flush=True)
     """
 )
-
-
-def free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
-
-
-def run_cluster(
-    worker: str,
-    num_processes: int = 2,
-    devices_per_process: int = 1,
-    timeout: int = 600,
-    num_slices: int = 1,
-) -> list[str]:
-    """Launch `worker` in `num_processes` rendezvousing subprocesses and
-    return their outputs; on any failure or timeout, kill every sibling
-    (a crashed rank leaves the others blocked in the collective) and fail
-    with all outputs.
-
-    num_slices > 1 hands each process the CROSS-SLICE env contract the
-    tpuhost role / GKE Job manifests emit (config/compile.py
-    tpu_job_env): JAX_PROCESS_ID stays the within-slice id and the
-    TK8S_* coordinates carry the slice arithmetic — exactly what a pod
-    on slice s, completion index p sees."""
-    port = free_port()
-    procs = []
-    assert num_processes % num_slices == 0
-    per_slice = num_processes // num_slices
-    for pid in range(num_processes):
-        env = dict(os.environ)
-        # neutralise the dev image's axon sitecustomize and pin CPU
-        env.pop("PALLAS_AXON_POOL_IPS", None)
-        env["JAX_PLATFORMS"] = "cpu"
-        env["XLA_FLAGS"] = (
-            f"--xla_force_host_platform_device_count={devices_per_process}"
-        )
-        env["JAX_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
-        env["JAX_NUM_PROCESSES"] = str(num_processes)
-        if num_slices > 1:
-            env["JAX_PROCESS_ID"] = str(pid % per_slice)
-            env["TK8S_NUM_SLICES"] = str(num_slices)
-            env["TK8S_SLICE_ID"] = str(pid // per_slice)
-            env["TK8S_PROCS_PER_SLICE"] = str(per_slice)
-        else:
-            env["JAX_PROCESS_ID"] = str(pid)
-        procs.append(
-            subprocess.Popen(
-                [sys.executable, "-c", worker],
-                env=env,
-                cwd=REPO,
-                stdout=subprocess.PIPE,
-                stderr=subprocess.STDOUT,
-                text=True,
-            )
-        )
-    outputs = [""] * num_processes
-    try:
-        for pid, proc in enumerate(procs):
-            try:
-                outputs[pid], _ = proc.communicate(timeout=timeout)
-            except subprocess.TimeoutExpired:
-                outputs[pid] = f"<timeout after {timeout}s>"
-                raise
-        for pid, proc in enumerate(procs):
-            assert proc.returncode == 0, (
-                f"process {pid} failed:\n" + "\n---\n".join(outputs)
-            )
-    finally:
-        for proc in procs:
-            if proc.poll() is None:
-                proc.kill()
-                proc.wait()
-    return outputs
 
 
 TRAIN_WORKER = textwrap.dedent(

@@ -317,9 +317,106 @@ def test_preemption_drill_drain_observed_then_healed_once(tmp_path):
     assert status["verdict"] == "healthy"
     assert status["heals"] == {
         "attempted": 1, "succeeded": 1, "failed": 0,
-        "rate_limited": 0, "held_ticks": 0, "in_flight": 0,
+        "rate_limited": 0, "held_ticks": 0, "suppressed": 0,
+        "in_flight": 0,
     }
     assert status["mttr_s"]["last"] == pytest.approx(210.0)
+    # the membership generation moved for the loss AND the return, and a
+    # healthy fleet advertises no heal in progress — what an elastic
+    # trainer keys its resume on (parallel/elastic.py)
+    assert status["membership"]["generation"] >= 3
+    assert status["membership"]["heal_in_progress"] is False
+
+
+# ---------------------------------------- drill: job ack + heal suppression
+
+
+def write_ack(world, phase, generation=2, step=100, slices=(), world_size=2):
+    from tritonk8ssupervisor_tpu.provision.state import atomic_write_text
+
+    atomic_write_text(world.paths.job_ack, json.dumps({
+        "v": 1, "ts": world.clock.time(), "phase": phase,
+        "generation": generation, "step": step, "world": world_size,
+        "slices": sorted(slices), "reason": "drill",
+    }) + "\n")
+
+
+def test_degraded_ack_suppresses_heal_until_healthy_again(tmp_path):
+    """Satellite pin: a slice loss the trainer already absorbed as
+    degraded continuation is NOT healed — breaker-open + degraded
+    training must not fight. The ack lands on the ledger (degraded-ack,
+    job-resumed with MTTR attribution after the notice), each skipped
+    heal is a heal-suppressed verdict, and no terraform replace runs."""
+    clock = SimClock()
+    world = FleetSim(tmp_path, clock)
+    world.preempt(2, at=0.0)
+    say = Say()
+    supervisor = build(world, clock, prompter=say)
+    write_ack(world, "notified", step=40, slices=())
+    # one tick: the notice is observed, the flap filter has not yet
+    # confirmed the loss (threshold 2), so no heal has run
+    run_sim(supervisor, clock, ticks=1)
+    write_ack(world, "degraded", step=40, slices=(2,), world_size=1)
+    run_sim(supervisor, clock, ticks=6)
+    assert world.applies == [], "suppressed slice was healed anyway"
+    recorded = kinds(world)
+    assert ev.JOB_NOTIFIED in recorded
+    assert ev.DEGRADED_ACK in recorded
+    assert ev.JOB_RESUMED in recorded
+    assert recorded.count(ev.HEAL_SUPPRESSED) == 1  # once, not per tick
+    resumed = next(r for r in ev.EventLedger(world.paths.events).replay()
+                   if r["kind"] == ev.JOB_RESUMED)
+    assert resumed["degraded"] is True
+    assert resumed["mttr_s"] is not None  # notified -> resumed on ledger
+    status = json.loads(world.paths.fleet_status.read_text())
+    assert status["job"]["phase"] == "degraded"
+    assert status["job"]["acked_degraded"] == [2]
+    assert status["heals"]["suppressed"] == 1
+    assert "suppressed" in say.text()
+
+
+def test_healthy_again_clears_suppression(tmp_path):
+    """The suppressed slice coming back (an operator ran `heal` by
+    hand) clears the acknowledgement: future losses heal normally."""
+    clock = SimClock()
+    world = FleetSim(tmp_path, clock)
+    world.preempt(1, at=0.0)
+    supervisor = build(world, clock, prompter=Say())
+    write_ack(world, "degraded", step=10, slices=(1,), world_size=2)
+    run_sim(supervisor, clock, ticks=3)
+    assert world.applies == []
+    world.down.discard(1)  # manual repair outside the supervisor
+    run_sim(supervisor, clock, ticks=2)
+    status = json.loads(world.paths.fleet_status.read_text())
+    assert status["job"]["acked_degraded"] == []
+    # and the slice is heal-eligible again on its next loss
+    world.preempt(1, at=world.clock.time())
+    run_sim(supervisor, clock, ticks=4)
+    assert world.applies == [[1]]
+
+
+def test_job_ack_restart_does_not_rerecord(tmp_path):
+    """A restarted supervisor folds the acked phase from the ledger and
+    does not re-record an acknowledgement it already ledgered."""
+    clock = SimClock()
+    world = FleetSim(tmp_path, clock)
+    supervisor = build(world, clock, prompter=Say())
+    write_ack(world, "resumed", generation=3, step=70)
+    run_sim(supervisor, clock, ticks=2)
+    first = kinds(world).count(ev.JOB_RESUMED)
+    assert first == 1
+    restarted = build(world, clock, prompter=Say())
+    run_sim(restarted, clock, ticks=2)
+    assert kinds(world).count(ev.JOB_RESUMED) == 1
+
+
+def test_job_ack_watcher_tolerates_missing_and_torn(tmp_path):
+    watcher = sup_mod.JobAckWatcher(tmp_path / "job-ack.json")
+    assert watcher.read() is None  # absent
+    (tmp_path / "job-ack.json").write_text('{"phase": "resu')
+    assert watcher.read() is None  # torn
+    view = ev.LedgerView()
+    assert watcher.observe(view, lambda *a, **k: None, 0.0) is None
 
 
 # ------------------------------------------------- drill (b): heal storm

@@ -72,7 +72,7 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument(
         "command",
         nargs="?",
-        choices=["heal", "supervise", "status"],
+        choices=["heal", "supervise", "status", "train"],
         metavar="command",
         help="optional subcommand: `heal` diagnoses per-slice fleet "
         "health (missing / unready / draining) and repairs ONLY the "
@@ -81,7 +81,11 @@ def build_parser() -> argparse.ArgumentParser:
         "`supervise` runs the resident reconcile loop (detect drift, "
         "rate-limited auto-heal, circuit breaker, durable event ledger); "
         "`status` renders the machine-readable fleet status "
-        "(docs/failure-modes.md, running-unattended runbook)",
+        "(docs/failure-modes.md, running-unattended runbook); `train` "
+        "runs the elastic-training drill — a small LM trained through "
+        "parallel/elastic.py's ElasticTrainer against this workdir's "
+        "fleet-status.json, resuming at the new world size on membership "
+        "changes (docs/failure-modes.md, elastic-training runbook)",
     )
     parser.add_argument(
         "-c", "--clean", action="store_true", help="destroy the cluster and all state"
@@ -149,6 +153,43 @@ def build_parser() -> argparse.ArgumentParser:
         "--json", action="store_true",
         help="status: print the raw fleet-status JSON document instead "
         "of the human summary",
+    )
+    # ---------------------------------------------------------- train drill
+    parser.add_argument(
+        "--steps", type=int, default=200, metavar="N",
+        help="train: total optimizer steps for the elastic drill "
+        "(default 200)",
+    )
+    parser.add_argument(
+        "--checkpoint-every", type=int, default=25, metavar="N",
+        help="train: steps between durable checkpoints — the bound on "
+        "work lost to an unplanned membership change (default 25)",
+    )
+    parser.add_argument(
+        "--status-file", type=Path, default=None, metavar="FILE",
+        help="train: fleet-status.json to watch (default: the workdir's; "
+        "a missing or mid-rewrite file reads as unknown, never healthy)",
+    )
+    parser.add_argument(
+        "--ack-file", type=Path, default=None, metavar="FILE",
+        help="train: job-ack.json to write membership acknowledgements "
+        "to (default: the workdir's)",
+    )
+    parser.add_argument(
+        "--env-file", type=Path, default=None, metavar="FILE",
+        help="train: cluster env file re-read on every rejoin (the "
+        "tpuhost role rewrites /etc/tpu-cluster.env with the new "
+        "process set after a heal; default: the standard location)",
+    )
+    parser.add_argument(
+        "--max-wait", type=float, default=600.0, metavar="SECONDS",
+        help="train: bounded wait for the supervisor's heal before "
+        "declaring degraded continuation (default 600)",
+    )
+    parser.add_argument(
+        "--train-report", type=Path, default=None, metavar="FILE",
+        help="train: also write the run report (resumes, steps lost, "
+        "world size) as JSON to FILE",
     )
     parser.add_argument(
         "--config",
@@ -292,6 +333,8 @@ def main(argv: list[str] | None = None, prompter: Prompter | None = None) -> int
             return supervise_cmd(args, paths, prompter)
         if args.command == "status":
             return status_cmd(args, paths, prompter)
+        if args.command == "train":
+            return train_cmd(args, paths, prompter)
         if args.show_config:
             return show_config(args, paths, prompter)
         return provision(args, paths, prompter)
@@ -472,14 +515,27 @@ def status_cmd(args, paths: state.RunPaths, prompter: Prompter) -> int:
     import json as json_mod
     import time as time_mod
 
+    # Tolerant read: a missing OR unreadable status file is "unknown,
+    # retry" — the atomic rewrite makes torn reads near-impossible, but
+    # a half-copied file (rsync, scraper snapshot) must fall back to the
+    # ledger fold, never crash or read as healthy.
+    doc = None
     if paths.fleet_status.exists():
-        doc = json_mod.loads(paths.fleet_status.read_text())
-    elif paths.events.exists():
+        try:
+            doc = json_mod.loads(paths.fleet_status.read_text())
+        except ValueError:
+            prompter.say(
+                f"NOTE: {paths.fleet_status} is unreadable (torn copy?); "
+                "falling back to the event ledger"
+            )
+    if not isinstance(doc, dict):
+        doc = None
+    if doc is None and paths.events.exists():
         ledger = events_mod.EventLedger(paths.events)
         doc = events_mod.fleet_status(
             events_mod.fold(ledger.replay()), time_mod.time()
         )
-    else:
+    if doc is None:
         raise state.MissingStateError(
             f"no fleet status at {paths.fleet_status} and no event "
             f"ledger at {paths.events} — run ./setup.sh supervise to "
@@ -520,7 +576,127 @@ def status_cmd(args, paths: state.RunPaths, prompter: Prompter) -> int:
             + (f" (reopen at {breaker.get('reopen_at'):.0f})"
                if breaker.get("reopen_at") else "")
         )
+        membership = doc.get("membership", {})
+        if membership:
+            prompter.say(
+                f"membership: generation {membership.get('generation')}"
+                + (", heal in progress"
+                   if membership.get("heal_in_progress") else "")
+                + (f", draining {membership.get('draining')}"
+                   if membership.get("draining") else "")
+            )
+        job = doc.get("job", {})
+        if job.get("phase"):
+            job_mttr = (job.get("mttr_s") or {}).get("last")
+            prompter.say(
+                f"job: {job['phase']} (generation "
+                f"{job.get('generation')}, step {job.get('step')}"
+                + (f", acked degraded {job['acked_degraded']}"
+                   if job.get("acked_degraded") else "")
+                + (f", job MTTR {job_mttr:.0f}s"
+                   if job_mttr is not None else "")
+                + ")"
+            )
     return 0 if doc.get("verdict") == "healthy" else 2
+
+
+def train_cmd(args, paths: state.RunPaths, prompter: Prompter) -> int:
+    """`./setup.sh train` — the elastic-training drill: a small causal
+    LM driven by parallel/elastic.py's ElasticTrainer through the real
+    make_lm_train_step machinery, watching this workdir's
+    fleet-status.json and acknowledging membership changes through
+    job-ack.json. Run it on a provisioned deployment (each host gets
+    the cluster env from the tpuhost role) or locally against a
+    supervisor (or a test harness) rewriting the status file."""
+    import json as json_mod
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tritonk8ssupervisor_tpu.models import TransformerLM
+    from tritonk8ssupervisor_tpu.parallel import elastic as elastic_mod
+    from tritonk8ssupervisor_tpu.parallel import make_workload_mesh
+    from tritonk8ssupervisor_tpu.parallel import train as train_lib
+    from tritonk8ssupervisor_tpu.parallel.checkpoint import TrainCheckpointer
+    from tritonk8ssupervisor_tpu.parallel.mesh import batch_axes
+
+    if not args.checkpoint_dir:
+        raise ConfigError(
+            "the elastic train drill needs --checkpoint-dir (or "
+            "TK8S_CHECKPOINT_DIR): resume at the new world size IS the "
+            "drill, and it resumes from the shared checkpoint"
+        )
+    batch, seq, vocab = 8, 16, 64
+
+    def setup() -> "elastic_mod.TrainSession":
+        mesh = make_workload_mesh()
+        model = TransformerLM(
+            vocab_size=vocab, num_layers=1, num_heads=2, embed_dim=32,
+            max_seq_len=seq, dtype=jnp.float32, logits_dtype=jnp.float32,
+        )
+        tx = train_lib.default_optimizer(learning_rate=0.05)
+        sample = jax.ShapeDtypeStruct((batch, seq), jnp.int32)
+        state_, shardings = train_lib.create_train_state(
+            model, jax.random.key(0), sample, mesh, tx
+        )
+        step_fn = train_lib.make_lm_train_step(model, tx, mesh, shardings)
+        return elastic_mod.TrainSession(state_, shardings, step_fn, mesh)
+
+    def batch_fn(session, step_index: int) -> tuple:
+        # deterministic per-step token grid: every process constructs the
+        # same global batch, so resumes are reproducible across worlds
+        fill = np.random.default_rng(step_index).integers(
+            0, vocab, (batch, seq)
+        ).astype(np.int32)
+        sharding = NamedSharding(session.mesh, P(batch_axes(session.mesh),
+                                                 None))
+        tokens = jax.make_array_from_callback(
+            (batch, seq), sharding, lambda idx: fill[idx]
+        )
+        return (tokens,)
+
+    env_file = args.env_file
+    trainer = elastic_mod.ElasticTrainer(
+        setup,
+        batch_fn,
+        # factory, not instance: orbax's manager runs JAX computations
+        # at construction, which must not precede the cluster join
+        checkpoint=elastic_mod.ElasticCheckpoint(
+            lambda: TrainCheckpointer(args.checkpoint_dir)
+        ),
+        health=elastic_mod.FileHealthSource(
+            args.status_file or paths.fleet_status
+        ),
+        policy=elastic_mod.ElasticPolicy(
+            checkpoint_every=max(1, args.checkpoint_every),
+            max_wait_s=args.max_wait,
+            max_degraded=max(0, args.max_degraded),
+        ),
+        ack=elastic_mod.JobAck(args.ack_file or paths.job_ack),
+        # first join: the inherited process env (what the launcher set);
+        # every REJOIN re-reads the env file — after a heal the tpuhost
+        # role rewrote it with the new process set, while this process's
+        # inherited variables still describe the dead world
+        rejoin_fn=(lambda: elastic_mod.default_initialize(env_file))
+        if env_file is not None else None,
+        echo=lambda line: prompter.say(line),
+    )
+    report = trainer.run(max(1, args.steps))
+    if args.train_report:
+        state.atomic_write_text(
+            args.train_report,
+            json_mod.dumps(report, indent=2, sort_keys=True) + "\n",
+        )
+    prompter.say(
+        f"elastic train drill done: steps {report['start_step']} -> "
+        f"{report['final_step']} at world size {report.get('world')}, "
+        f"{len(report['resumes'])} membership resume(s), "
+        f"{report['steps_lost']} step(s) lost, "
+        f"{report['drain_flushes']} drain flush(es)"
+    )
+    return 0
 
 
 def provision(args, paths: state.RunPaths, prompter: Prompter) -> int:

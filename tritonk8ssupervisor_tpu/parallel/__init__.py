@@ -19,6 +19,11 @@ from tritonk8ssupervisor_tpu.parallel.distributed import (
     cluster_env,
     initialize_from_env,
 )
+from tritonk8ssupervisor_tpu.parallel.elastic import (
+    ElasticPolicy,
+    ElasticTrainer,
+    FileHealthSource,
+)
 
 __all__ = [
     "make_mesh",
@@ -29,4 +34,7 @@ __all__ = [
     "param_shardings",
     "cluster_env",
     "initialize_from_env",
+    "ElasticPolicy",
+    "ElasticTrainer",
+    "FileHealthSource",
 ]

@@ -33,36 +33,130 @@ def resolve_checkpoint_dir(directory: Path | str) -> Path | str:
     return Path(directory).absolute()
 
 
+# Sidecar commit-marker directory: `<ckpt-dir>/.tk8s-complete/<step>` is
+# written (atomically, temp + os.replace — the state.atomic_write_text
+# pattern) only AFTER the step's async save fully finished. A step
+# directory without its marker is a save a crash interrupted — restore
+# skips it and falls back to the previous complete step instead of
+# dying on a torn array file. Sidecar rather than in-dir so orbax's own
+# layout/GC never sees an unexpected file.
+COMMIT_DIR = ".tk8s-complete"
+
+
 class TrainCheckpointer:
-    """Thin wrapper over ocp.CheckpointManager for TrainState pytrees."""
+    """Thin wrapper over ocp.CheckpointManager for TrainState pytrees,
+    with a crash-safety layer orbax alone does not give us on every
+    filesystem: saves are committed by a sidecar marker written only
+    after the write fully finished, `latest_step` only reports committed
+    steps, and `restore` falls back past a torn/partial latest step to
+    the previous complete one (SURVEY.md §5 crash-resume, extended from
+    "a checkpoint exists" to "a checkpoint is whole")."""
 
     def __init__(self, directory: Path | str, max_to_keep: int = 3):
+        self._dir = resolve_checkpoint_dir(directory)
+        # markers are a local-filesystem protocol; gs:// writes go
+        # through orbax's own atomic finalisation and skip this layer
+        self._local = isinstance(self._dir, Path)
         self._manager = ocp.CheckpointManager(
-            resolve_checkpoint_dir(directory),
+            self._dir,
             options=ocp.CheckpointManagerOptions(
                 max_to_keep=max_to_keep, create=True
             ),
         )
+        self._pending: list[int] = []  # saved, marker not yet written
+
+    # ------------------------------------------------------ commit markers
+
+    def _marker(self, step: int) -> Path:
+        return Path(self._dir) / COMMIT_DIR / str(step)
+
+    def _flush_markers(self) -> None:
+        """Wait for in-flight saves, then commit their markers — and drop
+        markers whose step dirs max_to_keep already pruned."""
+        if not self._local:
+            return
+        if self._pending:
+            self._manager.wait_until_finished()
+            steps = set(self._manager.all_steps())
+            for step in self._pending:
+                if step in steps:
+                    from tritonk8ssupervisor_tpu.provision.state import (
+                        atomic_write_text,
+                    )
+
+                    atomic_write_text(self._marker(step), f"{step}\n")
+            self._pending.clear()
+        marker_dir = Path(self._dir) / COMMIT_DIR
+        if marker_dir.is_dir():
+            live = {str(s) for s in self._manager.all_steps()}
+            for stale in marker_dir.iterdir():
+                if stale.name not in live:
+                    stale.unlink(missing_ok=True)
+
+    def _committed_steps(self) -> list[int]:
+        """Steps safe to restore, ascending. Steps without markers are
+        skipped as torn — unless NO step has one (a checkpoint directory
+        written before this layer existed), in which case orbax's own
+        record is trusted wholesale rather than discarded."""
+        steps = sorted(self._manager.all_steps())
+        if not self._local or not steps:
+            return steps
+        committed = [s for s in steps if self._marker(s).exists()]
+        return committed if committed else steps
+
+    # ------------------------------------------------------------- the API
 
     def latest_step(self) -> int | None:
-        return self._manager.latest_step()
+        self._flush_markers()
+        steps = self._committed_steps()
+        return steps[-1] if steps else None
 
     def save(self, step: int, state: Any, wait: bool = False) -> None:
+        # commit the PREVIOUS save's marker first: by the next save call
+        # the prior async write has (at worst) a bounded wait left, so
+        # the pipeline keeps one save in flight but never an unmarked
+        # backlog
+        self._flush_markers()
         self._manager.save(step, args=ocp.args.StandardSave(state))
+        self._pending.append(step)
         if wait:
-            self._manager.wait_until_finished()
+            self._flush_markers()
 
     def restore(self, abstract_state: Any, step: int | None = None) -> Any:
         """Restore into the given abstract pytree (jax.ShapeDtypeStructs
-        carrying shardings — build with `abstract_like`)."""
-        step = self._manager.latest_step() if step is None else step
-        if step is None:
+        carrying shardings — build with `abstract_like`). With no explicit
+        step, tries the latest committed step and falls back past any
+        that fail to read (torn save) to the previous complete one."""
+        if step is not None:
+            return self._manager.restore(
+                step, args=ocp.args.StandardRestore(abstract_state)
+            )
+        self._flush_markers()
+        candidates = self._committed_steps()
+        if not candidates:
             raise FileNotFoundError("no checkpoint to restore")
-        return self._manager.restore(
-            step, args=ocp.args.StandardRestore(abstract_state)
-        )
+        last_error: Exception | None = None
+        for candidate in reversed(candidates):
+            try:
+                return self._manager.restore(
+                    candidate, args=ocp.args.StandardRestore(abstract_state)
+                )
+            except Exception as e:  # noqa: BLE001 - a torn step may fail
+                # anywhere in orbax's read path; any earlier complete
+                # step beats dying on a half-written latest
+                last_error = e
+                print(
+                    f"checkpoint step {candidate} unreadable "
+                    f"({type(e).__name__}: {e}); falling back to the "
+                    "previous complete step",
+                    flush=True,
+                )
+        raise FileNotFoundError(
+            f"no readable checkpoint (latest torn?): {last_error}"
+        ) from last_error
 
     def close(self) -> None:
+        self._flush_markers()
         self._manager.wait_until_finished()
         self._manager.close()
 

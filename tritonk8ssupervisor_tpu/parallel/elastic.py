@@ -1,0 +1,622 @@
+"""Elastic training: supervisor-aware resume at the new world size.
+
+PRs 1-5 made the fleet self-healing; this module makes the *job* survive
+what the fleet survives. The supervisor (provision/supervisor.py) can
+detect, heal, and ledger a slice loss, but the training run it
+supervises still died with the slice — the checkpoint resize-resume pin
+(tests/test_checkpoint.py::test_restore_across_resized_mesh) proved the
+mechanism and nothing drove it. `ElasticTrainer` is that driver: the
+resident-control-loop + elastic-actors shape from Podracer (PAPERS.md),
+where membership change is a recoverable event, not a crash.
+
+The contract with the supervisor has two halves:
+
+- **Down**: `fleet-status.json` carries a monotonic membership
+  `generation` (bumped when a slice leaves or returns to the serving
+  set) and a `heal_in_progress` flag (so the trainer WAITS for the heal
+  instead of thrash-restarting into a half-healed fleet), plus the
+  `draining` list — scheduled maintenance the trainer answers with a
+  pre-preemption checkpoint while continuing to step.
+  `FileHealthSource` reads it; absence or a torn read is *unknown,
+  retry* — never healthy.
+- **Up**: the trainer acknowledges through `job-ack.json` (atomic
+  rewrite): `notified` when it saw the change, `resumed` when it is
+  stepping again, `degraded` when the bounded wait ran out and it
+  continues WITHOUT the lost slices. The supervisor folds those into
+  the event ledger (job-notified / job-resumed / degraded-ack) for MTTR
+  attribution, and a degraded-ack suppresses further heals of slices
+  the job has already written off — breaker-open and degraded training
+  must not fight.
+
+At every step boundary the trainer polls the health source; on a
+generation bump (or a mid-step collective failure — the unplanned form
+of the same event) it:
+
+1. flushes a coordinated emergency checkpoint (best-effort: the
+   coordinator may already be gone — then the last periodic checkpoint
+   bounds the loss to one interval);
+2. tears down `jax.distributed` and clears the backends;
+3. waits bounded-with-backoff (retry.Cooldown decorrelated jitter) for
+   the supervisor to finish healing — or, past `max_wait_s`, declares
+   degraded continuation within its `max_degraded` budget;
+4. re-runs `initialize_from_env` at the new process set, rebuilds the
+   mesh (`make_workload_mesh` / the injected `setup`) at the new
+   `num_slices`, and restores the checkpoint through `abstract_like`
+   into the NEW shardings — the resize-resume pin, live.
+
+Every seam (health source, checkpoint, cluster join/leave, clock/sleep,
+drain probe) is injectable, so the reconfigure logic is provable on a
+virtual clock (tests/test_elastic.py, bench_provision.py --elastic)
+and the real drill (2 CPU processes, one SIGKILLed mid-training) runs
+the exact same loop. Runbook: docs/failure-modes.md, "elastic training".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import random
+import time
+from pathlib import Path
+from typing import Any, Callable
+
+from tritonk8ssupervisor_tpu.provision import maintenance
+from tritonk8ssupervisor_tpu.provision import retry
+from tritonk8ssupervisor_tpu.provision.state import atomic_write_text
+
+
+class ElasticError(RuntimeError):
+    """The trainer cannot make progress (repeated failed resumes)."""
+
+
+# ------------------------------------------------------------ health source
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetView:
+    """What the trainer needs from one fleet-status.json observation."""
+
+    generation: int
+    heal_in_progress: bool
+    verdict: str
+    draining: tuple = ()
+    degraded: tuple = ()
+    updated: float | None = None
+
+
+def parse_fleet_status(raw: Any) -> FleetView | None:
+    """A FleetView from a parsed fleet-status document, or None when the
+    document is not one (wrong type, mangled fields) — the same "unknown,
+    retry" verdict as a torn read."""
+    try:
+        if not isinstance(raw, dict):
+            return None
+        membership = raw.get("membership")
+        membership = membership if isinstance(membership, dict) else {}
+        slices = raw.get("slices")
+        slices = slices if isinstance(slices, dict) else {}
+        draining = membership.get("draining")
+        if draining is None:
+            draining = [int(i) for i, entry in slices.items()
+                        if isinstance(entry, dict)
+                        and entry.get("state") == "draining"]
+        return FleetView(
+            generation=int(membership.get("generation", 1)),
+            heal_in_progress=bool(membership.get("heal_in_progress",
+                                                 False)),
+            verdict=str(raw.get("verdict", "unknown")),
+            draining=tuple(sorted(int(i) for i in draining)),
+            degraded=tuple(sorted(int(i)
+                                  for i in raw.get("degraded") or [])),
+            updated=raw.get("updated"),
+        )
+    except (TypeError, ValueError):
+        return None
+
+
+class HealthSource:
+    """Where the trainer learns about membership. `poll()` returns the
+    current FleetView, or None for *unknown* — a missing or mid-rewrite
+    status file must read as "retry", never as healthy."""
+
+    def poll(self) -> FleetView | None:  # pragma: no cover - interface
+        raise NotImplementedError
+
+
+class FileHealthSource(HealthSource):
+    """File-backed reader of the supervisor's fleet-status.json (the
+    atomic-rewrite side lives in events.write_fleet_status; readers only
+    ever see a whole document or nothing)."""
+
+    def __init__(self, path: Path | str) -> None:
+        self.path = Path(path)
+
+    def poll(self) -> FleetView | None:
+        try:
+            raw = json.loads(self.path.read_text())
+        except (OSError, ValueError):
+            return None  # absent or torn: unknown, retry
+        return parse_fleet_status(raw)
+
+
+class ScriptedHealthSource(HealthSource):
+    """The injectable fake for tests: yields a scripted sequence of
+    views (None entries model unknown reads); the last view repeats
+    forever."""
+
+    def __init__(self, views) -> None:
+        self._views = list(views)
+        self.polls = 0
+
+    def poll(self) -> FleetView | None:
+        self.polls += 1
+        if len(self._views) > 1:
+            return self._views.pop(0)
+        return self._views[0] if self._views else None
+
+
+# ----------------------------------------------------------------- job ack
+
+
+class JobAck:
+    """The trainer's half of the contract: job-ack.json, atomically
+    rewritten (state.atomic_write_text) so the supervisor's tick never
+    reads a torn acknowledgement. `path=None` disables (a run without a
+    supervisor, e.g. plain benchmarks)."""
+
+    def __init__(self, path: Path | str | None, clock=time.time) -> None:
+        self.path = Path(path) if path else None
+        self._clock = clock
+
+    def write(
+        self,
+        phase: str,
+        generation: int | None,
+        step: int,
+        world: int | None = None,
+        slices=(),
+        reason: str = "",
+    ) -> None:
+        if self.path is None:
+            return
+        doc = {
+            "v": 1,
+            "ts": self._clock(),
+            "phase": phase,
+            "generation": generation,
+            "step": int(step),
+            "world": world,
+            "slices": sorted(int(i) for i in slices),
+            "reason": reason[:200],
+        }
+        atomic_write_text(self.path, json.dumps(doc, sort_keys=True) + "\n")
+
+
+# ------------------------------------------------------- cluster transitions
+
+
+def default_initialize(env_file: Path | str | None = None,
+                       environ: dict | None = None):
+    """(Re)join the JAX cluster from the env contract — the production
+    init_fn/rejoin_fn. With `env_file`, the FILE is authoritative on
+    rejoin: after a heal, ansible rewrites /etc/tpu-cluster.env with the
+    new process set, while this process's inherited env vars still
+    describe the old world."""
+    from tritonk8ssupervisor_tpu.parallel import distributed
+
+    if env_file is not None:
+        env_file = Path(env_file)
+        if env_file.exists():
+            from tritonk8ssupervisor_tpu.config.store import parse_flat
+
+            environ = parse_flat(env_file.read_text())
+        elif environ is None:
+            environ = {}
+        return distributed.initialize_from_env(environ=environ,
+                                               env_file=env_file)
+    return distributed.initialize_from_env(environ)
+
+
+def default_shutdown() -> None:
+    """Leave the current JAX cluster: distributed shutdown (best-effort
+    — the coordinator may be the host that died) and a backend clear so
+    the next jax.devices() reflects the NEW world, not a cached view of
+    the old one."""
+    import jax
+
+    try:
+        jax.distributed.shutdown()
+    except Exception:  # noqa: BLE001 - already gone is fine
+        pass
+    try:
+        import jax.extend.backend as jeb
+
+        jeb.clear_backends()
+    except Exception:  # noqa: BLE001 - older jax layouts
+        pass
+
+
+# ------------------------------------------------------------------ trainer
+
+
+@dataclasses.dataclass
+class ElasticPolicy:
+    """Knobs for the elastic loop (docs/failure-modes.md lists them)."""
+
+    checkpoint_every: int = 50  # steps between durable checkpoints
+    poll_every: int = 1  # steps between health polls
+    wait_base_s: float = 5.0  # first heal-wait probe delay
+    wait_cap_s: float = 60.0  # decorrelated-jitter cap (retry.Cooldown)
+    max_wait_s: float = 600.0  # give up waiting -> degraded continuation
+    max_degraded: int = 0  # slices the job will continue without
+    max_consecutive_failures: int = 3  # resumes with zero progress
+
+
+@dataclasses.dataclass
+class TrainSession:
+    """One world's training surface, built by the caller's `setup()`:
+    state + its shardings, the jitted step, and the mesh it runs on.
+    `setup` is re-run after every membership change — it must rebuild
+    the mesh from the CURRENT device set (make_workload_mesh does)."""
+
+    state: Any
+    shardings: Any
+    step_fn: Callable  # (state, *batch) -> (state, metrics)
+    mesh: Any = None
+
+
+def _state_step(state: Any, fallback: int) -> int:
+    """The step counter carried by TrainState pytrees; `fallback` for
+    toy/fake states without one."""
+    step = getattr(state, "step", None)
+    if step is None:
+        return fallback
+    try:
+        return int(step)
+    except (TypeError, ValueError):
+        return fallback
+
+
+class ElasticCheckpoint:
+    """TrainCheckpointer adapted to the trainer's duck-typed needs:
+    `restore(state, shardings)` builds the abstract target itself, so
+    fakes in tests and the bench sim only implement three methods.
+
+    Pass a zero-arg factory instead of an instance to defer
+    construction until first use: orbax's CheckpointManager executes
+    JAX computations at __init__ (directory-creation sync), and
+    jax.distributed.initialize refuses to run after ANY computation —
+    so the manager must not exist before the trainer's init_fn joins
+    the cluster."""
+
+    def __init__(self, checkpointer) -> None:
+        if callable(checkpointer):
+            self._ckpt, self._factory = None, checkpointer
+        else:
+            self._ckpt, self._factory = checkpointer, None
+
+    def _resolve(self):
+        if self._ckpt is None:
+            self._ckpt = self._factory()
+        return self._ckpt
+
+    def latest_step(self) -> int | None:
+        return self._resolve().latest_step()
+
+    def save(self, step: int, state: Any, wait: bool = False) -> None:
+        self._resolve().save(step, state, wait=wait)
+
+    def restore(self, state: Any, shardings: Any,
+                step: int | None = None) -> Any:
+        from tritonk8ssupervisor_tpu.parallel.checkpoint import abstract_like
+
+        return self._resolve().restore(abstract_like(state, shardings),
+                                       step=step)
+
+    def reset(self) -> None:
+        """Drop the cached manager so the next use rebuilds it against
+        the CURRENT world (no-op without a factory). Called by the
+        trainer between leaving the old world and restoring in the new
+        one — the old manager's sync primitives assume a process set
+        that no longer exists."""
+        if self._factory is None or self._ckpt is None:
+            return
+        try:
+            self._ckpt.close()
+        except Exception:  # noqa: BLE001 - the old world may be gone
+            pass
+        self._ckpt = None
+
+    def close(self) -> None:
+        if self._ckpt is not None:
+            self._ckpt.close()
+
+
+class ElasticTrainer:
+    """The elastic loop around make_train_step/make_lm_train_step
+    machinery. See the module docstring for the protocol; every
+    collaborator is injectable:
+
+    - setup:      () -> TrainSession, re-run per world
+    - batch_fn:   (session, step) -> step args tuple
+    - checkpoint: latest_step()/save()/restore(state, shardings)
+                  (ElasticCheckpoint wraps TrainCheckpointer)
+    - health:     HealthSource
+    - ack:        JobAck (or None)
+    - init_fn / rejoin_fn / shutdown_fn: cluster transitions
+    - drain_fn:   () -> reason|None (maintenance.drain_requested)
+    """
+
+    def __init__(
+        self,
+        setup: Callable[[], TrainSession],
+        batch_fn: Callable[[TrainSession, int], tuple],
+        checkpoint,
+        health: HealthSource,
+        policy: ElasticPolicy | None = None,
+        ack: JobAck | None = None,
+        init_fn: Callable[[], Any] | None = None,
+        rejoin_fn: Callable[[], Any] | None = None,
+        shutdown_fn: Callable[[], None] = default_shutdown,
+        drain_fn: Callable[[], str | None] | None =
+            maintenance.drain_requested,
+        clock: Callable[[], float] = time.monotonic,
+        sleep: Callable[[float], None] = time.sleep,
+        rng: Callable[[], float] = random.random,
+        echo: Callable[[str], None] = lambda line: print(line, flush=True),
+    ) -> None:
+        self._setup = setup
+        self._batch_fn = batch_fn
+        self._ckpt = checkpoint
+        self._health = health
+        self.policy = policy or ElasticPolicy()
+        self._ack = ack or JobAck(None)
+        self._init_fn = init_fn or default_initialize
+        self._rejoin_fn = rejoin_fn or self._init_fn
+        self._shutdown_fn = shutdown_fn
+        self._drain_fn = drain_fn
+        self._clock = clock
+        self._sleep = sleep
+        self._rng = rng
+        self._echo = echo
+        self.session: TrainSession | None = None
+        self.generation: int | None = None
+        self.world: Any = None  # the last ClusterEnv (or None)
+
+    # ------------------------------------------------------------- helpers
+
+    def _say(self, text: str) -> None:
+        self._echo(f"[elastic] {text}")
+
+    def _world_size(self) -> int | None:
+        env = self.world
+        return getattr(env, "num_processes", None) if env is not None else 1
+
+    def _save(self, step: int, wait: bool = False) -> bool:
+        """Persist the current state; best-effort (an emergency flush
+        after the coordinator died may fail — the last periodic
+        checkpoint then bounds the loss)."""
+        try:
+            self._ckpt.save(step, self.session.state, wait=wait)
+            return True
+        except Exception as e:  # noqa: BLE001 - durability is best-effort
+            self._say(f"checkpoint save at step {step} failed "
+                      f"({type(e).__name__}: {e}); continuing on the "
+                      "previous checkpoint")
+            return False
+
+    def _restore(self, fallback_step: int) -> int:
+        """Restore the latest complete checkpoint into the CURRENT
+        session's shardings; returns the step training resumes at."""
+        latest = self._ckpt.latest_step()
+        if latest is None:
+            return fallback_step
+        self.session.state = self._ckpt.restore(
+            self.session.state, self.session.shardings
+        )
+        return _state_step(self.session.state, latest)
+
+    # -------------------------------------------------------- reconfigure
+
+    def _wait_for_heal(self) -> tuple[FleetView | None, bool, float]:
+        """Bounded wait for the supervisor: returns (last view, degraded,
+        seconds waited). Exits early on a settled fleet — healthy, or
+        degraded within the trainer's own budget once no heal is in
+        flight; a fleet still healing (heal_in_progress) is always worth
+        waiting for inside the budget.
+
+        Staleness guard: after an UNPLANNED event (our collective died),
+        a status document the supervisor wrote BEFORE the incident still
+        says "healthy" — trusting it would resume straight into the
+        broken fleet and fail again. A view is only evidence once it is
+        *fresh*: its generation moved past ours, or its `updated` stamp
+        changed from the first view this wait observed. (Stamps are
+        compared for inequality, never across clock domains.)"""
+        policy = self.policy
+        cooldown = retry.Cooldown(policy.wait_base_s, policy.wait_cap_s,
+                                  rng=self._rng)
+        start = self._clock()
+        deadline = start + policy.max_wait_s
+        baseline = self._health.poll()
+        view = baseline
+
+        def fresh(v: FleetView) -> bool:
+            if self.generation is None or v.generation != self.generation:
+                return True
+            if baseline is None:
+                return True
+            return v.updated != baseline.updated
+
+        while True:
+            if view is not None and not view.heal_in_progress \
+                    and fresh(view):
+                if view.verdict == "healthy":
+                    return view, False, self._clock() - start
+                if (len(view.degraded) <= policy.max_degraded
+                        and view.verdict in ("degraded", "degraded-hold")):
+                    # the supervisor has stopped (or been stopped from)
+                    # healing and the loss fits the budget: continue
+                    # degraded now rather than burn the whole wait
+                    return view, True, self._clock() - start
+            remaining = deadline - self._clock()
+            if remaining <= 0:
+                return view, True, self._clock() - start
+            self._sleep(min(cooldown.next(), remaining))
+            view = self._health.poll()
+
+    def _reconfigure(self, step: int, last_saved: int, reason: str,
+                     state_intact: bool, report: dict) -> int:
+        """The membership-change path: flush, leave, wait, rejoin,
+        rebuild, restore. Returns the step training resumes at."""
+        policy = self.policy
+        now = self._clock()
+        self._say(f"membership change at step {step}: {reason}")
+        if state_intact:
+            if self._save(step, wait=True):
+                last_saved = step
+        self._ack.write("notified", self.generation, step,
+                        world=self._world_size(), reason=reason)
+        self._shutdown_fn()
+        reset = getattr(self._ckpt, "reset", None)
+        if reset is not None:
+            reset()  # the old world's checkpoint manager dies with it
+        view, degraded, waited = self._wait_for_heal()
+        self.world = self._rejoin_fn()
+        self.session = self._setup()
+        resumed_at = self._restore(last_saved)
+        lost = max(0, step - resumed_at)
+        self.generation = view.generation if view is not None \
+            else self.generation
+        slices = tuple(view.degraded) if (degraded and view) else ()
+        phase = "degraded" if degraded else "resumed"
+        self._ack.write(phase, self.generation, resumed_at,
+                        world=self._world_size(), slices=slices,
+                        reason=reason)
+        self._say(
+            f"resumed at step {resumed_at} "
+            f"(world size {self._world_size()}, "
+            f"{'DEGRADED without slice(s) %s' % (list(slices),) if degraded else 'fleet healthy'}, "
+            f"waited {waited:.0f}s, lost {lost} step(s))"
+        )
+        report["resumes"].append({
+            "ts": self._clock(),
+            "reason": reason,
+            "at_step": step,
+            "resumed_step": resumed_at,
+            "steps_lost": lost,
+            "degraded": degraded,
+            "degraded_slices": list(slices),
+            "generation": self.generation,
+            "world": self._world_size(),
+            "waited_s": round(waited, 3),
+            "notice_ts": now,
+        })
+        report["steps_lost"] += lost
+        return resumed_at
+
+    # ---------------------------------------------------------------- run
+
+    def run(self, total_steps: int) -> dict:
+        """Train to `total_steps`, surviving membership changes. Returns
+        the report: start/final step, resumes (with per-resume steps
+        lost and wait), and drain flushes."""
+        policy = self.policy
+        view = self._health.poll()
+        self.generation = view.generation if view is not None else None
+        self.world = self._init_fn()
+        self.session = self._setup()
+        step = self._restore(0)
+        start_step = step
+        report = {
+            "start_step": start_step,
+            "final_step": step,
+            "steps_lost": 0,
+            "resumes": [],
+            "drain_flushes": 0,
+        }
+        if step > 0:
+            self._say(f"resuming from checkpoint at step {step}")
+        last_saved = step
+        last_polled = None
+        drain_flushed = False
+        failures_at: int | None = None
+        failures = 0
+        while step < total_steps:
+            # ---- step-boundary health consultation
+            reason = None
+            if last_polled is None or step - last_polled >= policy.poll_every:
+                last_polled = step
+                view = self._health.poll()
+                if view is not None:
+                    if self.generation is None:
+                        self.generation = view.generation
+                    elif view.generation != self.generation:
+                        reason = (f"generation "
+                                  f"{self.generation} -> {view.generation}")
+                drain = self._drain_fn() if self._drain_fn else None
+                if drain is None and view is not None and view.draining:
+                    drain = (f"slice(s) {list(view.draining)} draining "
+                             "per fleet status")
+                if reason is None and drain and not drain_flushed:
+                    # the pre-preemption checkpoint window: scheduled
+                    # maintenance was announced but the world has not
+                    # changed yet — flush NOW, keep stepping, and the
+                    # coming generation bump (or kill) costs ~0 steps
+                    self._say(f"drain notice ({drain}); flushing "
+                              f"checkpoint at step {step}")
+                    if self._save(step, wait=True):
+                        last_saved = step
+                        drain_flushed = True
+                        report["drain_flushes"] += 1
+                    self._ack.write("notified", self.generation, step,
+                                    world=self._world_size(),
+                                    reason=f"drain: {drain}"[:200])
+            if reason is not None:
+                step = self._reconfigure(step, last_saved, reason,
+                                         state_intact=True, report=report)
+                last_saved = step
+                last_polled = None
+                drain_flushed = False
+                continue
+            # ---- one optimizer step
+            try:
+                self.session.state, _metrics = self.session.step_fn(
+                    self.session.state, *self._batch_fn(self.session, step)
+                )
+                step += 1
+                failures = 0
+                failures_at = None
+            except KeyboardInterrupt:
+                raise
+            except Exception as e:  # noqa: BLE001 - a collective dying
+                # under us IS the unplanned membership signal: the
+                # in-flight state is suspect, so resume from the last
+                # durable checkpoint (<= one interval of loss)
+                if failures_at == step:
+                    failures += 1
+                else:
+                    failures, failures_at = 1, step
+                if failures >= policy.max_consecutive_failures:
+                    raise ElasticError(
+                        f"step {step} failed {failures} times with no "
+                        f"progress between resumes; giving up: {e}"
+                    ) from e
+                step = self._reconfigure(
+                    step, last_saved,
+                    f"step failure: {type(e).__name__}: {e}"[:200],
+                    state_intact=False, report=report,
+                )
+                last_saved = step
+                last_polled = None
+                drain_flushed = False
+                continue
+            # ---- periodic durability
+            if step - last_saved >= policy.checkpoint_every \
+                    or step >= total_steps:
+                if self._save(step, wait=step >= total_steps):
+                    last_saved = step
+                    drain_flushed = False
+        report["final_step"] = step
+        report["world"] = self._world_size()
+        report["generation"] = self.generation
+        return report

@@ -54,6 +54,22 @@ BREAKER_OPEN = "breaker-open"
 BREAKER_HALF_OPEN = "breaker-half-open"
 BREAKER_CLOSE = "breaker-close"
 DEGRADED_HOLD = "degraded-hold"  # breaker open: observing, not healing
+# The job-facing contract (parallel/elastic.py): the training job
+# acknowledges membership changes through job-ack.json and the
+# supervisor folds those acknowledgements into the ledger, so MTTR for
+# a *training job* (notice -> training resumed at the new world size)
+# is attributable from the same flight record as the fleet's own MTTR.
+JOB_NOTIFIED = "job-notified"  # trainer saw the membership change
+JOB_RESUMED = "job-resumed"  # trainer is stepping again (new world)
+DEGRADED_ACK = "degraded-ack"  # trainer continues WITHOUT these slices
+HEAL_SUPPRESSED = "heal-suppressed"  # heal skipped: the job owns the loss
+
+# Slice states the membership fold reasons about — mirrors
+# provision/heal.py's vocabulary (imported lazily there to avoid the
+# module cycle; tests pin the two stay in sync).
+_HEALTHY = "healthy"
+_DRAINING = "draining"
+_LOST = ("missing", "unready")
 
 
 class EventLedgerError(RuntimeError):
@@ -166,6 +182,23 @@ class LedgerView:
     heals_failed: int = 0
     rate_limited: int = 0
     held_ticks: int = 0  # degraded-hold observations
+    heals_suppressed: int = 0  # skipped: trainer acked the loss
+    # Monotonic membership generation: bumped whenever a slice LEAVES
+    # the serving set (healthy/draining -> missing/unready) or RETURNS
+    # to it (missing/unready -> healthy, i.e. a heal landed — replaced
+    # hosts, so the job must re-form even though the verdict is green).
+    # healthy -> draining is a notice, not yet a loss, so it does not
+    # bump; the trainer reads the draining list for its checkpoint
+    # window instead. This is what parallel/elastic.py keys resume on.
+    membership_generation: int = 1
+    # the training job's last acknowledged phase (job-ack.json fold)
+    job_phase: str = ""  # "" / "notified" / "resumed" / "degraded"
+    job_generation: int | None = None
+    job_step: int | None = None
+    job_notified_ts: float | None = None
+    job_resumed_ts: float | None = None
+    job_mttr_samples: list = dataclasses.field(default_factory=list)
+    acked_degraded: set = dataclasses.field(default_factory=set)
     breaker_state: str = "closed"
     breaker_since: float | None = None
     breaker_reopen_at: float | None = None
@@ -180,6 +213,25 @@ class LedgerView:
 
     def slice_view(self, index: int) -> SliceView:
         return self.slices.setdefault(int(index), SliceView(int(index)))
+
+
+def _note_state(view: LedgerView, sv: SliceView, new_state: str) -> None:
+    """Assign one slice observation, bumping the membership generation on
+    serving-set transitions. ONE helper shared by the TICK and VERDICT
+    folds — TICK lands first in the ledger, so if the two disagreed the
+    generation could skip or double-count a transition."""
+    prev = sv.state
+    if prev != new_state:
+        left = prev in (_HEALTHY, _DRAINING) and new_state in _LOST
+        returned = prev in _LOST and new_state == _HEALTHY
+        if left or returned:
+            view.membership_generation += 1
+        if new_state == _HEALTHY:
+            # a slice back in service clears any degraded-continuation
+            # acknowledgement: the trainer should fold it back in on its
+            # next generation-bump resume, and heal is fair game again
+            view.acked_degraded.discard(sv.index)
+    sv.state = new_state
 
 
 def apply(view: LedgerView, record: dict) -> LedgerView:
@@ -198,10 +250,10 @@ def apply(view: LedgerView, record: dict) -> LedgerView:
     elif kind == TICK:
         view.ticks += 1
         for index, state in (record.get("states") or {}).items():
-            view.slice_view(int(index)).state = state
+            _note_state(view, view.slice_view(int(index)), state)
     elif kind == VERDICT:
         sv = view.slice_view(record.get("slice", -1))
-        sv.state = record.get("state", "unknown")
+        _note_state(view, sv, record.get("state", "unknown"))
         sv.detail = record.get("detail", "")
         sv.since = ts
         sv.streak = record.get("streak", 0)
@@ -230,6 +282,26 @@ def apply(view: LedgerView, record: dict) -> LedgerView:
         view.rate_limited += 1
     elif kind == DEGRADED_HOLD:
         view.held_ticks += 1
+    elif kind == JOB_NOTIFIED:
+        view.job_phase = "notified"
+        view.job_generation = record.get("generation")
+        view.job_step = record.get("step")
+        view.job_notified_ts = ts
+    elif kind == JOB_RESUMED:
+        view.job_phase = "degraded" if record.get("degraded") else "resumed"
+        view.job_generation = record.get("generation")
+        view.job_step = record.get("step")
+        view.job_resumed_ts = ts
+        if record.get("mttr_s") is not None:
+            view.job_mttr_samples.append(record["mttr_s"])
+    elif kind == DEGRADED_ACK:
+        view.job_phase = "degraded"
+        view.job_generation = record.get("generation")
+        view.job_step = record.get("step")
+        for index in record.get("slices", []):
+            view.acked_degraded.add(int(index))
+    elif kind == HEAL_SUPPRESSED:
+        view.heals_suppressed += 1
     elif kind == BREAKER_OPEN:
         view.breaker_state = "open"
         view.breaker_since = ts
@@ -277,6 +349,11 @@ def fleet_status(view: LedgerView, now: float, pid: int | None = None) -> dict:
     else:
         verdict = "healthy"
     mttr = view.mttr_samples
+    job_mttr = view.job_mttr_samples
+    draining = sorted(
+        sv.index for sv in view.slices.values()
+        if sv.state == heal_mod.DRAINING
+    )
     return {
         "v": SCHEMA_VERSION,
         "updated": now,
@@ -304,12 +381,36 @@ def fleet_status(view: LedgerView, now: float, pid: int | None = None) -> dict:
             for sv in sorted(view.slices.values(), key=lambda s: s.index)
         },
         "degraded": degraded,
+        # The job-facing membership contract (parallel/elastic.py
+        # FileHealthSource): a monotonic generation the trainer keys
+        # resume on, and heal_in_progress so it WAITS for the supervisor
+        # instead of thrash-restarting into a half-healed fleet.
+        "membership": {
+            "generation": view.membership_generation,
+            "heal_in_progress": bool(view.open_heals),
+            "draining": draining,
+        },
+        "job": {
+            "phase": view.job_phase or None,
+            "generation": view.job_generation,
+            "step": view.job_step,
+            "notified": view.job_notified_ts,
+            "resumed": view.job_resumed_ts,
+            "acked_degraded": sorted(view.acked_degraded),
+            "mttr_s": {
+                "count": len(job_mttr),
+                "mean": (round(sum(job_mttr) / len(job_mttr), 3)
+                         if job_mttr else None),
+                "last": job_mttr[-1] if job_mttr else None,
+            },
+        },
         "heals": {
             "attempted": view.heals_attempted,
             "succeeded": view.heals_succeeded,
             "failed": view.heals_failed,
             "rate_limited": view.rate_limited,
             "held_ticks": view.held_ticks,
+            "suppressed": view.heals_suppressed,
             "in_flight": len(view.open_heals),
         },
         "mttr_s": {

@@ -195,6 +195,15 @@ class RunPaths:
         return self.root / "fleet-status.json"
 
     @property
+    def job_ack(self) -> Path:
+        # the training job's half of the membership contract
+        # (parallel/elastic.py JobAck): atomically rewritten by the
+        # trainer on notify/resume/degraded-continuation; the supervisor
+        # folds phase transitions into the event ledger (job-notified /
+        # job-resumed / degraded-ack) for MTTR attribution
+        return self.root / "job-ack.json"
+
+    @property
     def supervisor_pid(self) -> Path:
         # the running supervisor's pid lockfile — one resident reconcile
         # loop per workdir, and what teardown signals to stop it

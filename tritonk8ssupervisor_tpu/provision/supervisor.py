@@ -46,10 +46,12 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import json
 import os
 import random
 import signal
 import time
+from pathlib import Path
 from typing import Callable
 
 from tritonk8ssupervisor_tpu.config.schema import ClusterConfig, ConfigError
@@ -181,6 +183,77 @@ class CircuitBreaker:
         self.reopen_at = None
         self.cooldown.reset()
         return closed_it
+
+
+# ---------------------------------------------------------- job-ack watcher
+
+
+class JobAckWatcher:
+    """The supervisor's read side of the job<->supervisor contract.
+
+    An elastic training job (parallel/elastic.py) acknowledges membership
+    events by atomically rewriting job-ack.json: phase `notified` when it
+    saw a generation bump or drain notice, `resumed` when it is stepping
+    again, `degraded` when it gave up waiting and continues WITHOUT some
+    slices. `observe()` folds phase transitions into the event ledger
+    (job-notified / job-resumed / degraded-ack) exactly once — dedup is
+    against the folded LedgerView, so a restarted supervisor does not
+    re-record an acknowledgement it already ledgered. A missing or torn
+    ack file is "no news", never an error: the job may simply not be an
+    elastic one."""
+
+    def __init__(self, path) -> None:
+        self.path = Path(path)
+
+    def read(self) -> dict | None:
+        try:
+            doc = json.loads(self.path.read_text())
+        except (OSError, ValueError):
+            return None  # absent or torn: unknown, retry next tick
+        return doc if isinstance(doc, dict) else None
+
+    def observe(
+        self,
+        view: "events_mod.LedgerView",
+        record: Callable[..., dict],
+        now: float,
+        say: Callable[[str], None] = lambda line: None,
+    ) -> str | None:
+        """Fold the current ack (if new) into the ledger via `record`
+        (kind, **fields) and return the phase recorded, else None."""
+        doc = self.read()
+        if doc is None:
+            return None
+        phase = doc.get("phase")
+        if phase not in ("notified", "resumed", "degraded"):
+            return None  # heartbeat/unknown phases are not ledger events
+        gen = doc.get("generation")
+        step = doc.get("step")
+        folded = "degraded" if view.job_phase == "degraded" else view.job_phase
+        if (phase == folded and gen == view.job_generation
+                and step == view.job_step):
+            return None  # already on the ledger
+        if phase == "notified":
+            record(events_mod.JOB_NOTIFIED, generation=gen, step=step,
+                   reason=str(doc.get("reason", ""))[:200])
+            say(f"  job acknowledged membership change "
+                f"(generation {gen}, step {step})")
+            return phase
+        mttr = (round(now - view.job_notified_ts, 3)
+                if view.job_notified_ts is not None else None)
+        slices = sorted(int(i) for i in doc.get("slices") or [])
+        if phase == "degraded" and slices:
+            record(events_mod.DEGRADED_ACK, slices=slices,
+                   generation=gen, step=step)
+            say(f"  job continues DEGRADED without slice(s) "
+                f"{', '.join(str(i) for i in slices)}; suppressing heal "
+                "for them until they read healthy again")
+        record(events_mod.JOB_RESUMED, generation=gen, step=step,
+               world=doc.get("world"), degraded=phase == "degraded",
+               mttr_s=mttr)
+        say(f"  job resumed training (generation {gen}, step {step}"
+            + (f", job MTTR {mttr:.0f}s" if mttr is not None else "") + ")")
+        return phase
 
 
 # -------------------------------------------------------------- flap filter
@@ -326,6 +399,8 @@ class Supervisor:
         self._last_states: dict[int, str] = {}
         self._incidents: dict[int, float] = {}  # slice -> first-bad ts
         self._view = events_mod.LedgerView()  # folded history (restored)
+        self.job_ack = JobAckWatcher(paths.job_ack)
+        self._suppress_logged: set = set()  # slices with a ledgered skip
 
     # ----------------------------------------------------------- plumbing
 
@@ -421,10 +496,36 @@ class Supervisor:
             # observation, closed by a heal-done or a healthy observation
             if s.state == heal_mod.HEALTHY:
                 self._incidents.pop(s.index, None)
+                self._suppress_logged.discard(s.index)
             else:
                 self._incidents.setdefault(s.index, now)
 
+        # the training job's acknowledgement file, folded into the ledger
+        # BEFORE the heal decision so a fresh degraded-continuation ack
+        # suppresses this very tick's heal
+        self.job_ack.observe(self._view, self._record, now, say=self.say)
+
         eligible = self.flaps.observe(health)
+        if self._view.acked_degraded:
+            # the trainer already absorbed these losses as degraded
+            # continuation (past its wait budget): healing them now would
+            # fight the running job — a replaced slice bumps the
+            # membership generation and forces ANOTHER resume. Leave them
+            # quarantined until an operator heals by hand or the trainer
+            # folds them back in.
+            suppressed = [i for i in eligible
+                          if i in self._view.acked_degraded]
+            for i in suppressed:
+                if i not in self._suppress_logged:
+                    self._record(events_mod.HEAL_SUPPRESSED, slice=i)
+                    self.say(
+                        f"  slice {i}: heal suppressed — the job continues "
+                        "degraded without it (degraded-ack on the ledger); "
+                        "run `./setup.sh heal` to repair it by hand"
+                    )
+                    self._suppress_logged.add(i)
+            eligible = [i for i in eligible
+                        if i not in self._view.acked_degraded]
         summary = {
             "tick": self.ticks, "ts": now, "states": states,
             "eligible": list(eligible), "healed": [], "held": False,

@@ -97,6 +97,7 @@ def clean(
     # an interrupted clean would want preserved.
     journal_mod.Journal(paths.journal).scrub()
     paths.fleet_status.unlink(missing_ok=True)
+    paths.job_ack.unlink(missing_ok=True)
     events_mod.EventLedger(paths.events).scrub()
     prompter.say("Clean. Re-run ./setup.sh to provision again.")
     return True
