@@ -98,6 +98,12 @@ SIM_SECONDS = {
     # crash_resume.mttr_wall_s) — the baseline the supervisor's
     # unattended MTTR is judged against.
     "heal-slice": 120.0,
+    # Fleet-scale tick cost model (--fleetscale): one windowed `tpu-vm
+    # list` page (~1 s of gcloud startup + API latency) and one SSH
+    # probe/drain check round-trip. The supervisor's per-tick cost is
+    # ops x these, which is what the dirty-set reconcile bounds.
+    "fleet-list-page": 1.0,
+    "ssh-probe": 0.2,
 }
 
 
@@ -682,7 +688,8 @@ class SuperviseSim:
 
 def _supervise_run(world, policy, ticks, readiness_timeout=60.0):
     """Drive the supervisor as the virtual clock's single actor and
-    return the replayed event ledger."""
+    return the replayed event ledger. The clock doubles as the actor
+    hooks so parallel heal waves stay deterministic."""
     from tritonk8ssupervisor_tpu.provision import events as events_mod
     from tritonk8ssupervisor_tpu.provision import supervisor as sup_mod
 
@@ -694,6 +701,7 @@ def _supervise_run(world, policy, ticks, readiness_timeout=60.0):
         run=world.run, run_quiet=world.run_quiet, policy=policy,
         ledger=ledger, clock=world.clock.time, sleep=world.clock.sleep,
         rng=lambda: 0.0, readiness_timeout=readiness_timeout,
+        hooks=world.clock,
     )
     world.clock.begin()
     try:
@@ -1042,12 +1050,239 @@ def run_elastic_benchmark(num_slices: int = 4) -> dict:
     }
 
 
+# ------------------------------------------------------ fleetscale drills
+
+
+class FleetScaleSim(SuperviseSim):
+    """SuperviseSim with per-operation counters (fleet listings, SSH
+    probes) and a lock around the shared mutable state — parallel heal
+    workers drive run/run_quiet from several threads at once."""
+
+    def __init__(self, root, clock, num_slices=256, heal_works=True):
+        import threading
+
+        super().__init__(root, clock, num_slices=num_slices,
+                         heal_works=heal_works)
+        self.ops = {"list": 0, "ssh": 0}
+        self._op_lock = threading.Lock()
+
+    def _sync(self):
+        with self._op_lock:
+            super()._sync()
+
+    def run_quiet(self, args, cwd=None, **kwargs):
+        with self._op_lock:
+            if args and args[0] == "gcloud" and "list" in list(args):
+                self.ops["list"] += 1
+            elif args and args[0] == "ssh":
+                self.ops["ssh"] += 1
+        return super().run_quiet(args, cwd=cwd, **kwargs)
+
+
+def _tick_cost(ops: dict) -> float:
+    return round(ops.get("list", 0) * SIM_SECONDS["fleet-list-page"]
+                 + ops.get("ssh", 0) * SIM_SECONDS["ssh-probe"], 3)
+
+
+def run_fleetscale_tick_drill(
+    num_slices: int,
+    ticks: int = 8,
+    interval: float = 30.0,
+    workdir: Path | None = None,
+) -> dict:
+    """Steady-state supervisor tick cost at `num_slices` on a healthy
+    fleet: per-tick operation counts (windowed listing pages + SSH/drain
+    probes) priced by the SIM_SECONDS model. The first tick diagnoses
+    everything (never-observed slices are all dirty); steady ticks pay
+    for the page refetches plus the `sweep_slices` rotation only — the
+    number that must stay sublinear in N. Wall times of the real
+    `tick()` call are sampled too (the tier-1 smoke pins the 256-slice
+    tick under one reconcile interval)."""
+    import time as wall_time
+
+    from tritonk8ssupervisor_tpu.provision import events as events_mod
+    from tritonk8ssupervisor_tpu.provision import supervisor as sup_mod
+
+    own_tmp = workdir is None
+    root = Path(workdir) if workdir is not None else Path(
+        tempfile.mkdtemp(prefix="tk8s-fleetscale-tick-")
+    )
+    try:
+        clock = SimClock()
+        world = FleetScaleSim(root, clock, num_slices=num_slices)
+        policy = sup_mod.SupervisePolicy(interval=interval)
+        ledger = events_mod.EventLedger(
+            world.paths.events, clock=clock.time, echo=lambda line: None
+        )
+        supervisor = sup_mod.Supervisor(
+            world.config, world.paths, _Say(),
+            run=world.run, run_quiet=world.run_quiet, policy=policy,
+            ledger=ledger, clock=clock.time, sleep=clock.sleep,
+            rng=lambda: 0.0, readiness_timeout=60.0, hooks=clock,
+        )
+        per_tick: list = []
+        walls: list = []
+        clock.begin()
+        try:
+            supervisor.restore()
+            for _ in range(ticks):
+                before = dict(world.ops)
+                w0 = wall_time.perf_counter()
+                supervisor.tick()
+                walls.append(wall_time.perf_counter() - w0)
+                per_tick.append({k: world.ops[k] - before[k]
+                                 for k in world.ops})
+                clock.sleep(interval)
+        finally:
+            clock.release()
+        steady = per_tick[2:]
+        steady_costs = [_tick_cost(ops) for ops in steady]
+        return {
+            "num_slices": num_slices,
+            "interval_s": interval,
+            "pages": supervisor.snapshot.page_count,
+            "sweep_slices": policy.sweep_slices,
+            "first_tick_ops": per_tick[0],
+            "first_tick_cost_s": _tick_cost(per_tick[0]),
+            "steady_ops_per_tick": steady[-1],
+            "steady_tick_cost_s": round(
+                sum(steady_costs) / len(steady_costs), 3
+            ),
+            "wall_tick_s_max": round(max(walls), 4),
+        }
+    finally:
+        if own_tmp:
+            shutil.rmtree(root, ignore_errors=True)
+
+
+def run_fleetscale_outage_drill(
+    num_slices: int = 256,
+    lost: int = 32,
+    heal_workers: int = 8,
+    preempt_at: float = 300.0,
+    workdir: Path | None = None,
+) -> dict:
+    """A zone outage at fleet scale: `lost` slices preempted at once.
+    The dirty-set reconcile detects them via the changed listing pages,
+    the flap filter confirms on the next tick, and the supervisor
+    dispatches `lost` INDEPENDENT slice-scoped heals in waves of
+    `heal_workers` — the heal makespan (first heal-start to last
+    heal-done on the ledger) must be ceil(lost/workers) heal times, not
+    `lost` serial ones."""
+    from tritonk8ssupervisor_tpu.provision import events as events_mod
+    from tritonk8ssupervisor_tpu.provision import supervisor as sup_mod
+
+    own_tmp = workdir is None
+    root = Path(workdir) if workdir is not None else Path(
+        tempfile.mkdtemp(prefix="tk8s-fleetscale-outage-")
+    )
+    try:
+        clock = SimClock()
+        world = FleetScaleSim(root, clock, num_slices=num_slices)
+        zone = list(range(lost))  # one failure domain: slices 0..lost-1
+        for i in zone:
+            world.preempt(i, at=preempt_at)
+        policy = sup_mod.SupervisePolicy(
+            interval=30.0, flap_threshold=2, heal_burst=2,
+            heal_refill_s=3600.0, heal_workers=heal_workers,
+        )
+        records = _supervise_run(world, policy, ticks=16,
+                                 readiness_timeout=60.0)
+        starts = [r for r in records
+                  if r["kind"] == events_mod.HEAL_START]
+        dones = [r for r in records if r["kind"] == events_mod.HEAL_DONE]
+        status = json.loads(world.paths.fleet_status.read_text())
+        makespan = (max(r["ts"] for r in dones)
+                    - min(r["ts"] for r in starts)) if dones else None
+        single_heal = SIM_SECONDS["heal-slice"]
+        serial_makespan = lost * single_heal
+        healed = sorted(i for r in dones for i in r["slices"])
+        return {
+            "num_slices": num_slices,
+            "lost_slices": lost,
+            "heal_workers": heal_workers,
+            "preempt_at_s": preempt_at,
+            "heals_attempted": len(starts),
+            "heals_succeeded": len(dones),
+            "scoped_per_slice": all(
+                len(r["slices"]) == 1 for r in starts
+            ),
+            "all_healed": healed == zone,
+            "heal_makespan_s": makespan,
+            "single_heal_s": single_heal,
+            "makespan_over_single_heal": (
+                round(makespan / single_heal, 3)
+                if makespan is not None else None
+            ),
+            "serial_makespan_s": serial_makespan,
+            "parallel_speedup_x": (
+                round(serial_makespan / makespan, 2)
+                if makespan else None
+            ),
+            "unattended_mttr_s": (
+                max(r["ts"] for r in dones) - preempt_at if dones else None
+            ),
+            "end_verdict": status["verdict"],
+            "slice_states": status.get("slice_states", {}),
+        }
+    finally:
+        if own_tmp:
+            shutil.rmtree(root, ignore_errors=True)
+
+
+def run_fleetscale_benchmark(
+    slice_counts: tuple = (4, 64, 256),
+    outage_slices: int = 256,
+    outage_lost: int = 32,
+) -> dict:
+    """The fleet-scale acceptance datapoint, one BENCH-style JSON
+    document: supervisor tick cost vs N (sublinear — the 256-slice
+    steady tick within 4x the 4-slice tick via the dirty-set reconcile
+    and windowed listing pages) and the zone-outage heal makespan
+    (parallel slice-scoped heals: <= 4x one heal for 32 lost slices at
+    8 workers, vs 32x serial)."""
+    ticks = {str(n): run_fleetscale_tick_drill(n) for n in slice_counts}
+    outage = run_fleetscale_outage_drill(num_slices=outage_slices,
+                                         lost=outage_lost)
+    small = ticks[str(min(slice_counts))]["steady_tick_cost_s"]
+    big = ticks[str(max(slice_counts))]["steady_tick_cost_s"]
+    ratio = round(big / small, 3) if small else None
+    fleet_growth = max(slice_counts) / min(slice_counts)
+    passes = bool(
+        ratio is not None and ratio <= 4.0
+        and outage["all_healed"]
+        and outage["scoped_per_slice"]
+        and outage["heal_makespan_s"] is not None
+        and outage["heal_makespan_s"]
+        <= 4.0 * outage["single_heal_s"] + 1e-6
+        and outage["end_verdict"] == "healthy"
+        and ticks[str(max(slice_counts))]["steady_tick_cost_s"]
+        <= ticks[str(max(slice_counts))]["interval_s"]
+    )
+    return {
+        "benchmark": "provision_fleetscale",
+        "metric": "steady_tick_cost_ratio_256_over_4",
+        "unit": "x (modeled steady-state supervisor tick cost at 256 "
+                "slices over 4 slices; 64x the fleet must cost <= 4x "
+                "the tick — sublinear via dirty-set reconcile + paged "
+                "listings)",
+        "model_seconds": dict(SIM_SECONDS),
+        "value": ratio,
+        "fleet_growth_x": fleet_growth,
+        "ticks": ticks,
+        "outage": outage,
+        "passes": passes,
+    }
+
+
 # ------------------------------------------------------ the regression gate
 
 
 DEFAULT_BASELINE = Path(__file__).resolve().parent / "BENCH_provision.json"
 SUPERVISE_BASELINE = Path(__file__).resolve().parent / "BENCH_supervise.json"
 ELASTIC_BASELINE = Path(__file__).resolve().parent / "BENCH_elastic.json"
+FLEETSCALE_BASELINE = (Path(__file__).resolve().parent
+                       / "BENCH_fleetscale.json")
 
 
 def run_check(
@@ -1055,17 +1290,22 @@ def run_check(
     tolerance: float = 0.10,
     supervise_baseline: Path = SUPERVISE_BASELINE,
     elastic_baseline: Path = ELASTIC_BASELINE,
+    fleetscale_baseline: Path = FLEETSCALE_BASELINE,
 ) -> tuple[bool, list[str], dict]:
     """Re-simulate against the committed BENCH_provision.json,
-    BENCH_supervise.json, and BENCH_elastic.json: fail when the cold
-    (pipelined DAG) or warm makespan — or the supervisor's unattended
-    MTTR, or the elastic drill's time-to-training-resumed / steps lost
-    — regressed more than `tolerance`, or when a drill no longer meets
-    its structural budget (MTTR beats manual + one interval; steps lost
-    within one checkpoint interval). The gate that keeps a DAG-edge,
-    cache, reconcile-loop, or elastic-resume regression from landing
-    silently. Improvements always pass; the committed files are only
-    rewritten by explicit `--out` runs."""
+    BENCH_supervise.json, BENCH_elastic.json, and BENCH_fleetscale.json:
+    fail when the cold (pipelined DAG) or warm makespan — or the
+    supervisor's unattended MTTR, or the elastic drill's
+    time-to-training-resumed / steps lost, or the fleet-scale steady
+    tick cost / zone-outage heal makespan — regressed more than
+    `tolerance`, or when a drill no longer meets its structural budget
+    (MTTR beats manual + one interval; steps lost within one checkpoint
+    interval; 256-slice tick within 4x the 4-slice tick — superlinear
+    tick growth fails here; 32-slice outage healed within 4x one heal).
+    The gate that keeps a DAG-edge, cache, reconcile-loop, or
+    elastic-resume regression from landing silently. Improvements always
+    pass; the committed files are only rewritten by explicit `--out`
+    runs."""
     baseline = Path(baseline)
     if not baseline.exists():
         return False, [f"baseline {baseline} missing"], {}
@@ -1131,6 +1371,32 @@ def run_check(
                 "checkpoint interval, resume within budget, "
                 "job-notified/job-resumed on the ledger)"
             )
+
+    fleetscale_baseline = Path(fleetscale_baseline)
+    if not fleetscale_baseline.exists():
+        problems.append(f"baseline {fleetscale_baseline} missing "
+                        "(fleetscale)")
+    else:
+        committed_fs = json.loads(fleetscale_baseline.read_text())
+        current_fs = run_fleetscale_benchmark()
+        current["fleetscale"] = current_fs
+        big = str(max(int(n) for n in current_fs["ticks"]))
+        compare(
+            f"{big}-slice steady tick cost",
+            committed_fs.get("ticks", {}).get(big, {}).get(
+                "steady_tick_cost_s"),
+            current_fs["ticks"][big]["steady_tick_cost_s"],
+        )
+        compare("zone-outage heal makespan",
+                committed_fs.get("outage", {}).get("heal_makespan_s"),
+                current_fs["outage"]["heal_makespan_s"])
+        if not current_fs["passes"]:
+            problems.append(
+                "fleetscale drill no longer passes (steady tick cost "
+                "sublinear in N — 256-slice within 4x the 4-slice tick "
+                "and under one reconcile interval; zone outage healed "
+                "in parallel within 4x one heal)"
+            )
     return not problems, problems, current
 
 
@@ -1155,6 +1421,13 @@ def main(argv: list[str] | None = None) -> int:
                         "costs <= one checkpoint interval of steps and "
                         "training resumes within the detect+confirm+heal "
                         "budget (BENCH_elastic.json)")
+    parser.add_argument("--fleetscale", action="store_true",
+                        help="run the fleet-scale drills: steady-state "
+                        "supervisor tick cost vs N in {4, 64, 256} "
+                        "(sublinear via dirty-set reconcile + paged "
+                        "listings) and a 32-of-256 zone outage healed "
+                        "by parallel slice-scoped heals "
+                        "(BENCH_fleetscale.json)")
     parser.add_argument("--check", action="store_true",
                         help="perf-regression gate: fail if the simulated "
                         "cold/warm makespan regressed >10%% vs the "
@@ -1184,6 +1457,8 @@ def main(argv: list[str] | None = None) -> int:
         result = run_supervise_benchmark(args.slices)
     elif args.elastic:
         result = run_elastic_benchmark(args.slices)
+    elif args.fleetscale:
+        result = run_fleetscale_benchmark()
     elif args.warm:
         result = {
             "benchmark": "provision_warm",
@@ -1244,6 +1519,27 @@ def main(argv: list[str] | None = None) -> int:
             f"training {result['value']:.0f}s after the preemption "
             f"(budget {result['budget_s']:.0f}s), ledger job MTTR "
             f"{result['ledger']['job_mttr_s']}s -> "
+            f"passes={result['passes']}",
+            file=sys.stderr,
+        )
+        return 0 if result["passes"] else 1
+    if args.fleetscale:
+        outage = result["outage"]
+        ticks = result["ticks"]
+        counts = sorted(int(n) for n in ticks)
+        costs = " -> ".join(
+            f"{n}: {ticks[str(n)]['steady_tick_cost_s']:.1f}s"
+            for n in counts
+        )
+        print(
+            f"\nfleet-scale supervise (simulated): steady tick cost "
+            f"{costs} ({result['value']:.2f}x for "
+            f"{result['fleet_growth_x']:.0f}x the fleet); zone outage "
+            f"{outage['lost_slices']}/{outage['num_slices']} slices -> "
+            f"{outage['heals_succeeded']} parallel scoped heals in "
+            f"{outage['heal_makespan_s']:.0f}s "
+            f"({outage['makespan_over_single_heal']:.1f}x one heal, "
+            f"{outage['parallel_speedup_x']:.1f}x vs serial) -> "
             f"passes={result['passes']}",
             file=sys.stderr,
         )

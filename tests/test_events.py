@@ -197,16 +197,113 @@ def test_fold_job_ack_events_and_suppression():
     assert view.acked_degraded == set()
 
 
+# ------------------------------------------------------------- compaction
+
+
+def write_records(led, records):
+    for r in records:
+        fields = {k: v for k, v in r.items() if k not in ("kind", "ts")}
+        led._clock = lambda ts=r["ts"]: ts
+        led.append(r["kind"], **fields)
+
+
+def test_compact_roundtrip_preserves_resume_invariants(tmp_path):
+    """fold(compacted ledger) == fold(original ledger) for everything a
+    restart consumes: per-slice heal-start timestamps (token buckets),
+    breaker window/state/trips, counters, MTTR samples, membership
+    generation — one snapshot record instead of the whole history."""
+    led = quiet_ledger(tmp_path)
+    write_records(led, seeded_records())
+    before = ev.fold(led.replay())
+    dropped = led.compact()
+    assert dropped == len(seeded_records()) - 1
+    lines = [l for l in led.path.read_text().splitlines() if l.strip()]
+    assert len(lines) == 1
+    assert json.loads(lines[0])["kind"] == ev.SNAPSHOT
+    after = ev.fold(led.replay())
+    assert after.slices[1].heal_starts == before.slices[1].heal_starts
+    assert after.slices[0].heals_failed == before.slices[0].heals_failed
+    assert after.heals_attempted == before.heals_attempted
+    assert after.heals_succeeded == before.heals_succeeded
+    assert after.rate_limited == before.rate_limited
+    assert after.held_ticks == before.held_ticks
+    assert after.mttr_samples == before.mttr_samples
+    assert after.breaker_state == before.breaker_state == "open"
+    assert after.breaker_reopen_at == before.breaker_reopen_at
+    assert after.breaker_failures == before.breaker_failures
+    assert after.breaker_trips == before.breaker_trips
+    assert after.membership_generation == before.membership_generation
+    assert after.started == before.started
+    # the status documents agree too
+    assert (ev.fleet_status(after, 800.0)
+            == ev.fleet_status(before, 800.0))
+    # a second compact is a no-op (already one record)
+    assert led.compact() == 0
+
+
+def test_compact_preserves_crash_signature_and_job_state(tmp_path):
+    """An orphaned heal-start (kill mid-heal) and the job-ack fold both
+    survive compaction: the restarted supervisor still charges the spent
+    token and still refuses to re-record the acknowledgement."""
+    led = quiet_ledger(tmp_path)
+    write_records(led, seeded_records()[:4] + [
+        {"ts": 95.0, "kind": ev.JOB_NOTIFIED, "generation": 2, "step": 50,
+         "reason": "drill"},
+        {"ts": 96.0, "kind": ev.DEGRADED_ACK, "slices": [1],
+         "generation": 2, "step": 50},
+    ])
+    led.compact()
+    view = ev.fold(led.replay())
+    assert len(view.open_heals) == 1  # the kill-mid-heal signature
+    assert view.open_heals[0]["id"] == "h1"
+    assert view.slices[1].heal_starts == [90.0]  # token stays spent
+    assert view.acked_degraded == {1}
+    assert view.job_phase == "degraded"
+    assert view.job_generation == 2 and view.job_step == 50
+
+
+def test_compact_generation_monotonic_across_boundary(tmp_path):
+    """Records folded AFTER a compact continue the membership generation
+    from the snapshot — never a reset (the elastic trainer keys resume
+    on monotonicity)."""
+    led = quiet_ledger(tmp_path)
+    write_records(led, seeded_records())
+    generation = ev.fold(led.replay()).membership_generation
+    led.compact()
+    # slice 0 (unready) comes back: a serving-set RETURN, one more bump
+    led._clock = lambda: 900.0
+    led.append(ev.VERDICT, slice=0, state="healthy", detail="")
+    after = ev.fold(led.replay())
+    assert after.membership_generation == generation + 1
+    # and no temp residue from the atomic rewrite
+    assert [p.name for p in led.path.parent.iterdir()] == [led.path.name]
+
+
+def test_compact_empty_and_single_record_noop(tmp_path):
+    led = quiet_ledger(tmp_path)
+    assert led.compact() == 0  # no ledger at all
+    led.append(ev.SUPERVISOR_START, pid=1)
+    assert led.compact() == 0  # nothing to fold away
+
+
 # ----------------------------------------------------------- fleet status
 
 
 def test_fleet_status_document_shape():
+    """The status document stays BOUNDED at fleet scale: per-state
+    counts for everyone, per-slice detail only for the not-healthy
+    slices (what a FileHealthSource parses every step boundary);
+    `all_slices=True` — `status --json --all` — is the full dump."""
     doc = ev.fleet_status(ev.fold(seeded_records()), now=800.0, pid=7)
     assert doc["supervisor"]["running"] is True
     assert doc["supervisor"]["uptime_s"] == 800.0
     assert doc["verdict"] == "degraded-hold"  # breaker open
-    assert doc["slices"]["1"]["state"] == "healthy"
-    assert doc["slices"]["1"]["heals_succeeded"] == 1
+    assert doc["slices_total"] == 2
+    assert doc["slice_states"] == {"healthy": 1, "unready": 1}
+    # healthy slice 1 is summarised in the counts, not dumped per-slice
+    assert "1" not in doc["slices"]
+    assert doc["slices"]["0"]["state"] == "unready"
+    assert doc["slices"]["0"]["detail"] == "10.0.0.1 (rc 255)"
     assert doc["heals"] == {
         "attempted": 2, "succeeded": 1, "failed": 1,
         "rate_limited": 1, "held_ticks": 1, "suppressed": 0,
@@ -215,6 +312,30 @@ def test_fleet_status_document_shape():
     assert doc["mttr_s"]["mean"] == 180.0
     assert doc["breaker"]["state"] == "open"
     assert doc["degraded"] == [0]  # slice 0's last verdict was unready
+
+    full = ev.fleet_status(ev.fold(seeded_records()), now=800.0, pid=7,
+                           all_slices=True)
+    assert full["slices"]["1"]["state"] == "healthy"
+    assert full["slices"]["1"]["heals_succeeded"] == 1
+    assert full["slices"]["0"]["state"] == "unready"
+    assert full["slice_states"] == doc["slice_states"]
+
+
+def test_fleet_status_bounded_at_fleet_scale():
+    """256 slices, 2 broken: the default document names ONLY the broken
+    slices — the per-slice block a scraper (or the elastic trainer's
+    FileHealthSource) parses is O(incidents), never O(fleet)."""
+    records = [{"ts": 30.0, "kind": ev.TICK, "tick": 1, "states": {
+        str(i): ("missing" if i in (7, 200) else "healthy")
+        for i in range(256)
+    }}]
+    doc = ev.fleet_status(ev.fold(records), now=60.0)
+    assert doc["slices_total"] == 256
+    assert doc["slice_states"] == {"healthy": 254, "missing": 2}
+    assert sorted(doc["slices"]) == ["200", "7"]
+    assert doc["degraded"] == [7, 200]
+    dumped = json.dumps(doc)
+    assert len(dumped) < 4096  # bounded: counts + 2 details, not 256
 
 
 def test_fleet_status_healthy_and_stopped():

@@ -118,6 +118,77 @@ def test_diagnose_with_no_hosts_record_marks_all_missing(tmp_path):
     assert [s.state for s in health.slices] == ["missing"] * 3
 
 
+def test_diagnose_only_slices_scopes_the_expensive_probes(tmp_path):
+    """Fleet-scale contract: `only_slices` restricts the per-host SSH +
+    drain probing (and the returned FleetHealth) to that subset — the
+    supervisor's dirty-set reconcile diagnoses changed slices, never the
+    whole fleet per tick. The batched listing still covers everyone
+    (it is the cheap change detector)."""
+    paths, _ = seed_world(tmp_path)
+    ssh_asked = []
+    base = scripted_quiet(
+        ssh_fail={"10.0.1.1"},
+        drains={"10.0.2.1": "maintenance-event: TERMINATE"},
+    )
+
+    def quiet(args, cwd=None, **kwargs):
+        if args and args[0] == "ssh":
+            ssh_asked.append(args[-2])
+        return base(args, cwd=cwd, **kwargs)
+
+    health = heal_mod.diagnose(cfg(), paths, run_quiet=quiet,
+                               only_slices=[1])
+    assert [s.index for s in health.slices] == [1]
+    assert health.slices[0].state == "unready"
+    assert "10.0.1.1" in health.slices[0].detail
+    # only slice 1's host was ever sshed — 0 and 2 paid nothing
+    assert set(ssh_asked) == {"10.0.1.1"}
+    assert health.degraded == [1]
+
+    # the scoped view still sees drains for a drained member of the set
+    ssh_asked.clear()
+    health = heal_mod.diagnose(cfg(), paths, run_quiet=quiet,
+                               only_slices=[0, 2])
+    assert [s.state for s in health.slices] == ["healthy", "draining"]
+    assert set(ssh_asked) == {"10.0.0.1", "10.0.2.1"}
+    # out-of-range indices are dropped, not crashed on
+    assert heal_mod.diagnose(cfg(), paths, run_quiet=quiet,
+                             only_slices=[99]).slices == []
+
+
+def test_slice_ssh_verdicts_shared_bounded_pool(monkeypatch):
+    """Satellite pin: the per-slice SSH verdicts ride ONE bounded pool
+    (TK8S_PROBE_WORKERS) across every probed host — never a
+    thread-per-host fan-out — and the verdict still names EVERY unready
+    host of a slice."""
+    import threading
+
+    monkeypatch.setenv("TK8S_PROBE_WORKERS", "2")
+    live = {"now": 0, "peak": 0}
+    lock = threading.Lock()
+
+    def quiet(args, cwd=None, **kwargs):
+        with lock:
+            live["now"] += 1
+            live["peak"] = max(live["peak"], live["now"])
+        try:
+            ip = args[-2]
+            if ip.endswith(".bad"):
+                raise run_mod.CommandError(args, 255)
+            return ""
+        finally:
+            with lock:
+                live["now"] -= 1
+
+    host_ips = [[f"10.{i}.0.bad", f"10.{i}.1.ok"] for i in range(8)]
+    verdicts = readiness.slice_ssh_verdicts(host_ips, run_quiet=quiet)
+    assert live["peak"] <= 2  # the TK8S_PROBE_WORKERS bound held
+    assert set(verdicts) == set(range(8))
+    for i in range(8):
+        assert verdicts[i].startswith("1/2 host(s) ssh not ready")
+        assert f"10.{i}.0.bad (rc 255)" in verdicts[i]
+
+
 # ------------------------------------------------------------------- heal
 
 

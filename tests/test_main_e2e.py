@@ -353,16 +353,22 @@ def test_cli_supervise_one_tick_smoke_and_status(fake_world, capsys):
     assert "tick" in kinds and "supervisor-stop" in kinds
     status = json.loads(paths.fleet_status.read_text())
     assert status["verdict"] == "healthy"
-    assert status["slices"]["0"]["state"] == "healthy"
+    # bounded status: healthy slices live in the counts, not the detail
+    assert status["slice_states"] == {"healthy": 1}
+    assert status["slices"] == {}
     # the pid lock was released on clean exit
     assert not paths.supervisor_pid.exists()
 
     assert main(["status", "--workdir", str(work)]) == 0
     out = capsys.readouterr().out
-    assert "fleet: healthy" in out and "slice 0: healthy" in out
+    assert "fleet: healthy" in out and "1 healthy (of 1)" in out
     assert main(["status", "--json", "--workdir", str(work)]) == 0
     doc = json.loads(capsys.readouterr().out)
     assert doc["verdict"] == "healthy"
+    # --json --all folds the ledger into the FULL per-slice dump
+    assert main(["status", "--json", "--all", "--workdir", str(work)]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["slices"]["0"]["state"] == "healthy"
 
 
 def test_cli_supervise_heals_lost_slice_unattended(fake_world, capsys):

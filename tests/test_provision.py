@@ -597,6 +597,88 @@ def test_fleet_snapshot_failed_fetch_is_not_cached():
     assert snap.states() == {"n-0": "READY"}  # retried, not poisoned
 
 
+def test_fleet_snapshot_paged_fetch_bounded_calls():
+    """Fleet-scale satellite: with page_size set, the listing arrives in
+    bounded name-filtered windows — ceil(N/page) list calls, each
+    carrying only its page's node names, merged into one fleet view."""
+    config = cfg(num_slices=10)
+    calls = []
+
+    def quiet(args, cwd=None, **kwargs):
+        calls.append(list(args))
+        # the fake answers for the WHOLE fleet; the snapshot must keep
+        # only the page's names (a real filtered call returns just them)
+        return "\n".join(f"{config.node_prefix}-{i}\tREADY"
+                         for i in range(10))
+
+    clock = {"t": 0.0}
+    snap = readiness.FleetSnapshot(config, run_quiet=quiet, ttl=10.0,
+                                   clock=lambda: clock["t"], page_size=4)
+    assert snap.page_count == 3  # ceil(10/4)
+    states = snap.states()
+    assert len(calls) == 3 and snap.fetches == 3
+    assert states == {f"{config.node_prefix}-{i}": "READY"
+                      for i in range(10)}
+    # each call is windowed: a name filter + matching page size
+    filters = [a for call in calls for a in call
+               if str(a).startswith("--filter=name:(")]
+    assert len(filters) == 3
+    assert f"{config.node_prefix}-0" in filters[0]
+    assert f"{config.node_prefix}-9" in filters[2]
+    # within the TTL nothing refetches; past it, every page does
+    snap.states()
+    assert len(calls) == 3
+    clock["t"] = 11.0
+    snap.states()
+    assert len(calls) == 6
+
+
+def test_fleet_snapshot_quota_throttle_serves_stale_and_backs_off():
+    """A page fetch failing with a 429/RESOURCE_EXHAUSTED throttle parks
+    that page behind the retry classifier's quota floor and serves the
+    last good copy STALE — a 256-slice fleet never hammers a throttling
+    API — then refetches once the floor lapses."""
+    from tritonk8ssupervisor_tpu.provision import retry
+
+    config = cfg(num_slices=2)
+    state = {"throttle": False}
+    calls = []
+
+    def quiet(args, cwd=None, **kwargs):
+        calls.append(list(args))
+        if state["throttle"]:
+            raise run_mod.CommandError(
+                args, 1, tail="ERROR: 429 Too Many Requests"
+            )
+        return f"{config.node_prefix}-0\tREADY\n{config.node_prefix}-1\tREADY"
+
+    clock = {"t": 0.0}
+    snap = readiness.FleetSnapshot(config, run_quiet=quiet, ttl=5.0,
+                                   clock=lambda: clock["t"], page_size=2)
+    assert snap.states()[f"{config.node_prefix}-0"] == "READY"
+    assert snap.fetches == 1
+
+    state["throttle"] = True
+    clock["t"] = 6.0  # TTL lapsed: refetch attempt throttles
+    states = snap.states()
+    assert states[f"{config.node_prefix}-0"] == "READY"  # stale copy
+    assert snap.fetch_errors == 1 and snap.served_stale == 1
+    assert "429" in snap.last_error
+    # inside the quota floor: no further API calls, stale again
+    clock["t"] = 12.0
+    before = len(calls)
+    snap.states()
+    assert len(calls) == before  # backed off, did NOT hammer
+    assert snap.served_stale == 2
+    assert snap.staleness() >= 6.0  # staleness is tracked, not hidden
+    # past the floor (>= QUOTA_BACKOFF_FLOOR after the failure): refetch
+    state["throttle"] = False
+    clock["t"] = 6.0 + retry.QUOTA_BACKOFF_FLOOR + 1.0
+    snap.states()
+    assert len(calls) == before + 1
+    assert snap.staleness() == 0.0
+
+
 def test_run_streaming_timeout_kills_child_process_group():
     """A wedged child is killed (whole process group) and surfaces as
     rc 124 — the bench.py subprocess-probe lesson applied to

@@ -141,7 +141,7 @@ class FleetSim:
 
 
 def build(world, clock, prompter=None, policy=None, readiness_timeout=60.0,
-          rng=lambda: 0.0):
+          rng=lambda: 0.0, hooks=None):
     return sup_mod.Supervisor(
         world.config, world.paths, prompter or Say(),
         run=world.run, run_quiet=world.run_quiet,
@@ -150,6 +150,7 @@ def build(world, clock, prompter=None, policy=None, readiness_timeout=60.0,
                               echo=lambda line: None),
         clock=clock.time, sleep=clock.sleep, rng=rng,
         readiness_timeout=readiness_timeout,
+        hooks=hooks,
     )
 
 
@@ -212,7 +213,8 @@ def test_breaker_trips_on_kth_windowed_failure_and_half_open_probe():
     assert breaker.reopen_at == pytest.approx(640.0)  # base again (rng 0)
     assert breaker.allow(640.0)
     assert breaker.record_success(650.0)  # probe heals: closes
-    assert breaker.state == sup_mod.CLOSED and breaker.failures == []
+    assert breaker.state == sup_mod.CLOSED
+    assert list(breaker.failures) == []  # windowed deque, emptied
 
 
 def test_breaker_failures_outside_window_expire():
@@ -573,6 +575,231 @@ def test_kill_mid_heal_leaves_crash_signature_and_spent_token(tmp_path):
     assert status["heals"]["succeeded"] == 1
 
 
+# ------------------------------------------ dirty-set reconcile (fleet scale)
+
+
+def counting_quiet(world):
+    """Wrap world.run_quiet with fleet-listing / ssh call counters."""
+    counts = {"list": 0, "ssh": 0}
+    orig = world.run_quiet
+
+    def quiet(args, cwd=None, **kwargs):
+        if args and args[0] == "gcloud":
+            counts["list"] += 1
+        elif args and args[0] == "ssh":
+            counts["ssh"] += 1
+        return orig(args, cwd=cwd, **kwargs)
+
+    world.run_quiet = quiet
+    return counts
+
+
+def test_dirty_set_reconcile_probes_changed_not_fleet(tmp_path):
+    """THE fleet-scale tick pin: after the first full diagnosis, a
+    steady tick pays the paged listing plus the sweep rotation's SSH —
+    NOT a per-slice probe round over the whole fleet — while a
+    preemption still heals (its listing page changed -> dirty ->
+    diagnosed -> flap-confirmed -> healed)."""
+    clock = SimClock()
+    world = FleetSim(tmp_path, clock, num_slices=12)
+    counts = counting_quiet(world)
+    policy = sup_mod.SupervisePolicy(interval=30.0, page_size=4,
+                                     sweep_slices=2)
+    supervisor = build(world, clock, policy=policy)
+    run_sim(supervisor, clock, ticks=1)
+    # first tick: every slice is never-diagnosed -> full probe round
+    assert counts["list"] == 3  # 12 slices in pages of 4
+    assert counts["ssh"] == 24  # 12 x (ssh probe + drain check)
+    counts["list"] = counts["ssh"] = 0
+
+    run_sim(supervisor, clock, ticks=1)
+    # steady: pages refetch (the cheap change detector) but only the
+    # 2-slice sweep pays the expensive SSH/drain probes
+    assert counts["list"] == 3
+    assert counts["ssh"] == 4  # 2 swept slices x (probe + drain)
+
+    # a preemption flips its LISTING page -> the slice is dirty every
+    # tick until healed, without waiting for the sweep to come around
+    world.preempt(7, at=clock.time())
+    run_sim(supervisor, clock, ticks=4)
+    assert world.applies == [[7]]
+    status = json.loads(world.paths.fleet_status.read_text())
+    assert status["verdict"] == "healthy"
+    assert status["slice_states"] == {"healthy": 12}
+
+
+def test_sweep_rotation_catches_listing_invisible_drift(tmp_path):
+    """A drain file on a listing-READY host is invisible to the cheap
+    change detector; the sweep rotation still finds it within
+    ceil(num_slices / sweep_slices) ticks."""
+    clock = SimClock()
+    world = FleetSim(tmp_path, clock, num_slices=8)
+    world.drain(6, start=40.0, until=10_000.0)
+    policy = sup_mod.SupervisePolicy(interval=30.0, page_size=4,
+                                     sweep_slices=2)
+    say = Say()
+    supervisor = build(world, clock, prompter=say, policy=policy)
+    # 1 full tick + ceil(8/2)=4 sweep ticks bound the detection
+    run_sim(supervisor, clock, ticks=6)
+    recorded = kinds(world)
+    assert ev.MAINTENANCE in recorded
+    assert "draining for maintenance" in say.text()
+    assert world.applies == []  # drain is expected downtime, never healed
+
+
+# ------------------------------------------------- parallel heal dispatch
+
+
+def test_parallel_heals_converge_in_wave_time(tmp_path):
+    """THE parallel-heal pin: 4 slices lost at once with heal_workers=2
+    dispatch as 4 INDEPENDENT slice-scoped heals in 2 waves — the heal
+    makespan is 2 heal-times, not 4 serial ones, every heal is its own
+    ledger record charged to its own token bucket, and the fleet ends
+    healthy."""
+    clock = SimClock()
+    world = FleetSim(tmp_path, clock, num_slices=6)
+    for i in range(4):
+        world.preempt(i, at=60.0)
+    policy = sup_mod.SupervisePolicy(
+        interval=30.0, flap_threshold=2, heal_burst=2,
+        heal_refill_s=3600.0, heal_workers=2,
+    )
+    supervisor = build(world, clock, policy=policy, hooks=clock)
+    run_sim(supervisor, clock, ticks=10)
+    # one scoped terraform replace per slice, never a combined order
+    assert sorted(i for order in world.applies for i in order) == [0, 1, 2, 3]
+    assert all(len(order) == 1 for order in world.applies)
+    records = ev.EventLedger(world.paths.events).replay()
+    starts = [r for r in records if r["kind"] == ev.HEAL_START]
+    dones = [r for r in records if r["kind"] == ev.HEAL_DONE]
+    assert len(starts) == 4 and len(dones) == 4
+    makespan = (max(r["ts"] for r in dones)
+                - min(r["ts"] for r in starts))
+    assert makespan == pytest.approx(240.0)  # 2 waves x 120 s, not 480
+    status = json.loads(world.paths.fleet_status.read_text())
+    assert status["verdict"] == "healthy"
+    assert status["heals"]["attempted"] == 4
+    assert status["heals"]["succeeded"] == 4
+
+
+def test_parallel_heal_failures_trip_breaker_and_stop_next_wave(tmp_path):
+    """A wave of failing heals feeds the shared breaker; once it trips,
+    the NEXT wave is held (degraded-hold on the ledger) instead of
+    dispatched — parallelism never buys a heal storm more replaces."""
+    clock = SimClock()
+    world = FleetSim(tmp_path, clock, num_slices=6, heal_works=False)
+    for i in range(5):
+        world.preempt(i, at=0.0)
+    policy = sup_mod.SupervisePolicy(
+        interval=30.0, flap_threshold=2, heal_burst=1,
+        heal_refill_s=36_000.0, heal_workers=2,
+        breaker_threshold=3, breaker_window_s=36_000.0,
+        breaker_cooldown_s=6_000.0, max_degraded=5,
+    )
+    supervisor = build(world, clock, policy=policy, hooks=clock,
+                       readiness_timeout=60.0)
+    run_sim(supervisor, clock, ticks=6)
+    recorded = kinds(world)
+    # wave 1 (2 heals) fails without tripping (threshold 3); wave 2's
+    # 3rd/4th failures trip it; wave 3 (the 5th heal) is NEVER dispatched
+    assert recorded.count(ev.HEAL_START) == 4
+    assert ev.BREAKER_OPEN in recorded
+    assert ev.DEGRADED_HOLD in recorded
+    status = json.loads(world.paths.fleet_status.read_text())
+    assert status["verdict"] == "degraded-hold"
+    assert status["heals"]["attempted"] == 4
+    assert status["heals"]["failed"] == 4
+
+
+# --------------------------------------- ledger compaction + restart drill
+
+
+def test_kill_compact_restart_resumes_without_double_heal(tmp_path):
+    """The compaction drill: SIGKILL after a successful heal, compact
+    the ledger to one snapshot, restart — the spent heal token stays
+    spent, counters continue, the membership generation is monotonic
+    across the compact boundary, and the healed slice is NOT re-healed.
+    A fresh loss then rate-limits against the PRE-COMPACT consumption."""
+    from tritonk8ssupervisor_tpu.testing.faults import (
+        FaultPlan,
+        FaultRule,
+        SupervisorKilled,
+    )
+
+    clock = SimClock()
+    world = FleetSim(tmp_path, clock)
+    world.preempt(1, at=60.0)
+    policy = sup_mod.SupervisePolicy(
+        interval=30.0, flap_threshold=2, heal_burst=1, heal_refill_s=600.0,
+    )
+    plan = FaultPlan([FaultRule(match="tpu-vm list", after=4, kill=True)],
+                     echo=lambda line: None)
+    world_quiet = world.run_quiet
+    world.run_quiet = plan.wrap(world_quiet)
+    supervisor = build(world, clock, policy=policy)
+    clock.begin()
+    try:
+        with pytest.raises(SupervisorKilled):
+            supervisor.run(ticks=20)
+    finally:
+        clock.release()
+    assert world.applies == [[1]]
+
+    led = ev.EventLedger(world.paths.events, clock=clock.time,
+                         echo=lambda line: None)
+    before = ev.fold(led.replay())
+    assert before.heals_attempted == 1
+    dropped = led.compact()
+    assert dropped > 0
+    lines = [l for l in world.paths.events.read_text().splitlines()
+             if l.strip()]
+    assert len(lines) == 1  # one snapshot record
+
+    # restart over the COMPACTED ledger: no double-heal, counters resume
+    world.run_quiet = world_quiet
+    second = build(world, clock, policy=policy)
+    run_sim(second, clock, ticks=4)
+    assert world.applies == [[1]]
+    status = json.loads(world.paths.fleet_status.read_text())
+    assert status["verdict"] == "healthy"
+    assert status["heals"]["attempted"] == 1
+    # generation continued from the snapshot (loss + return >= 3), and
+    # later transitions keep bumping it monotonically
+    assert status["membership"]["generation"] >= before.membership_generation
+
+    # the slice breaks again: the bucket restored FROM THE SNAPSHOT has
+    # its burst-1 token spent -> rate-limited until the refill
+    world.preempt(1, at=clock.time())
+    third = build(world, clock, policy=policy)
+    run_sim(third, clock, ticks=14)
+    recorded = kinds(world)
+    assert recorded.count(ev.RATE_LIMITED) >= 1
+    assert len(world.applies) == 2
+
+
+def test_supervisor_auto_compacts_past_threshold(tmp_path):
+    """The supervise loop compacts its own ledger once it crosses
+    compact_records — a week-long run replays a snapshot plus the tail,
+    not millions of records — and the folded state is unchanged."""
+    clock = SimClock()
+    world = FleetSim(tmp_path, clock)
+    policy = sup_mod.SupervisePolicy(interval=30.0, compact_records=8)
+    say = Say()
+    supervisor = build(world, clock, prompter=say, policy=policy)
+    run_sim(supervisor, clock, ticks=20)
+    lines = [l for l in world.paths.events.read_text().splitlines()
+             if l.strip()]
+    # without compaction: start + first tick's 1+3 records + 19 ticks +
+    # stop > 24 lines; with it the file stays near the threshold
+    assert len(lines) <= 10
+    assert any(json.loads(l)["kind"] == ev.SNAPSHOT for l in lines)
+    assert "event ledger compacted" in say.text()
+    view = ev.fold(ev.EventLedger(world.paths.events).replay())
+    assert view.ticks == 20  # history-spanning counters survived
+    status = json.loads(world.paths.fleet_status.read_text())
+    assert status["verdict"] == "healthy"
+
+
 # ---------------------------------------------------------- housekeeping
 
 
@@ -669,6 +896,86 @@ def test_supervise_bench_json_document(tmp_path, capsys):
     assert doc["value"] == doc["unattended_mttr_s"] <= doc["mttr_budget_s"]
     assert doc["breaker_drill"]["end_verdict"] == "degraded-hold"
     assert "supervise (simulated)" in capsys.readouterr().err
+
+
+@pytest.mark.perf
+def test_breaker_and_flap_per_tick_cost_flat_over_10k_ticks():
+    """Satellite audit pin: CircuitBreaker._prune and FlapFilter.observe
+    run every tick — their per-tick cost must be independent of total
+    history / fleet size. The breaker's failure window is a deque that
+    never holds more than one window's worth of timestamps, and the flap
+    streak dict only holds slices with a live streak (healthy
+    observations REMOVE the entry) — 10k ticks of both stay well under a
+    second of wall time."""
+    import time as wall
+
+    from tritonk8ssupervisor_tpu.provision import heal as heal_mod
+
+    breaker = sup_mod.CircuitBreaker(
+        threshold=3, window_s=60.0,
+        cooldown=retry.Cooldown(1.0, 10.0, rng=lambda: 0.0),
+    )
+    t0 = wall.perf_counter()
+    for i in range(10_000):
+        breaker.record_failure(float(i))
+        # the window deque is BOUNDED by the window, not the history
+        assert len(breaker.failures) <= 61
+    breaker_s = wall.perf_counter() - t0
+    assert breaker_s < 1.0
+
+    flaps = sup_mod.FlapFilter(threshold=2)
+    # 10k ticks over a big fleet where the dirty set is ONE slice per
+    # tick: cost tracks the observation, and recoveries shrink the dict
+    t0 = wall.perf_counter()
+    for i in range(10_000):
+        index = i % 1000
+        flaps.observe(heal_mod.FleetHealth(
+            [heal_mod.SliceHealth(index, UNREADY)]
+        ))
+        flaps.observe(heal_mod.FleetHealth(
+            [heal_mod.SliceHealth(index, HEALTHY)]
+        ))
+        assert len(flaps.streaks) <= 1  # healthy observations evict
+    flap_s = wall.perf_counter() - t0
+    assert flap_s < 1.0
+
+
+@pytest.mark.perf
+def test_fleetscale_bench_tick_sublinear_and_outage_parallel():
+    """The fleet-scale acceptance (BENCH_fleetscale.json): 256-slice
+    steady tick cost within 4x the 4-slice tick (sublinear in N via the
+    dirty-set reconcile + paged listings) AND under one reconcile
+    interval on the simclock — with the real tick()'s wall time sampled
+    too; a 32-of-256 zone outage converges in parallel-heal time
+    (<= 4x one heal at 8 workers), every heal slice-scoped."""
+    import bench_provision
+
+    result = bench_provision.run_fleetscale_benchmark()
+    assert result["passes"] is True
+    assert result["value"] <= 4.0  # 64x the fleet, <= 4x the tick
+    t256 = result["ticks"]["256"]
+    assert t256["steady_tick_cost_s"] <= t256["interval_s"]
+    assert t256["wall_tick_s_max"] < t256["interval_s"]
+    assert t256["pages"] == 4  # 256 slices in 64-slice windows
+    outage = result["outage"]
+    assert outage["all_healed"] and outage["scoped_per_slice"]
+    assert outage["heals_succeeded"] == 32
+    assert (outage["heal_makespan_s"]
+            <= 4.0 * outage["single_heal_s"] + 1e-6)
+    assert outage["parallel_speedup_x"] >= 4.0
+    assert outage["end_verdict"] == "healthy"
+
+
+@pytest.mark.perf
+def test_fleetscale_bench_json_document(tmp_path, capsys):
+    import bench_provision
+
+    out = tmp_path / "BENCH_fleetscale.json"
+    assert bench_provision.main(["--fleetscale", "--out", str(out)]) == 0
+    doc = json.loads(out.read_text())
+    assert doc["benchmark"] == "provision_fleetscale"
+    assert doc["passes"] is True
+    assert "fleet-scale supervise (simulated)" in capsys.readouterr().err
 
 
 # ------------------------------------------------------------ chaos drill

@@ -150,9 +150,45 @@ def build_parser() -> argparse.ArgumentParser:
         "decorrelated jitter (default 300)",
     )
     parser.add_argument(
+        "--page-size", type=int, default=None, metavar="N",
+        help="supervise: slices per fleet-listing page (default 64 — "
+        "sized so one page is one `tpu-vm list` call; a 256-slice fleet "
+        "is fetched as bounded pages with per-page TTLs and the retry "
+        "classifier's 429 backoff floor instead of one giant ask; "
+        "env TK8S_SUPERVISE_PAGE_SIZE)",
+    )
+    parser.add_argument(
+        "--sweep-slices", type=int, default=None, metavar="N",
+        help="supervise: slices re-diagnosed per tick beyond the dirty "
+        "set (default 4) — the slow full-sweep rotation that bounds how "
+        "long listing-invisible drift can hide to "
+        "ceil(num_slices/N) ticks (env TK8S_SUPERVISE_SWEEP)",
+    )
+    parser.add_argument(
+        "--heal-workers", type=int, default=None, metavar="N",
+        help="supervise: parallel slice-scoped heals per wave (default "
+        "8; 1 restores the serial combined heal order) — a zone outage "
+        "killing K slices converges in ceil(K/N) heal times "
+        "(env TK8S_SUPERVISE_HEAL_WORKERS)",
+    )
+    parser.add_argument(
+        "--compact-records", type=int, default=None, metavar="N",
+        help="supervise: auto-compact the event ledger to one snapshot "
+        "record once it holds N records (default 20000; 0 disables) — "
+        "restart-resume state is preserved exactly "
+        "(env TK8S_SUPERVISE_COMPACT)",
+    )
+    parser.add_argument(
         "--json", action="store_true",
         help="status: print the raw fleet-status JSON document instead "
         "of the human summary",
+    )
+    parser.add_argument(
+        "--all", action="store_true",
+        help="status: include EVERY slice in the per-slice detail "
+        "(folded from the event ledger) — the default document stays "
+        "bounded at fleet scale: per-state counts plus only the "
+        "not-healthy slices",
     )
     # ---------------------------------------------------------- train drill
     parser.add_argument(
@@ -458,6 +494,10 @@ def supervise_policy_from_args(args) -> supervisor_mod.SupervisePolicy:
         "breaker_window_s": args.breaker_window,
         "breaker_cooldown_s": args.breaker_cooldown,
         "max_degraded": max(0, args.max_degraded) or None,
+        "page_size": args.page_size,
+        "sweep_slices": args.sweep_slices,
+        "heal_workers": args.heal_workers,
+        "compact_records": args.compact_records,
     }
     for field, value in overrides.items():
         if value is not None:
@@ -520,7 +560,7 @@ def status_cmd(args, paths: state.RunPaths, prompter: Prompter) -> int:
     # a half-copied file (rsync, scraper snapshot) must fall back to the
     # ledger fold, never crash or read as healthy.
     doc = None
-    if paths.fleet_status.exists():
+    if paths.fleet_status.exists() and not args.all:
         try:
             doc = json_mod.loads(paths.fleet_status.read_text())
         except ValueError:
@@ -531,10 +571,22 @@ def status_cmd(args, paths: state.RunPaths, prompter: Prompter) -> int:
     if not isinstance(doc, dict):
         doc = None
     if doc is None and paths.events.exists():
+        # --all re-folds the ledger: fleet-status.json is deliberately
+        # BOUNDED (counts + not-healthy details), the full per-slice
+        # dump only exists on demand
         ledger = events_mod.EventLedger(paths.events)
         doc = events_mod.fleet_status(
-            events_mod.fold(ledger.replay()), time_mod.time()
+            events_mod.fold(ledger.replay()), time_mod.time(),
+            all_slices=args.all,
         )
+    if doc is None and args.all and paths.fleet_status.exists():
+        # --all without a ledger: the bounded document is all there is
+        try:
+            doc = json_mod.loads(paths.fleet_status.read_text())
+        except ValueError:
+            doc = None
+    if not isinstance(doc, dict):
+        doc = None
     if doc is None:
         raise state.MissingStateError(
             f"no fleet status at {paths.fleet_status} and no event "
@@ -554,7 +606,14 @@ def status_cmd(args, paths: state.RunPaths, prompter: Prompter) -> int:
                f"{sup.get('ticks', 0)} ticks)"
                if sup.get("running") and uptime is not None else "")
         )
-        for index, entry in sorted(doc.get("slices", {}).items()):
+        counts = doc.get("slice_states") or {}
+        if counts:
+            total = doc.get("slices_total", sum(counts.values()))
+            summary = ", ".join(f"{n} {state}"
+                                for state, n in sorted(counts.items()))
+            prompter.say(f"slices: {summary} (of {total})")
+        for index, entry in sorted(doc.get("slices", {}).items(),
+                                   key=lambda kv: int(kv[0])):
             detail = f" ({entry['detail']})" if entry.get("detail") else ""
             prompter.say(f"  slice {index}: {entry.get('state')}{detail}")
         heals = doc.get("heals", {})

@@ -43,7 +43,8 @@ SCHEMA_VERSION = 1
 # replay fold, and the docs (docs/failure-modes.md "running unattended").
 SUPERVISOR_START = "supervisor-start"
 SUPERVISOR_STOP = "supervisor-stop"
-TICK = "tick"  # one reconcile observation: per-slice states
+TICK = "tick"  # one reconcile observation: CHANGED per-slice states
+SNAPSHOT = "snapshot"  # a compacted ledger prefix: the folded view, whole
 VERDICT = "verdict"  # a slice's state CHANGED (healthy -> missing, ...)
 MAINTENANCE = "maintenance"  # a slice began draining for maintenance
 HEAL_START = "heal-start"
@@ -142,6 +143,48 @@ class EventLedger:
             records.append(record)
         return records
 
+    def compact(self, view: "LedgerView | None" = None) -> int:
+        """Rewrite the ledger down to ONE snapshot record carrying the
+        folded view — the event-ledger sibling of `Journal.compact()`.
+
+        A week-long supervise loop appends a tick record every interval
+        plus a verdict per state change, forever; restart-replay cost (and
+        the file itself) grows without bound. Everything resume needs is
+        the FOLD, not the history: per-slice heal-start timestamps (token
+        buckets), the breaker's windowed failures and open/cooldown state,
+        the monotonic membership generation, the job-ack phase, counters,
+        and any orphaned heal-start (the crash signature). The snapshot
+        record serialises exactly that; `apply()` restores it wholesale,
+        so fold(compacted ledger + later records) == fold(original ledger
+        + later records). The rewrite is a same-directory temp file +
+        fsync + os.replace — readers and a crash mid-compaction see the
+        old ledger or the new one, never a truncation. Returns the number
+        of records dropped.
+
+        `view` (the supervisor's live fold) skips the re-replay; without
+        it the ledger is replayed and folded here (the offline path).
+        """
+        records = self.replay()
+        if len(records) <= 1:
+            return 0
+        if view is None:
+            view = fold(records)
+        snap = {"v": SCHEMA_VERSION, "ts": self._clock(), "kind": SNAPSHOT,
+                **snapshot_fields(view)}
+        line = json.dumps(snap, sort_keys=True) + "\n"
+        tmp = self.path.with_name(f".{self.path.name}.compact.tmp")
+        with self._mutex:
+            with tmp.open("w") as f:
+                f.write(line)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.path)
+        dropped = len(records) - 1
+        self._echo(
+            f"event ledger compacted: {len(records)} records -> 1 snapshot"
+        )
+        return dropped
+
     def scrub(self) -> None:
         """Delete the ledger — teardown's LAST act (after even the
         journal), so a clean that crashes halfway leaves the full flight
@@ -215,6 +258,95 @@ class LedgerView:
         return self.slices.setdefault(int(index), SliceView(int(index)))
 
 
+def snapshot_fields(view: LedgerView) -> dict:
+    """Serialise a LedgerView into the snapshot record's fields — the
+    exact inverse of `_apply_snapshot`. Every field a restart consumes is
+    here: drop one and a compacted ledger silently forgets it (the
+    compact round-trip tests in tests/test_events.py pin the set)."""
+    return {
+        "started": view.started,
+        "stopped": view.stopped,
+        "ticks": view.ticks,
+        "heals_attempted": view.heals_attempted,
+        "heals_succeeded": view.heals_succeeded,
+        "heals_failed": view.heals_failed,
+        "rate_limited": view.rate_limited,
+        "held_ticks": view.held_ticks,
+        "heals_suppressed": view.heals_suppressed,
+        "membership_generation": view.membership_generation,
+        "job_phase": view.job_phase,
+        "job_generation": view.job_generation,
+        "job_step": view.job_step,
+        "job_notified_ts": view.job_notified_ts,
+        "job_resumed_ts": view.job_resumed_ts,
+        "job_mttr_samples": list(view.job_mttr_samples),
+        "acked_degraded": sorted(view.acked_degraded),
+        "breaker_state": view.breaker_state,
+        "breaker_since": view.breaker_since,
+        "breaker_reopen_at": view.breaker_reopen_at,
+        "breaker_trips": view.breaker_trips,
+        "breaker_failures": list(view.breaker_failures),
+        # orphaned heal-starts (the crash signature) survive the compact
+        "pending_heals": {str(k): v for k, v in view.pending_heals.items()},
+        "mttr_samples": list(view.mttr_samples),
+        "last_ts": view.last_ts,
+        "slices": {
+            str(sv.index): {
+                "state": sv.state,
+                "detail": sv.detail,
+                "since": sv.since,
+                "streak": sv.streak,
+                "heal_starts": list(sv.heal_starts),
+                "heals_succeeded": sv.heals_succeeded,
+                "heals_failed": sv.heals_failed,
+            }
+            for sv in view.slices.values()
+        },
+    }
+
+
+def _apply_snapshot(view: LedgerView, record: dict) -> None:
+    """Restore a compacted snapshot into `view` wholesale — the first
+    record of a compacted ledger; later records fold on top normally."""
+    view.started = record.get("started")
+    view.stopped = record.get("stopped")
+    view.ticks = record.get("ticks", 0)
+    view.heals_attempted = record.get("heals_attempted", 0)
+    view.heals_succeeded = record.get("heals_succeeded", 0)
+    view.heals_failed = record.get("heals_failed", 0)
+    view.rate_limited = record.get("rate_limited", 0)
+    view.held_ticks = record.get("held_ticks", 0)
+    view.heals_suppressed = record.get("heals_suppressed", 0)
+    view.membership_generation = record.get("membership_generation", 1)
+    view.job_phase = record.get("job_phase", "")
+    view.job_generation = record.get("job_generation")
+    view.job_step = record.get("job_step")
+    view.job_notified_ts = record.get("job_notified_ts")
+    view.job_resumed_ts = record.get("job_resumed_ts")
+    view.job_mttr_samples = list(record.get("job_mttr_samples") or [])
+    view.acked_degraded = {int(i) for i in record.get("acked_degraded") or []}
+    view.breaker_state = record.get("breaker_state", "closed")
+    view.breaker_since = record.get("breaker_since")
+    view.breaker_reopen_at = record.get("breaker_reopen_at")
+    view.breaker_trips = record.get("breaker_trips", 0)
+    view.breaker_failures = list(record.get("breaker_failures") or [])
+    view.pending_heals = dict(record.get("pending_heals") or {})
+    view.open_heals = list(view.pending_heals.values())
+    view.mttr_samples = list(record.get("mttr_samples") or [])
+    view.slices = {}
+    for index, entry in (record.get("slices") or {}).items():
+        sv = SliceView(int(index))
+        sv.state = entry.get("state", "unknown")
+        sv.detail = entry.get("detail", "")
+        sv.since = entry.get("since")
+        sv.streak = entry.get("streak", 0)
+        sv.heal_starts = list(entry.get("heal_starts") or [])
+        sv.heals_succeeded = entry.get("heals_succeeded", 0)
+        sv.heals_failed = entry.get("heals_failed", 0)
+        view.slices[sv.index] = sv
+    view.last_ts = record.get("last_ts")
+
+
 def _note_state(view: LedgerView, sv: SliceView, new_state: str) -> None:
     """Assign one slice observation, bumping the membership generation on
     serving-set transitions. ONE helper shared by the TICK and VERDICT
@@ -241,6 +373,9 @@ def apply(view: LedgerView, record: dict) -> LedgerView:
     publish; `fold()` is the same function looped over a replay."""
     kind = record.get("kind", "")
     ts = record.get("ts")
+    if kind == SNAPSHOT:
+        _apply_snapshot(view, record)
+        return view
     view.last_ts = ts
     if kind == SUPERVISOR_START:
         view.started = ts
@@ -330,17 +465,33 @@ def fold(records: list[dict]) -> LedgerView:
 # ------------------------------------------------------------ fleet status
 
 
-def fleet_status(view: LedgerView, now: float, pid: int | None = None) -> dict:
+def fleet_status(
+    view: LedgerView,
+    now: float,
+    pid: int | None = None,
+    all_slices: bool = False,
+) -> dict:
     """The machine-readable status document. Written atomically to
     fleet-status.json every reconcile tick and rendered by
     `./setup.sh status [--json]`; schema documented in
-    docs/failure-modes.md (running unattended)."""
+    docs/failure-modes.md (running unattended).
+
+    The document stays BOUNDED at fleet scale: `slice_states` carries
+    per-state counts for the whole fleet, while the per-slice `slices`
+    detail names only the not-healthy slices — at 256 healthy slices the
+    status a FileHealthSource (parallel/elastic.py) parses every step
+    boundary is a few hundred bytes, not a megabyte. `all_slices=True`
+    (what `./setup.sh status --json --all` folds from the ledger) emits
+    the full per-slice dump."""
     from tritonk8ssupervisor_tpu.provision import heal as heal_mod
 
     degraded = sorted(
         sv.index for sv in view.slices.values()
         if sv.state not in (heal_mod.HEALTHY, "unknown")
     )
+    counts: dict = {}
+    for sv in view.slices.values():
+        counts[sv.state] = counts.get(sv.state, 0) + 1
     healing = bool(view.open_heals)
     if view.breaker_state != "closed":
         verdict = "degraded-hold"
@@ -369,6 +520,8 @@ def fleet_status(view: LedgerView, now: float, pid: int | None = None) -> dict:
             "ticks": view.ticks,
         },
         "verdict": verdict,
+        "slices_total": len(view.slices),
+        "slice_states": counts,
         "slices": {
             str(sv.index): {
                 "state": sv.state,
@@ -379,6 +532,7 @@ def fleet_status(view: LedgerView, now: float, pid: int | None = None) -> dict:
                 "heals_failed": sv.heals_failed,
             }
             for sv in sorted(view.slices.values(), key=lambda s: s.index)
+            if all_slices or sv.state != heal_mod.HEALTHY
         },
         "degraded": degraded,
         # The job-facing membership contract (parallel/elastic.py

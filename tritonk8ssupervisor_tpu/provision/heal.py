@@ -34,6 +34,7 @@ from __future__ import annotations
 import contextlib
 import dataclasses
 import json
+import threading
 import time
 
 from tritonk8ssupervisor_tpu.config.schema import ClusterConfig, ConfigError
@@ -108,14 +109,21 @@ def drain_verdicts(
     ssh_key: str = "",
     run_quiet: run_mod.RunFn = run_mod.run_capture,
     drain_file: str = maintenance.DEFAULT_DRAIN_FILE,
+    only_slices=None,
 ) -> dict:
     """{slice index: drain reason} for slices where ANY host carries the
     maintenance watchdog's drain file. An unreachable host is NOT
     draining (it shows up as unready via the SSH probe instead); a
     reachable host without the file returns empty output — also not
-    draining."""
+    draining. `only_slices` bounds the asking to that subset (the
+    supervisor's dirty-set reconcile never drain-checks 256 slices a
+    tick)."""
+    wanted = (None if only_slices is None
+              else {int(i) for i in only_slices})
     verdicts: dict = {}
     for i, slice_ips in enumerate(host_ips):
+        if wanted is not None and i not in wanted:
+            continue
         for ip in slice_ips:
             try:
                 reason = run_quiet(
@@ -138,13 +146,21 @@ def diagnose(
     ssh_key: str = "",
     check_drain: bool = True,
     snapshot: "readiness.FleetSnapshot | None" = None,
+    only_slices=None,
 ) -> FleetHealth:
     """Readiness verdicts + the drain signal, folded into per-slice
     health. Probes are batched/concurrent the PR-2 way: one `tpu-vm
-    list` for the whole fleet, SSH fan-out per slice. With `snapshot`
+    list` (windowed into pages at fleet scale) for the whole fleet, SSH
+    fan-out on a bounded pool. With `snapshot`
     (readiness.FleetSnapshot) the listing is the run's shared TTL-cached
     one — a heal that just polled readiness does not issue a second
-    `tpu-vm list` to diagnose the same fleet."""
+    `tpu-vm list` to diagnose the same fleet.
+
+    `only_slices` scopes the EXPENSIVE probes (per-host SSH + drain
+    files) to that subset and returns a FleetHealth over just those
+    slices — the supervisor's dirty-set reconcile diagnoses the slices
+    whose listing page changed plus a slow sweep rotation, never the
+    whole fleet per tick."""
     try:
         hosts = load_hosts(paths)
         host_ips = hosts.host_ips
@@ -157,17 +173,24 @@ def diagnose(
         )
     except Exception:  # noqa: BLE001 - listing is advisory; SSH decides
         listing = {}
+    indices = (
+        list(range(config.num_slices)) if only_slices is None
+        else sorted({int(i) for i in only_slices
+                     if 0 <= int(i) < config.num_slices})
+    )
     ssh_verdicts = readiness.slice_ssh_verdicts(
-        host_ips, ssh_user=ssh_user, ssh_key=ssh_key, run_quiet=run_quiet
+        host_ips, ssh_user=ssh_user, ssh_key=ssh_key, run_quiet=run_quiet,
+        only_slices=None if only_slices is None else indices,
     )
     drains = (
         drain_verdicts(host_ips, ssh_user=ssh_user, ssh_key=ssh_key,
-                       run_quiet=run_quiet)
+                       run_quiet=run_quiet,
+                       only_slices=None if only_slices is None else indices)
         if check_drain else {}
     )
 
     slices = []
-    for i in range(config.num_slices):
+    for i in indices:
         name = f"{config.node_prefix}-{i}"
         slice_ips = tuple(host_ips[i]) if i < len(host_ips) else ()
         if not slice_ips:
@@ -192,15 +215,27 @@ def diagnose(
     return FleetHealth(slices)
 
 
+# Concurrent slice-scoped heals (the supervisor's parallel dispatch)
+# merge into one quarantine record: the read-modify-write below must not
+# interleave across heal worker threads or entries get lost.
+_QUARANTINE_LOCK = threading.Lock()
+
+
 def record_quarantine(
     paths: RunPaths,
     entries: dict,
     clock=time.time,
 ) -> None:
     """Merge {slice index: {state, detail, hosts}} into
-    terraform/quarantine.json (atomic write). The record survives the
-    heal so an operator can see WHAT was pulled and WHY even after
-    hosts.json has been rewritten; healed slices are removed again."""
+    terraform/quarantine.json (atomic write, serialised across heal
+    worker threads). The record survives the heal so an operator can see
+    WHAT was pulled and WHY even after hosts.json has been rewritten;
+    healed slices are removed again."""
+    with _QUARANTINE_LOCK:
+        _record_quarantine_locked(paths, entries, clock)
+
+
+def _record_quarantine_locked(paths, entries, clock) -> None:
     existing: dict = {}
     if paths.quarantine_file.exists():
         try:

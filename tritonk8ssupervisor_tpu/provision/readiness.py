@@ -13,12 +13,14 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import os
 import random
 import threading
 import time
-from typing import Callable
+from typing import Callable, Iterable
 
 from tritonk8ssupervisor_tpu.config.schema import ClusterConfig
+from tritonk8ssupervisor_tpu.provision import retry
 from tritonk8ssupervisor_tpu.provision import runner as run_mod
 
 
@@ -138,13 +140,53 @@ def _node_is_ready(node: dict) -> bool:
 # --------------------------------------------------------------- tpu-vm mode
 
 
+DEFAULT_PROBE_WORKERS = 16
+
+
+def probe_workers(default: int = DEFAULT_PROBE_WORKERS) -> int:
+    """The bounded SSH fan-out width (TK8S_PROBE_WORKERS, same convention
+    as TK8S_SCHED_WORKERS). At 256 slices an unbounded one-thread-per-host
+    probe would spawn hundreds of ssh children at once; the pool caps the
+    concurrency while the verdict still names EVERY unready host."""
+    raw = os.environ.get("TK8S_PROBE_WORKERS", "")
+    try:
+        return max(1, int(raw)) if raw else default
+    except ValueError:
+        return default
+
+
+def _ssh_probe_one(
+    ip: str,
+    ssh_user: str,
+    ssh_key: str,
+    run_quiet: run_mod.RunFn,
+    connect_timeout: int,
+) -> str:
+    args = [
+        "ssh",
+        "-o", "BatchMode=yes",
+        "-o", f"ConnectTimeout={connect_timeout}",
+        "-o", "StrictHostKeyChecking=no",
+        "-o", "UserKnownHostsFile=/dev/null",
+    ]
+    if ssh_key:
+        args += ["-i", str(ssh_key)]
+    if ssh_user:
+        args += ["-l", ssh_user]
+    try:
+        run_quiet(args + [ip, "true"])
+    except run_mod.CommandError as e:
+        return f"{ip} (rc {e.returncode})"
+    return ""
+
+
 def ssh_ready_probe(
     ips: list[str],
     ssh_user: str = "",
     ssh_key: str = "",
     run_quiet: run_mod.RunFn = run_mod.run_capture,
     connect_timeout: int = 5,
-    max_workers: int = 16,
+    max_workers: int | None = None,
 ) -> str:
     """Ready when `ssh <ip> true` succeeds on every host with the exact
     credentials ansible will use.
@@ -156,39 +198,26 @@ def ssh_ready_probe(
     boot). BatchMode fails instead of hanging on a password prompt;
     known_hosts stays untouched so teardown's scrub list remains accurate.
 
-    All hosts are probed CONCURRENTLY and the verdict names every unready
-    host: one straggler costs one ConnectTimeout, not N of them, and the
-    operator sees the whole unready set instead of rediscovering it one
-    poll cycle at a time.
+    Hosts are probed concurrently on a BOUNDED pool (TK8S_PROBE_WORKERS,
+    default 16 — one thread per host does not survive 256 slices) and the
+    verdict names every unready host: one straggler costs one
+    ConnectTimeout, not N of them, and the operator sees the whole
+    unready set instead of rediscovering it one poll cycle at a time.
     """
-
-    def probe_one(ip: str) -> str:
-        args = [
-            "ssh",
-            "-o", "BatchMode=yes",
-            "-o", f"ConnectTimeout={connect_timeout}",
-            "-o", "StrictHostKeyChecking=no",
-            "-o", "UserKnownHostsFile=/dev/null",
-        ]
-        if ssh_key:
-            args += ["-i", str(ssh_key)]
-        if ssh_user:
-            args += ["-l", ssh_user]
-        try:
-            run_quiet(args + [ip, "true"])
-        except run_mod.CommandError as e:
-            return f"{ip} (rc {e.returncode})"
-        return ""
-
     if not ips:
         return ""
+    workers = probe_workers() if max_workers is None else max(1, max_workers)
     from concurrent.futures import ThreadPoolExecutor
 
     with ThreadPoolExecutor(
-        max_workers=min(max_workers, len(ips)),
+        max_workers=min(workers, len(ips)),
         thread_name_prefix="ssh-probe",
     ) as pool:
-        verdicts = list(pool.map(probe_one, ips))
+        verdicts = list(pool.map(
+            lambda ip: _ssh_probe_one(ip, ssh_user, ssh_key, run_quiet,
+                                      connect_timeout),
+            ips,
+        ))
     unready = [v for v in verdicts if v]
     if unready:
         return (f"{len(unready)}/{len(ips)} host(s) ssh not ready: "
@@ -202,38 +231,82 @@ def slice_ssh_verdicts(
     ssh_key: str = "",
     run_quiet: run_mod.RunFn = run_mod.run_capture,
     connect_timeout: int = 5,
+    only_slices: "Iterable[int] | None" = None,
+    max_workers: int | None = None,
 ) -> dict[int, str]:
     """Per-slice SSH readiness verdict ("" = every host in the slice
     accepts authenticated sessions). The heal diagnosis needs verdicts at
     SLICE granularity — one dead host condemns its slice (the JAX gang
-    loses the whole collective anyway) but must not condemn the fleet."""
-    return {
-        i: ssh_ready_probe(
-            list(slice_ips), ssh_user=ssh_user, ssh_key=ssh_key,
-            run_quiet=run_quiet, connect_timeout=connect_timeout,
-        )
+    loses the whole collective anyway) but must not condemn the fleet.
+
+    ALL probed hosts share ONE bounded pool (TK8S_PROBE_WORKERS): the old
+    slice-at-a-time loop serialised the fleet — at 256 slices the last
+    slice's verdict waited behind 255 probe rounds. `only_slices`
+    restricts the probing to that subset (the supervisor's dirty-set
+    reconcile diagnoses only changed slices); every probed slice still
+    gets a verdict naming each of its unready hosts."""
+    wanted = (None if only_slices is None
+              else {int(i) for i in only_slices})
+    targets = [
+        (i, ip)
         for i, slice_ips in enumerate(host_ips)
+        if wanted is None or i in wanted
+        for ip in slice_ips
+    ]
+    verdicts: dict[int, str] = {
+        i: "" for i, _ in enumerate(host_ips)
+        if wanted is None or i in wanted
     }
+    if not targets:
+        return verdicts
+    workers = probe_workers() if max_workers is None else max(1, max_workers)
+    from concurrent.futures import ThreadPoolExecutor
+
+    with ThreadPoolExecutor(
+        max_workers=min(workers, len(targets)),
+        thread_name_prefix="ssh-probe",
+    ) as pool:
+        results = list(pool.map(
+            lambda t: (t[0], _ssh_probe_one(t[1], ssh_user, ssh_key,
+                                            run_quiet, connect_timeout)),
+            targets,
+        ))
+    unready: dict[int, list[str]] = {}
+    for index, verdict in results:
+        if verdict:
+            unready.setdefault(index, []).append(verdict)
+    for index, bad in unready.items():
+        total = len(host_ips[index])
+        verdicts[index] = (f"{len(bad)}/{total} host(s) ssh not ready: "
+                           + ", ".join(bad))
+    return verdicts
 
 
 def tpu_vm_states(
     config: ClusterConfig,
     run_quiet: run_mod.RunFn = run_mod.run_capture,
+    names: "Iterable[str] | None" = None,
 ) -> dict[str, str]:
     """Cloud TPU state per node name from ONE batched `tpu-vm list` call.
     Shared by the readiness poll (every slice) and the heal diagnosis
-    (which slices are missing/stuck while the rest of the fleet is up)."""
-    raw = run_quiet(
-        [
-            "gcloud",
-            "compute",
-            "tpus",
-            "tpu-vm",
-            "list",
-            f"--zone={config.zone}",
-            "--format=value(name,state)",
-        ]
-    )
+    (which slices are missing/stuck while the rest of the fleet is up).
+    With `names`, the listing is windowed to that page of nodes (a
+    server-side name filter + matching --page-size) — how FleetSnapshot
+    pages a 256-slice fleet instead of asking for everything at once."""
+    args = [
+        "gcloud",
+        "compute",
+        "tpus",
+        "tpu-vm",
+        "list",
+        f"--zone={config.zone}",
+        "--format=value(name,state)",
+    ]
+    if names is not None:
+        page = [str(n) for n in names]
+        args += [f"--filter=name:({' '.join(page)})",
+                 f"--page-size={max(1, len(page))}"]
+    raw = run_quiet(args)
     states: dict[str, str] = {}
     for line in raw.splitlines():
         parts = line.split()
@@ -245,8 +318,20 @@ def tpu_vm_states(
     return states
 
 
+@dataclasses.dataclass
+class _SnapshotPage:
+    """One window of the fleet listing: the node names it covers, the
+    last good fetch, and the quota-backoff gate."""
+
+    names: tuple
+    states: dict | None = None
+    fetched_at: float = float("-inf")
+    backoff_until: float = float("-inf")
+
+
 class FleetSnapshot:
-    """ONE batched `tpu-vm list` shared by every consumer in a run.
+    """The batched `tpu-vm list` shared by every consumer in a run —
+    fetched in bounded WINDOWED PAGES at fleet scale.
 
     Per-slice pipelined readiness runs N slice polls concurrently, and
     `heal` diagnoses right after its own readiness checks — without
@@ -254,9 +339,20 @@ class FleetSnapshot:
     startup + API latency per call, N slices turn every poll beat into
     N round-trips). The snapshot caches the listing for `ttl` seconds:
     concurrent slice polls inside one beat see the same fetch, and the
-    TTL bounds staleness to less than a poll interval. Thread-safe; a
-    fetch that raises is never cached (the next caller retries), and
-    `fetches` counts real calls for tests/observability.
+    TTL bounds staleness to less than a poll interval.
+
+    `page_size` > 0 splits the fleet into pages of that many slices,
+    each fetched by its own name-filtered list call with its OWN TTL and
+    staleness tracking — a 256-slice fleet is four 64-slice pages, and a
+    consumer that only cares about one page's worth of slices never
+    forces the rest to refetch. A page fetch that fails with a
+    rate/quota throttle (HTTP 429 / RESOURCE_EXHAUSTED — the retry
+    classifier's verdict) parks that page behind the classifier's
+    QUOTA_BACKOFF_FLOOR and serves the last good copy STALE (counted in
+    `served_stale`) instead of hammering the API; a failure with no
+    stale copy to serve still raises (never cached), and `fetch_errors`
+    / `last_error` keep the reconcile loop honest about a listing that
+    is quietly erroring. Thread-safe; `fetches` counts real calls.
     """
 
     def __init__(
@@ -265,15 +361,26 @@ class FleetSnapshot:
         run_quiet: run_mod.RunFn = run_mod.run_capture,
         ttl: float = 10.0,
         clock: Callable[[], float] = time.monotonic,
+        page_size: int = 0,
+        quota_backoff_s: float | None = None,
     ) -> None:
         self._config = config
         self._run_quiet = run_quiet
         self._ttl = ttl
         self._clock = clock
         self._lock = threading.Lock()
-        self._states: dict[str, str] | None = None
-        self._fetched_at = 0.0
+        n = max(1, int(config.num_slices))
+        size = n if int(page_size) <= 0 else min(int(page_size), n)
+        names = [f"{config.node_prefix}-{i}" for i in range(n)]
+        self._pages = [
+            _SnapshotPage(tuple(names[i:i + size]))
+            for i in range(0, n, size)
+        ]
+        self._quota_backoff = (retry.QUOTA_BACKOFF_FLOOR
+                               if quota_backoff_s is None
+                               else float(quota_backoff_s))
         self.fetches = 0
+        self.served_stale = 0  # pages served past their TTL (backoff)
         # Failed fetches are never cached, but a LONG-RUNNING consumer
         # (the supervisor's reconcile loop) needs to see that its
         # listings are erroring — a fleet that "looks healthy" because
@@ -281,26 +388,74 @@ class FleetSnapshot:
         self.fetch_errors = 0
         self.last_error = ""
 
+    @property
+    def page_count(self) -> int:
+        return len(self._pages)
+
+    def _fetch_backoff(self, error: Exception, now: float) -> float:
+        """Next-allowed-fetch time after a failed page fetch: a throttle
+        verdict (429/RESOURCE_EXHAUSTED) waits the classifier's quota
+        floor; anything else may retry immediately (the old never-cache
+        contract)."""
+        if isinstance(error, run_mod.CommandError):
+            verdict = retry.classify(error)
+            if verdict.min_delay > 0:
+                return now + max(verdict.min_delay, self._quota_backoff)
+        return now
+
     def states(self, max_age: float | None = None) -> dict[str, str]:
         ttl = self._ttl if max_age is None else max_age
         with self._lock:
             now = self._clock()
-            if self._states is None or now - self._fetched_at > ttl:
-                try:
-                    self._states = tpu_vm_states(
-                        self._config, self._run_quiet
-                    )
-                except Exception as e:  # noqa: BLE001 - count, then raise
-                    self.fetch_errors += 1
-                    self.last_error = str(e)
-                    raise
-                self._fetched_at = now
-                self.fetches += 1
-            return dict(self._states)
+            merged: dict[str, str] = {}
+            single = len(self._pages) == 1
+            for page in self._pages:
+                fresh = (page.states is not None
+                         and now - page.fetched_at <= ttl)
+                if not fresh and now >= page.backoff_until:
+                    try:
+                        listing = tpu_vm_states(
+                            self._config, self._run_quiet,
+                            names=None if single else page.names,
+                        )
+                    except Exception as e:  # noqa: BLE001 - classify below
+                        self.fetch_errors += 1
+                        self.last_error = str(e)
+                        page.backoff_until = self._fetch_backoff(e, now)
+                        if page.states is None:
+                            raise  # nothing stale to serve
+                        self.served_stale += 1
+                    else:
+                        wanted = set(page.names)
+                        page.states = (
+                            dict(listing) if single
+                            else {k: v for k, v in listing.items()
+                                  if k in wanted}
+                        )
+                        page.fetched_at = now
+                        self.fetches += 1
+                elif not fresh:
+                    self.served_stale += 1  # quota backoff: stale by choice
+                merged.update(page.states)
+            return merged
+
+    def staleness(self, now: float | None = None) -> float:
+        """Age of the OLDEST page's data (inf when a page has never been
+        fetched) — what "how stale could this verdict be" means once
+        pages refresh independently."""
+        with self._lock:
+            now = self._clock() if now is None else now
+            return max(
+                (now - page.fetched_at) for page in self._pages
+            )
 
     def invalidate(self) -> None:
+        """Mark every page stale. Data is KEPT for the quota-backoff
+        stale-serve path; the next states() refetches whatever is
+        allowed to refetch."""
         with self._lock:
-            self._states = None
+            for page in self._pages:
+                page.fetched_at = float("-inf")
 
 
 def tpu_vm_probe(

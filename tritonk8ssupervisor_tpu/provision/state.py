@@ -22,10 +22,18 @@ def atomic_write_text(path: Path, text: str) -> None:
     """Write via a same-directory temp file + os.replace so readers only
     ever see the old or the new content, never a torn half-write — the
     contract every polled state file here needs (hosts.json is read by
-    heal/teardown, the drain file by training loops mid-step)."""
+    heal/teardown, the drain file by training loops mid-step). The temp
+    name carries pid AND thread id: the supervisor's parallel slice
+    heals write hosts.json/quarantine from worker threads of ONE
+    process, and a shared temp name would let two writers replace each
+    other's half-written file."""
+    import threading
+
     path = Path(path)
     path.parent.mkdir(parents=True, exist_ok=True)
-    tmp = path.with_name(f".{path.name}.{os.getpid()}.tmp")
+    tmp = path.with_name(
+        f".{path.name}.{os.getpid()}.{threading.get_ident()}.tmp"
+    )
     tmp.write_text(text)
     os.replace(tmp, path)
 

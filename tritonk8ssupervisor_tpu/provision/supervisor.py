@@ -44,12 +44,14 @@ manual-heal baseline, BENCH_supervise.json).
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import dataclasses
 import json
 import os
 import random
 import signal
+import threading
 import time
 from pathlib import Path
 from typing import Callable
@@ -60,6 +62,7 @@ from tritonk8ssupervisor_tpu.provision import heal as heal_mod
 from tritonk8ssupervisor_tpu.provision import readiness
 from tritonk8ssupervisor_tpu.provision import retry
 from tritonk8ssupervisor_tpu.provision import runner as run_mod
+from tritonk8ssupervisor_tpu.provision.scheduler import Task, run_dag
 from tritonk8ssupervisor_tpu.provision.state import (
     LockHeldError,
     PidLock,
@@ -131,7 +134,13 @@ class CircuitBreaker:
     """Global heal circuit breaker: `threshold` failed heals inside
     `window_s` trip it OPEN; after a cooldown (retry.Cooldown — grows
     between consecutive trips, resets on recovery) it HALF-OPENs for one
-    probe heal whose outcome closes or re-opens it."""
+    probe heal whose outcome closes or re-opens it.
+
+    The failure window is a deque pruned from the left: `_prune` runs
+    every recorded failure, and a list rebuild there made its cost grow
+    with total history — at fleet scale (hundreds of heals on record)
+    per-tick bookkeeping must stay O(events in window), never O(events
+    ever). The perf pin lives in tests/test_supervisor.py."""
 
     def __init__(
         self,
@@ -143,13 +152,15 @@ class CircuitBreaker:
         self.window_s = float(window_s)
         self.cooldown = cooldown
         self.state = CLOSED
-        self.failures: list[float] = []  # failure timestamps in window
+        # failure timestamps inside the window, oldest first
+        self.failures: collections.deque = collections.deque()
         self.reopen_at: float | None = None
         self.trips = 0
 
     def _prune(self, now: float) -> None:
         cutoff = now - self.window_s
-        self.failures = [ts for ts in self.failures if ts > cutoff]
+        while self.failures and self.failures[0] <= cutoff:
+            self.failures.popleft()
 
     def allow(self, now: float) -> bool:
         """May a heal run now? OPEN past its cooldown transitions to
@@ -179,7 +190,7 @@ class CircuitBreaker:
         """Returns True when this success CLOSES a tripped breaker."""
         closed_it = self.state != CLOSED
         self.state = CLOSED
-        self.failures = []
+        self.failures.clear()
         self.reopen_at = None
         self.cooldown.reset()
         return closed_it
@@ -273,11 +284,17 @@ class FlapFilter:
 
     def observe(self, health: "heal_mod.FleetHealth") -> list[int]:
         """Update streaks from one diagnosis; return the heal-eligible
-        slice indices (unhealthy, not draining, streak >= threshold)."""
+        slice indices (unhealthy, not draining, streak >= threshold).
+
+        Cost is O(slices IN THIS DIAGNOSIS), and the streak dict only
+        holds slices with a live streak (healthy observations remove the
+        entry instead of zeroing it) — with the dirty-set reconcile
+        passing a handful of changed slices per tick, a 256-slice fleet
+        pays for its incidents, not its size."""
         eligible: list[int] = []
         for s in health.slices:
             if s.state == heal_mod.HEALTHY:
-                self.streaks[s.index] = 0
+                self.streaks.pop(s.index, None)
             elif s.state == heal_mod.DRAINING:
                 pass  # expected downtime: hold the streak, don't grow it
             else:
@@ -306,6 +323,13 @@ class SupervisePolicy:
     breaker_cooldown_s: float = 300.0  # base cooldown (grows per trip)
     breaker_cooldown_cap_s: float = 3600.0
     max_degraded: int = 0  # N-of-M budget the hold verdict respects
+    # ---- fleet-scale knobs (sharded reconcile, parallel heals) ----
+    page_size: int = 64  # slices per FleetSnapshot listing page
+    sweep_slices: int = 4  # slices re-diagnosed per tick beyond the
+    # dirty set — silent drift (e.g. a drain file on a listing-READY
+    # host) is caught within ceil(num_slices / sweep_slices) ticks
+    heal_workers: int = 8  # parallel slice-scoped heals per wave
+    compact_records: int = 20000  # ledger records before auto-compact
 
     _ENV = {
         "interval": ("TK8S_SUPERVISE_INTERVAL", float),
@@ -317,6 +341,10 @@ class SupervisePolicy:
         "breaker_cooldown_s": ("TK8S_SUPERVISE_BREAKER_COOLDOWN", float),
         "breaker_cooldown_cap_s": ("TK8S_SUPERVISE_BREAKER_COOLDOWN_CAP",
                                    float),
+        "page_size": ("TK8S_SUPERVISE_PAGE_SIZE", int),
+        "sweep_slices": ("TK8S_SUPERVISE_SWEEP", int),
+        "heal_workers": ("TK8S_SUPERVISE_HEAL_WORKERS", int),
+        "compact_records": ("TK8S_SUPERVISE_COMPACT", int),
     }
 
     @classmethod
@@ -330,6 +358,29 @@ class SupervisePolicy:
         return cls(**kwargs)
 
 
+# ----------------------------------------------------------- actor hooks
+
+
+class _NoHooks:
+    """Default actor-lifecycle hooks: no-ops. The parallel heal dispatch
+    brackets its worker threads with launch/begin/release so a virtual
+    clock (testing/simclock.py — whose SimClock satisfies this protocol
+    directly) can account for them; on the real wall clock nothing needs
+    accounting."""
+
+    def launch(self, *a, **k) -> None:
+        pass
+
+    def begin(self, *a, **k) -> None:
+        pass
+
+    def release(self, *a, **k) -> None:
+        pass
+
+
+_NO_HOOKS = _NoHooks()
+
+
 # -------------------------------------------------------------- supervisor
 
 
@@ -337,7 +388,30 @@ class Supervisor:
     """The reconcile loop. One instance per run; `run()` holds the
     workdir's supervisor pid lock and loops `tick()` until the tick
     budget or a stop request. Injectable clock/sleep/rng make the loop a
-    pure function of the scripted world under testing/simclock.py."""
+    pure function of the scripted world under testing/simclock.py.
+
+    Fleet-scale shape (Maple-style: many local reconcilers, one global
+    policy): the tick cost scales with the number of CHANGED slices, not
+    fleet size —
+
+    - the fleet listing arrives in bounded pages
+      (readiness.FleetSnapshot(page_size=), per-page TTL + 429 quota
+      floor), and per-slice listing signatures from it drive a DIRTY
+      SET: only slices whose listing changed, slices already known
+      unhealthy, and a slow `sweep_slices`-per-tick rotation (bounding
+      how long silent drift — a drain file on a listing-READY host —
+      can hide) get the expensive SSH/drain diagnosis;
+    - heal throughput scales with the heal budget, not 1: eligible
+      slices are dispatched as INDEPENDENT slice-scoped heals in waves
+      of `heal_workers` (scheduler.run_dag under the hood), each heal
+      charged to its own token bucket and the shared breaker — a zone
+      outage killing 32 slices converges in ceil(32/workers) heal
+      times, not 32 serial ones;
+    - the event ledger auto-compacts past `compact_records`
+      (events.EventLedger.compact — fold-to-snapshot, resume invariants
+      preserved), so a week-long run replays one record per slice, not
+      millions.
+    """
 
     def __init__(
         self,
@@ -356,6 +430,7 @@ class Supervisor:
         timer=None,
         readiness_timeout: float = 900.0,
         heal_fn=heal_mod.heal,
+        hooks=None,
     ) -> None:
         if config.mode != "tpu-vm":
             raise ConfigError(
@@ -381,10 +456,13 @@ class Supervisor:
         self._stop = False
         # the shared batched listing: ttl under the tick interval so every
         # tick observes fresh state, while the probes INSIDE one tick
-        # (diagnose + any heal readiness) share a single fetch
+        # (diagnose + any heal readiness) share a single fetch; paged so
+        # a 256-slice fleet is bounded list calls per tick, never one
+        # giant ask raced against API rate limits
         self.snapshot = readiness.FleetSnapshot(
             config, run_quiet=run_quiet,
             ttl=min(10.0, max(0.0, self.policy.interval / 2.0)),
+            page_size=self.policy.page_size,
         )
         self.flaps = FlapFilter(self.policy.flap_threshold)
         self.buckets: dict[int, TokenBucket] = {}
@@ -401,6 +479,15 @@ class Supervisor:
         self._view = events_mod.LedgerView()  # folded history (restored)
         self.job_ack = JobAckWatcher(paths.job_ack)
         self._suppress_logged: set = set()  # slices with a ledgered skip
+        # ---- dirty-set reconcile state ----
+        self._health_cache: dict[int, "heal_mod.SliceHealth"] = {}
+        self._listing_sig: dict[int, str] = {}  # slice -> listing state
+        self._sweep_cursor = 0  # round-robin full-sweep rotation
+        self._hooks = hooks if hooks is not None else _NO_HOOKS
+        # parallel heals run on worker threads: ledger folds, breaker,
+        # flap/incident bookkeeping share one re-entrant lock
+        self._mutex = threading.RLock()
+        self._ledger_records = 0  # appended + replayed, for auto-compact
 
     # ----------------------------------------------------------- plumbing
 
@@ -417,9 +504,13 @@ class Supervisor:
     def _record(self, kind: str, **fields) -> dict:
         """Append to the durable ledger AND fold into the live view —
         the status publish then costs O(view), not O(ledger): a
-        week-long loop never re-reads its own history per tick."""
-        record = self.ledger.append(kind, **fields)
-        events_mod.apply(self._view, record)
+        week-long loop never re-reads its own history per tick.
+        Serialised under the supervisor mutex: parallel heal workers
+        record concurrently, and the fold is a mutation."""
+        with self._mutex:
+            record = self.ledger.append(kind, **fields)
+            events_mod.apply(self._view, record)
+            self._ledger_records += 1
         return record
 
     def say(self, text: str) -> None:
@@ -436,12 +527,14 @@ class Supervisor:
         continue instead of resetting. Slice streaks deliberately do NOT
         survive: a restarted supervisor must re-confirm unhealth with
         fresh snapshots before it replaces anything."""
-        view = events_mod.fold(self.ledger.replay())
+        records = self.ledger.replay()
+        self._ledger_records = len(records)
+        view = events_mod.fold(records)
         for sv in view.slices.values():
             bucket = self._bucket(sv.index)
             for ts in sv.heal_starts:
                 bucket.consume_at(ts)
-        self.breaker.failures = list(view.breaker_failures)
+        self.breaker.failures = collections.deque(view.breaker_failures)
         if view.breaker_state == OPEN:
             self.breaker.state = OPEN
             self.breaker.reopen_at = view.breaker_reopen_at
@@ -464,20 +557,71 @@ class Supervisor:
 
     # --------------------------------------------------------------- tick
 
+    def _dirty_set(self) -> list[int]:
+        """The slices worth an expensive (SSH + drain) diagnosis this
+        tick: slices whose LISTING signature changed since the last tick
+        (the paged `tpu-vm list` is the cheap fleet-wide change
+        detector), slices already known not-healthy (streaks must grow
+        or clear on fresh evidence), never-diagnosed slices, plus the
+        `sweep_slices`-per-tick round-robin rotation that bounds how
+        long a listing-invisible drift (a drain file on a READY node)
+        can stay unseen. At `num_slices <= sweep_slices` every slice is
+        swept every tick — small fleets keep the PR-5 behavior exactly."""
+        n = self.config.num_slices
+        listing_sig: dict[int, str] | None = None
+        try:
+            states = self.snapshot.states()
+            listing_sig = {
+                i: states.get(f"{self.config.node_prefix}-{i}", "")
+                for i in range(n)
+            }
+        except Exception:  # noqa: BLE001 - listing down: SSH still decides
+            pass  # keep the previous signatures; the sweep still rotates
+        dirty: set[int] = set()
+        for i in range(n):
+            cached = self._health_cache.get(i)
+            if cached is None or cached.state != heal_mod.HEALTHY:
+                dirty.add(i)
+            elif (listing_sig is not None
+                  and listing_sig[i] != self._listing_sig.get(i, "")):
+                dirty.add(i)
+        for _ in range(min(max(1, self.policy.sweep_slices), n)):
+            dirty.add(self._sweep_cursor % n)
+            self._sweep_cursor = (self._sweep_cursor + 1) % n
+        if listing_sig is not None:
+            self._listing_sig = listing_sig
+        return sorted(dirty)
+
     def tick(self) -> dict:
         """One reconcile pass: observe -> judge -> (maybe) heal ->
-        publish status. Returns the observation summary."""
+        publish status. Returns the observation summary.
+
+        Incremental: only the dirty set (changed/unhealthy/swept slices)
+        is diagnosed, the flap filter and incident bookkeeping fold just
+        those observations, and the TICK record carries only the CHANGED
+        states — per-tick cost and ledger growth track incidents, not
+        fleet size."""
         now = self._clock()
         self.ticks += 1
         self.snapshot.invalidate()  # every tick sees fresh fleet state
-        health = heal_mod.diagnose(
+        dirty = self._dirty_set()
+        observed = heal_mod.diagnose(
             self.config, self.paths, run_quiet=self._run_quiet,
             ssh_user=self._ssh_user, ssh_key=self._ssh_key,
-            snapshot=self.snapshot,
+            snapshot=self.snapshot, only_slices=dirty,
         )
-        states = {str(s.index): s.state for s in health.slices}
-        self._record(events_mod.TICK, tick=self.ticks, states=states)
-        for s in health.slices:
+        for s in observed.slices:
+            self._health_cache[s.index] = s
+        health = heal_mod.FleetHealth(
+            [self._health_cache[i] for i in sorted(self._health_cache)]
+        )
+        changed = {
+            str(s.index): s.state for s in observed.slices
+            if self._last_states.get(s.index) != s.state
+        }
+        self._record(events_mod.TICK, tick=self.ticks, states=changed,
+                     observed=len(dirty))
+        for s in observed.slices:
             if self._last_states.get(s.index) != s.state:
                 self._record(
                     events_mod.VERDICT, slice=s.index, state=s.state,
@@ -505,7 +649,7 @@ class Supervisor:
         # suppresses this very tick's heal
         self.job_ack.observe(self._view, self._record, now, say=self.say)
 
-        eligible = self.flaps.observe(health)
+        eligible = self.flaps.observe(observed)
         if self._view.acked_degraded:
             # the trainer already absorbed these losses as degraded
             # continuation (past its wait budget): healing them now would
@@ -527,14 +671,16 @@ class Supervisor:
             eligible = [i for i in eligible
                         if i not in self._view.acked_degraded]
         summary = {
-            "tick": self.ticks, "ts": now, "states": states,
+            "tick": self.ticks, "ts": now,
+            "states": {str(s.index): s.state for s in health.slices},
+            "observed": list(dirty),
             "eligible": list(eligible), "healed": [], "held": False,
         }
         if eligible:
             summary.update(self._reconcile(eligible, health, now))
         elif health.degraded:
             pending = [
-                s.index for s in health.slices
+                s.index for s in observed.slices
                 if s.state not in (heal_mod.HEALTHY, heal_mod.DRAINING)
             ]
             if pending:
@@ -567,6 +713,9 @@ class Supervisor:
             self._record(events_mod.BREAKER_HALF_OPEN,
                                slices=sorted(eligible))
             self.say("  breaker half-open: one probe heal")
+            # one probe decides the breaker; the rest of the eligible
+            # set keeps its tokens for the post-probe tick
+            eligible = sorted(eligible)[:1]
         to_heal: list[int] = []
         for index in sorted(eligible):
             if self._bucket(index).try_take(now):
@@ -583,19 +732,83 @@ class Supervisor:
                 )
                 out["rate_limited"].append(index)
         if to_heal:
-            if self._heal(to_heal, health, now):
-                out["healed"] = to_heal
+            out["healed"] = self._dispatch_heals(to_heal, health, now)
         return out
+
+    def _dispatch_heals(
+        self, slices: list[int], health, now: float
+    ) -> list[int]:
+        """Order the heals: one slice-scoped heal per slice, dispatched
+        in waves of `heal_workers` concurrent workers (scheduler.run_dag
+        under the actor hooks, so the simclock drills stay
+        deterministic) — a zone outage killing K slices converges in
+        ceil(K / heal_workers) heal times, not K serial ones. Each heal
+        was already charged to its own token bucket; the shared breaker
+        is consulted between waves, so a storm of failures stops the
+        NEXT wave (in-flight heals finish — they are real repairs, not
+        retries). `heal_workers <= 1` keeps the PR-5 single combined
+        heal order (one terraform apply covering every slice). A
+        HALF-OPEN breaker dispatches exactly one probe heal."""
+        order = sorted(slices)
+        if self.breaker.state == HALF_OPEN:
+            order = order[:1]  # one probe heal decides the breaker
+        if len(order) == 1 or self.policy.heal_workers <= 1:
+            return order if self._heal(order, health, now) else []
+        healed: list[int] = []
+        width = max(1, int(self.policy.heal_workers))
+        for start in range(0, len(order), width):
+            wave = order[start:start + width]
+            wave_now = self._clock()
+            if start > 0 and not self.breaker.allow(wave_now):
+                remaining = order[start:]
+                self._record(
+                    events_mod.DEGRADED_HOLD, slices=remaining,
+                    reopen_at=self.breaker.reopen_at,
+                    max_degraded=self.policy.max_degraded,
+                )
+                self.say(
+                    f"  breaker OPEN mid-dispatch: holding degraded on "
+                    f"slice(s) {', '.join(str(i) for i in remaining)}"
+                )
+                break
+
+            def make(index: int):
+                def fn(_results: dict):
+                    self._hooks.begin()
+                    return (index,
+                            self._heal([index], health, self._clock()))
+                return fn
+
+            tasks = [Task(f"heal-slice-{i}", make(i)) for i in wave]
+            # the supervisor's own actor slot is released while it waits
+            # on the wave — on the virtual clock, time may only advance
+            # once every in-flight heal is asleep
+            self._hooks.release()
+            try:
+                results = run_dag(
+                    tasks, max_workers=len(wave),
+                    on_submit=self._hooks.launch,
+                    on_settled=self._hooks.release,
+                    echo=lambda line: None,
+                )
+            finally:
+                self._hooks.begin()
+            healed.extend(i for i, ok in results.values() if ok)
+        return sorted(healed)
 
     def _heal(self, slices: list[int], health, now: float) -> bool:
         """One heal order through the existing slice-scoped path. The
         heal-start record is fsync'd BEFORE any repair runs: a kill
         anywhere inside leaves the attempt on the ledger (spent token on
-        resume — no double-heal)."""
-        self._heal_seq += 1
-        heal_id = f"heal-{int(now)}-{self._heal_seq}"
-        self._record(events_mod.HEAL_START, id=heal_id,
-                           slices=sorted(slices), attempt=self._heal_seq)
+        resume — no double-heal). Safe to run from parallel heal
+        workers: bookkeeping (ledger folds, breaker, streaks, incidents)
+        is serialised under the supervisor mutex while the repair itself
+        runs unlocked."""
+        with self._mutex:
+            self._heal_seq += 1
+            heal_id = f"heal-{int(now)}-{self._heal_seq}"
+            self._record(events_mod.HEAL_START, id=heal_id,
+                         slices=sorted(slices), attempt=self._heal_seq)
         started = self._clock()
         phase = (self._timer.phase("supervise-heal")
                  if self._timer is not None else contextlib.nullcontext())
@@ -614,41 +827,66 @@ class Supervisor:
             # stand-in, KeyboardInterrupt) must sail through UNrecorded:
             # the orphaned heal-start IS the crash signature resume reads.
             done = self._clock()
-            self._record(
-                events_mod.HEAL_FAILED, id=heal_id, slices=sorted(slices),
-                seconds=round(done - started, 3), error=str(e)[:500],
-            )
-            self.say(f"  heal of slice(s) "
-                     f"{', '.join(str(i) for i in slices)} FAILED: {e}")
-            if self.breaker.record_failure(done):
+            with self._mutex:
                 self._record(
-                    events_mod.BREAKER_OPEN,
-                    failures=len(self.breaker.failures),
-                    window_s=self.policy.breaker_window_s,
-                    reopen_at=self.breaker.reopen_at,
-                    trip=self.breaker.trips,
+                    events_mod.HEAL_FAILED, id=heal_id,
+                    slices=sorted(slices),
+                    seconds=round(done - started, 3), error=str(e)[:500],
                 )
-                self.say(
-                    f"  circuit breaker OPEN (trip {self.breaker.trips}: "
-                    f"{len(self.breaker.failures)} failed heal(s) in "
-                    f"{self.policy.breaker_window_s:.0f}s); degraded-hold "
-                    f"until t={self.breaker.reopen_at:.0f}"
-                )
+                self.say(f"  heal of slice(s) "
+                         f"{', '.join(str(i) for i in slices)} FAILED: {e}")
+                if self.breaker.record_failure(done):
+                    self._record(
+                        events_mod.BREAKER_OPEN,
+                        failures=len(self.breaker.failures),
+                        window_s=self.policy.breaker_window_s,
+                        reopen_at=self.breaker.reopen_at,
+                        trip=self.breaker.trips,
+                    )
+                    self.say(
+                        f"  circuit breaker OPEN (trip "
+                        f"{self.breaker.trips}: "
+                        f"{len(self.breaker.failures)} failed heal(s) in "
+                        f"{self.policy.breaker_window_s:.0f}s); "
+                        "degraded-hold "
+                        f"until t={self.breaker.reopen_at:.0f}"
+                    )
             return False
         done = self._clock()
-        mttr = [round(done - self._incidents.get(i, now), 3)
-                for i in sorted(slices)]
-        for i in slices:
-            self._incidents.pop(i, None)
-            self.flaps.streaks[i] = 0  # healed: demand fresh evidence
-        self._record(
-            events_mod.HEAL_DONE, id=heal_id, slices=sorted(slices),
-            seconds=round(done - started, 3), mttr_s=mttr,
-        )
-        if self.breaker.record_success(done):
-            self._record(events_mod.BREAKER_CLOSE)
-            self.say("  circuit breaker closed (heal succeeded)")
+        with self._mutex:
+            mttr = [round(done - self._incidents.get(i, now), 3)
+                    for i in sorted(slices)]
+            for i in slices:
+                self._incidents.pop(i, None)
+                # healed: demand fresh evidence before any further heal
+                self.flaps.streaks.pop(i, None)
+            self._record(
+                events_mod.HEAL_DONE, id=heal_id, slices=sorted(slices),
+                seconds=round(done - started, 3), mttr_s=mttr,
+            )
+            if self.breaker.record_success(done):
+                self._record(events_mod.BREAKER_CLOSE)
+                self.say("  circuit breaker closed (heal succeeded)")
         return True
+
+    def _maybe_compact(self) -> None:
+        """Fold the event ledger to one snapshot record once it crosses
+        `compact_records` (between ticks — no heal in flight). A tick
+        appends O(changed slices) records, so a quiet week stays under
+        the threshold; an eventful one compacts instead of growing a
+        restart's replay without bound. The live view IS the fold, so
+        compaction costs one replay-free rewrite."""
+        limit = int(self.policy.compact_records)
+        if limit <= 0 or self._ledger_records < limit:
+            return
+        with self._mutex:
+            dropped = self.ledger.compact(view=self._view)
+            self._ledger_records = 1
+        if dropped:
+            self.say(
+                f"  event ledger compacted: {dropped + 1} records -> "
+                "1 snapshot (restart-resume state preserved)"
+            )
 
     # ------------------------------------------------------------- status
 
@@ -705,6 +943,7 @@ class Supervisor:
             while not self._stop:
                 self.tick()
                 done += 1
+                self._maybe_compact()
                 if ticks and done >= ticks:
                     break
                 self._sleep(self.policy.interval)

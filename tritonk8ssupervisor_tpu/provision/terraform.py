@@ -165,7 +165,13 @@ def apply_slices(
         run(["terraform", "init", "-input=false", "-no-color"],
             cwd=module_dir, env=env)
     run(
-        ["terraform", "apply", "-auto-approve", "-input=false", "-no-color"]
+        # -lock-timeout: the supervisor dispatches independent slice-
+        # scoped heals concurrently; a second apply QUEUES on the state
+        # lock instead of aborting with "state locked" (terraform
+        # serialises the applies; the slow parts — VM boot, readiness,
+        # converge — overlap across heal workers regardless)
+        ["terraform", "apply", "-auto-approve", "-input=false", "-no-color",
+         "-lock-timeout=600s"]
         + slice_replace_addresses(slice_indices),
         cwd=module_dir,
         env=env,
