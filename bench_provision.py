@@ -53,6 +53,7 @@ Usage::
     python bench_provision.py --warm
     python bench_provision.py --resilience [--out BENCH_resilience.json]
     python bench_provision.py --supervise [--out BENCH_supervise.json]
+    python bench_provision.py --chaos [--campaigns 25] [--out BENCH_chaos.json]
     python bench_provision.py --check [--baseline BENCH_provision.json]
 """
 
@@ -1275,6 +1276,192 @@ def run_fleetscale_benchmark(
     }
 
 
+# --------------------------------------------------------- chaos campaigns
+
+
+def run_chaos_blast_radius_drill(
+    num_slices: int = 256,
+    failure_domains: int = 8,
+    lost_domain_index: int = 3,
+    preempt_at: float = 300.0,
+    heal_workers: int = 8,
+    workdir: Path | None = None,
+) -> dict:
+    """THE blast-radius acceptance drill: a seeded domain outage kills
+    one whole failure domain (32 of 256 slices) while two unrelated
+    slices die in HEALTHY domains. The supervisor must classify the
+    correlated loss (DOMAIN_OUTAGE), open the per-domain breaker for
+    the outaged domain ONLY, keep heals flowing in the healthy domains
+    meanwhile, re-enter the dead domain via exactly ONE canary heal,
+    and drain the rest in parallel waves — with the InvariantChecker
+    finding zero violations in the ledger."""
+    from tritonk8ssupervisor_tpu.provision import events as events_mod
+    from tritonk8ssupervisor_tpu.testing import chaos
+
+    own_tmp = workdir is None
+    root = Path(workdir) if workdir is not None else Path(
+        tempfile.mkdtemp(prefix="tk8s-chaos-blast-")
+    )
+    try:
+        config = chaos.sim_config(num_slices, failure_domains)
+        lost_domain = config.domain_of(lost_domain_index)
+        domain_slices = sorted(
+            i for i, d in config.domain_map().items() if d == lost_domain
+        )
+        # two unrelated losses in OTHER domains prove heals keep flowing
+        healthy_losses = [
+            i for i in range(num_slices)
+            if config.domain_of(i) != lost_domain
+        ][:2]
+        scenario = chaos.Scenario(
+            seed=0, num_slices=num_slices,
+            failure_domains=failure_domains,
+            events=[
+                {"kind": "domain-outage", "domain": lost_domain,
+                 "at": preempt_at},
+                {"kind": "preemption-storm", "slices": healthy_losses,
+                 "at": preempt_at},
+            ],
+            max_ticks=80, mttr_bound_s=2400.0,
+        )
+        policy = chaos.default_policy()
+        policy.heal_workers = heal_workers
+        policy.heal_refill_s = 36_000.0
+        policy.page_size = 64
+        result = chaos.run_campaign(scenario, root, policy=policy)
+        records = events_mod.EventLedger(
+            chaos.RunPaths(root).events
+        ).replay()
+        outage_domains = sorted({
+            r["domain"] for r in records
+            if r["kind"] == events_mod.DOMAIN_OUTAGE
+        })
+        breaker_open_domains = sorted({
+            r["domain"] for r in records
+            if r["kind"] == events_mod.DOMAIN_BREAKER_OPEN
+        })
+        canary_starts = [r for r in records
+                        if r["kind"] == events_mod.HEAL_START
+                        and r.get("canary")]
+        closes = [r for r in records
+                  if r["kind"] == events_mod.DOMAIN_BREAKER_CLOSE
+                  and r.get("domain") == lost_domain]
+        gate_lift_ts = closes[0]["ts"] if closes else None
+        healthy_domain_dones = [
+            r for r in records if r["kind"] == events_mod.HEAL_DONE
+            if set(r["slices"]) & set(healthy_losses)
+        ]
+        heals_flowed_during_hold = bool(
+            healthy_domain_dones and gate_lift_ts is not None
+            and all(r["ts"] < gate_lift_ts for r in healthy_domain_dones)
+        )
+        dones = [r for r in records if r["kind"] == events_mod.HEAL_DONE]
+        healed = sorted({i for r in dones for i in r["slices"]})
+        domain_mttr = (
+            max(r["ts"] for r in dones) - preempt_at if dones else None
+        )
+        return {
+            "num_slices": num_slices,
+            "failure_domains": failure_domains,
+            "lost_domain": lost_domain,
+            "lost_slices": len(domain_slices),
+            "healthy_domain_losses": healthy_losses,
+            "heal_workers": heal_workers,
+            "preempt_at_s": preempt_at,
+            "outage_classified_domains": outage_domains,
+            "breaker_open_domains": breaker_open_domains,
+            "breaker_open_only_lost_domain":
+                breaker_open_domains == [lost_domain],
+            "heals_flowed_in_healthy_domains": heals_flowed_during_hold,
+            "canary_heals": len(canary_starts),
+            "exactly_one_canary": len(canary_starts) == 1,
+            "all_healed": healed == sorted(domain_slices + healthy_losses),
+            "blast_radius_mttr_s": domain_mttr,
+            "violations": result["violations"],
+            "converged": result["converged"],
+            "restarts": result["restarts"],
+        }
+    finally:
+        if own_tmp:
+            shutil.rmtree(root, ignore_errors=True)
+
+
+def run_chaos_campaigns(
+    campaigns: int = 25,
+    num_slices: int = 16,
+    failure_domains: int = 4,
+    seed0: int = 1,
+) -> dict:
+    """N seeded campaigns (testing/chaos.py): every one must converge
+    with ZERO InvariantChecker violations; the MTTR distribution is the
+    perf metric the --check gate watches."""
+    from tritonk8ssupervisor_tpu.testing import chaos
+
+    results: list = []
+    for seed in range(seed0, seed0 + campaigns):
+        scenario = chaos.generate_scenario(
+            seed, num_slices=num_slices, failure_domains=failure_domains
+        )
+        root = Path(tempfile.mkdtemp(prefix="tk8s-chaos-camp-"))
+        try:
+            results.append(chaos.run_campaign(scenario, root))
+        finally:
+            shutil.rmtree(root, ignore_errors=True)
+    mttrs = [r["mttr_s"] for r in results if r["mttr_s"] is not None]
+    violations = [v for r in results for v in r["violations"]]
+    return {
+        "campaigns": campaigns,
+        "seed_range": [seed0, seed0 + campaigns - 1],
+        "num_slices": num_slices,
+        "failure_domains": failure_domains,
+        "converged": sum(1 for r in results if r["converged"]),
+        "violations": violations,
+        "violation_count": len(violations),
+        "mttr_mean_s": (round(sum(mttrs) / len(mttrs), 1)
+                        if mttrs else None),
+        "mttr_max_s": max(mttrs) if mttrs else None,
+        "restarts": sum(r["restarts"] for r in results),
+        "domain_outages": sum(r["domain_outages"] for r in results),
+        "canaries": sum(r["canaries"] for r in results),
+        "heals_deferred": sum(r["heals_deferred"] for r in results),
+        "per_seed": [
+            {"seed": r["seed"], "events": r["events"],
+             "mttr_s": r["mttr_s"], "violations": len(r["violations"])}
+            for r in results
+        ],
+    }
+
+
+def run_chaos_benchmark(campaigns: int = 25) -> dict:
+    """The blast-radius acceptance datapoint, one BENCH-style JSON
+    document: the 32-of-256 domain-outage drill (heals keep flowing in
+    healthy domains, one canary gates re-entry) plus `campaigns` seeded
+    chaos campaigns with zero ledger-invariant violations."""
+    blast = run_chaos_blast_radius_drill()
+    sweep = run_chaos_campaigns(campaigns=campaigns)
+    return {
+        "benchmark": "provision_chaos",
+        "metric": "campaign_mttr_mean_s",
+        "unit": "seconds from first injected fault to fleet healthy, "
+                "averaged over seeded chaos campaigns (simulated; every "
+                "campaign must pass the ledger InvariantChecker with "
+                "zero violations)",
+        "model_seconds": dict(SIM_SECONDS),
+        "value": sweep["mttr_mean_s"],
+        "blast_radius": blast,
+        "campaigns": sweep,
+        "passes": bool(
+            blast["breaker_open_only_lost_domain"]
+            and blast["heals_flowed_in_healthy_domains"]
+            and blast["exactly_one_canary"]
+            and blast["all_healed"]
+            and not blast["violations"]
+            and sweep["converged"] == sweep["campaigns"]
+            and sweep["violation_count"] == 0
+        ),
+    }
+
+
 # ------------------------------------------------------ the regression gate
 
 
@@ -1283,6 +1470,7 @@ SUPERVISE_BASELINE = Path(__file__).resolve().parent / "BENCH_supervise.json"
 ELASTIC_BASELINE = Path(__file__).resolve().parent / "BENCH_elastic.json"
 FLEETSCALE_BASELINE = (Path(__file__).resolve().parent
                        / "BENCH_fleetscale.json")
+CHAOS_BASELINE = Path(__file__).resolve().parent / "BENCH_chaos.json"
 
 
 def run_check(
@@ -1291,6 +1479,7 @@ def run_check(
     supervise_baseline: Path = SUPERVISE_BASELINE,
     elastic_baseline: Path = ELASTIC_BASELINE,
     fleetscale_baseline: Path = FLEETSCALE_BASELINE,
+    chaos_baseline: Path = CHAOS_BASELINE,
 ) -> tuple[bool, list[str], dict]:
     """Re-simulate against the committed BENCH_provision.json,
     BENCH_supervise.json, BENCH_elastic.json, and BENCH_fleetscale.json:
@@ -1397,6 +1586,34 @@ def run_check(
                 "and under one reconcile interval; zone outage healed "
                 "in parallel within 4x one heal)"
             )
+
+    chaos_baseline = Path(chaos_baseline)
+    if not chaos_baseline.exists():
+        problems.append(f"baseline {chaos_baseline} missing (chaos)")
+    else:
+        committed_ch = json.loads(chaos_baseline.read_text())
+        current_ch = run_chaos_benchmark(
+            int(committed_ch.get("campaigns", {}).get("campaigns", 25))
+        )
+        current["chaos"] = current_ch
+        for violation in (
+            current_ch["campaigns"]["violations"]
+            + current_ch["blast_radius"]["violations"]
+        ):
+            problems.append(f"chaos invariant violated: {violation}")
+        compare("chaos campaign MTTR (mean)",
+                committed_ch.get("value"), current_ch["value"])
+        compare("blast-radius MTTR",
+                committed_ch.get("blast_radius", {}).get(
+                    "blast_radius_mttr_s"),
+                current_ch["blast_radius"]["blast_radius_mttr_s"])
+        if not current_ch["passes"]:
+            problems.append(
+                "chaos drill no longer passes (per-domain breaker open "
+                "only for the outaged domain, heals flowing in healthy "
+                "domains, exactly one canary, zero invariant "
+                "violations across all seeded campaigns)"
+            )
     return not problems, problems, current
 
 
@@ -1428,6 +1645,16 @@ def main(argv: list[str] | None = None) -> int:
                         "listings) and a 32-of-256 zone outage healed "
                         "by parallel slice-scoped heals "
                         "(BENCH_fleetscale.json)")
+    parser.add_argument("--chaos", action="store_true",
+                        help="run the seeded chaos campaigns: the "
+                        "32-of-256 blast-radius drill (per-domain "
+                        "breaker, canary re-entry, heals flowing in "
+                        "healthy domains) plus N seeded fault "
+                        "compositions, every one checked against the "
+                        "ledger InvariantChecker (BENCH_chaos.json)")
+    parser.add_argument("--campaigns", type=int, default=25,
+                        metavar="N", help="--chaos: seeded campaigns to "
+                        "run (default 25)")
     parser.add_argument("--check", action="store_true",
                         help="perf-regression gate: fail if the simulated "
                         "cold/warm makespan regressed >10%% vs the "
@@ -1459,6 +1686,8 @@ def main(argv: list[str] | None = None) -> int:
         result = run_elastic_benchmark(args.slices)
     elif args.fleetscale:
         result = run_fleetscale_benchmark()
+    elif args.chaos:
+        result = run_chaos_benchmark(campaigns=max(1, args.campaigns))
     elif args.warm:
         result = {
             "benchmark": "provision_warm",
@@ -1541,6 +1770,25 @@ def main(argv: list[str] | None = None) -> int:
             f"({outage['makespan_over_single_heal']:.1f}x one heal, "
             f"{outage['parallel_speedup_x']:.1f}x vs serial) -> "
             f"passes={result['passes']}",
+            file=sys.stderr,
+        )
+        return 0 if result["passes"] else 1
+    if args.chaos:
+        blast = result["blast_radius"]
+        sweep = result["campaigns"]
+        print(
+            f"\nchaos campaigns (simulated): blast radius "
+            f"{blast['lost_slices']}/{blast['num_slices']} slices of "
+            f"domain {blast['lost_domain']} -> breaker open only there="
+            f"{blast['breaker_open_only_lost_domain']}, healthy-domain "
+            f"heals flowed={blast['heals_flowed_in_healthy_domains']}, "
+            f"canaries={blast['canary_heals']}, domain MTTR "
+            f"{blast['blast_radius_mttr_s']:.0f}s; "
+            f"{sweep['campaigns']} seeded campaigns: "
+            f"{sweep['converged']} converged, "
+            f"{sweep['violation_count']} invariant violation(s), MTTR "
+            f"mean {sweep['mttr_mean_s']:.0f}s / max "
+            f"{sweep['mttr_max_s']:.0f}s -> passes={result['passes']}",
             file=sys.stderr,
         )
         return 0 if result["passes"] else 1

@@ -1,0 +1,283 @@
+"""Chaos harness (testing/chaos.py): deterministic seeded scenario
+generation, the InvariantChecker's ability to catch each forbidden
+history, a tier-1 few-seed campaign smoke, the blast-radius acceptance
+drill, and the chaos-marked 100-seed sweep."""
+
+import json
+
+import pytest
+
+from tritonk8ssupervisor_tpu.provision import events as ev
+from tritonk8ssupervisor_tpu.provision import supervisor as sup_mod
+from tritonk8ssupervisor_tpu.testing import chaos
+
+
+def checker(num_slices=8, failure_domains=4, **policy_overrides):
+    policy = chaos.default_policy()
+    for key, value in policy_overrides.items():
+        setattr(policy, key, value)
+    return chaos.InvariantChecker(
+        chaos.sim_config(num_slices, failure_domains), policy
+    )
+
+
+# ------------------------------------------------------ scenario generator
+
+
+def test_generate_scenario_deterministic_per_seed():
+    a = chaos.generate_scenario(42)
+    b = chaos.generate_scenario(42)
+    assert a == b  # same seed -> byte-identical scenario
+    c = chaos.generate_scenario(43)
+    assert a != c  # seeds actually vary the composition
+
+
+def test_generate_scenarios_cover_the_primitive_space():
+    kinds = set()
+    for seed in range(60):
+        for event in chaos.generate_scenario(seed).events:
+            kinds.add(event["kind"])
+    # every primitive shows up somewhere in a modest seed range
+    assert {"domain-outage", "preemption-storm", "quota-storm",
+            "flapping-ssh", "torn-status", "sigkill-mid-heal"} <= kinds
+
+
+# --------------------------------------------------------- the invariants
+
+
+def test_checker_flags_concurrent_double_heal():
+    records = [
+        {"ts": 10.0, "kind": ev.HEAL_START, "id": "h1", "slices": [2]},
+        {"ts": 20.0, "kind": ev.HEAL_START, "id": "h2", "slices": [2]},
+        {"ts": 30.0, "kind": ev.HEAL_DONE, "id": "h1", "slices": [2]},
+        {"ts": 40.0, "kind": ev.HEAL_DONE, "id": "h2", "slices": [2]},
+    ]
+    violations = checker().check_no_double_heal(records)
+    assert any("double-heal" in v and "h2" in v for v in violations)
+
+
+def test_checker_flags_reheal_without_fresh_evidence():
+    records = [
+        {"ts": 5.0, "kind": ev.VERDICT, "slice": 2, "state": "missing"},
+        {"ts": 10.0, "kind": ev.HEAL_START, "id": "h1", "slices": [2]},
+        {"ts": 30.0, "kind": ev.HEAL_DONE, "id": "h1", "slices": [2]},
+        {"ts": 40.0, "kind": ev.HEAL_START, "id": "h2", "slices": [2]},
+    ]
+    violations = checker().check_no_double_heal(records)
+    assert any("without a fresh unhealthy verdict" in v
+               for v in violations)
+    # with the evidence in between, the same shape is clean
+    records.insert(3, {"ts": 35.0, "kind": ev.VERDICT, "slice": 2,
+                       "state": "unready"})
+    assert checker().check_no_double_heal(records) == []
+
+
+def test_checker_orphaned_start_then_recovery_heal_is_legal():
+    """A kill-orphaned heal-start (no done/failed ever) followed by a
+    post-restart re-heal is the documented recovery path."""
+    records = [
+        {"ts": 5.0, "kind": ev.VERDICT, "slice": 1, "state": "missing"},
+        {"ts": 10.0, "kind": ev.HEAL_START, "id": "h1", "slices": [1]},
+        # SIGKILL here: h1 never closes
+        {"ts": 700.0, "kind": ev.VERDICT, "slice": 1, "state": "missing"},
+        {"ts": 710.0, "kind": ev.HEAL_START, "id": "h2", "slices": [1]},
+        {"ts": 830.0, "kind": ev.HEAL_DONE, "id": "h2", "slices": [1]},
+    ]
+    assert checker().check_no_double_heal(records) == []
+
+
+def test_checker_flags_token_overspend():
+    policy_burst = 2
+    records = [
+        {"ts": float(t), "kind": ev.HEAL_START, "id": f"h{t}",
+         "slices": [0]}
+        for t in (0, 1, 2)  # three heals in two seconds, burst 2
+    ]
+    violations = checker(heal_burst=policy_burst).check_token_conservation(
+        records
+    )
+    assert len(violations) == 1 and "token-conservation" in violations[0]
+
+
+def test_checker_flags_illegal_breaker_transitions():
+    # closing a never-opened breaker
+    bad = [{"ts": 1.0, "kind": ev.BREAKER_CLOSE}]
+    assert any("closed -> closed" in v
+               for v in checker().check_breaker_transitions(bad))
+    # half-opening a closed domain breaker
+    bad = [{"ts": 1.0, "kind": ev.DOMAIN_BREAKER_HALF_OPEN,
+            "domain": "z-fd0"}]
+    assert any("z-fd0" in v and "closed -> half-open" in v
+               for v in checker().check_breaker_transitions(bad))
+    # the legal cycle is clean, re-announced half-open included
+    good = [
+        {"ts": 1.0, "kind": ev.DOMAIN_BREAKER_OPEN, "domain": "z-fd0"},
+        {"ts": 2.0, "kind": ev.DOMAIN_BREAKER_HALF_OPEN, "domain": "z-fd0"},
+        {"ts": 3.0, "kind": ev.DOMAIN_BREAKER_HALF_OPEN, "domain": "z-fd0"},
+        {"ts": 4.0, "kind": ev.DOMAIN_BREAKER_CLOSE, "domain": "z-fd0"},
+    ]
+    assert checker().check_breaker_transitions(good) == []
+
+
+def test_checker_flags_heal_into_gated_domain():
+    """After DOMAIN_OUTAGE, a non-canary heal into the domain before its
+    canary succeeded is THE blast-radius violation."""
+    config = chaos.sim_config(8, 4)
+    domain = config.domain_of(1)  # slices 1 and 5
+    records = [
+        {"ts": 10.0, "kind": ev.DOMAIN_OUTAGE, "domain": domain,
+         "slices": [1, 5]},
+        {"ts": 20.0, "kind": ev.HEAL_START, "id": "h1", "slices": [5]},
+    ]
+    violations = checker().check_domain_canary_gate(records)
+    assert any("canary-gate" in v and "non-canary" in v
+               for v in violations)
+    # the canary itself, then post-close heals, are clean
+    good = [
+        {"ts": 10.0, "kind": ev.DOMAIN_OUTAGE, "domain": domain,
+         "slices": [1, 5]},
+        {"ts": 300.0, "kind": ev.HEAL_START, "id": "h1", "slices": [1],
+         "canary": True, "domain": domain},
+        {"ts": 420.0, "kind": ev.HEAL_DONE, "id": "h1", "slices": [1],
+         "canary": True, "domain": domain},
+        {"ts": 420.0, "kind": ev.DOMAIN_BREAKER_CLOSE, "domain": domain},
+        {"ts": 450.0, "kind": ev.HEAL_START, "id": "h2", "slices": [5]},
+    ]
+    assert checker().check_domain_canary_gate(good) == []
+
+
+def test_checker_flags_two_concurrent_canaries():
+    config = chaos.sim_config(8, 4)
+    domain = config.domain_of(0)
+    records = [
+        {"ts": 10.0, "kind": ev.DOMAIN_OUTAGE, "domain": domain,
+         "slices": [0, 4]},
+        {"ts": 300.0, "kind": ev.HEAL_START, "id": "c1", "slices": [0],
+         "canary": True, "domain": domain},
+        {"ts": 310.0, "kind": ev.HEAL_START, "id": "c2", "slices": [4],
+         "canary": True, "domain": domain},
+        {"ts": 400.0, "kind": ev.HEAL_DONE, "id": "c1", "slices": [0]},
+        {"ts": 410.0, "kind": ev.HEAL_DONE, "id": "c2", "slices": [4]},
+    ]
+    violations = checker().check_domain_canary_gate(records)
+    assert any("second canary" in v for v in violations)
+
+
+# ----------------------------------------------------- campaign smoke (t1)
+
+
+def test_campaign_smoke_few_seeds_zero_violations(tmp_path):
+    """The tier-1 chaos smoke: a handful of seeded campaigns — REAL
+    supervisor, scripted world, virtual clock — every one converging
+    healthy with zero ledger-invariant violations."""
+    for seed in (1, 3, 7):  # covers outage, kill-restart, quota storm
+        scenario = chaos.generate_scenario(seed)
+        out = chaos.run_campaign(scenario, tmp_path / f"seed-{seed}")
+        assert out["violations"] == [], (seed, out)
+        assert out["converged"] is True
+        assert out["mttr_s"] <= scenario.mttr_bound_s
+        assert out["status_parses"] is True
+
+
+def test_campaign_kill_restart_resumes_from_ledger(tmp_path):
+    """Seed 3 composes a domain outage with a SIGKILL mid-heal: the
+    campaign restarts the supervisor from its event ledger and still
+    converges — with the restart visible in the result and the invariant
+    checker happy about the orphaned heal-start."""
+    scenario = chaos.generate_scenario(3)
+    assert "sigkill-mid-heal" in [e["kind"] for e in scenario.events]
+    out = chaos.run_campaign(scenario, tmp_path)
+    assert out["restarts"] >= 1
+    assert out["converged"] is True
+    assert out["violations"] == []
+
+
+# ------------------------------------------------- acceptance drills (perf)
+
+
+@pytest.mark.perf
+def test_chaos_bench_blast_radius_isolation():
+    """THE blast-radius acceptance pin: a seeded domain outage killing
+    32/256 slices leaves heals flowing in healthy domains (per-domain
+    breaker OPEN only for the outaged domain), re-entry happens via
+    exactly one canary heal, and the ledger passes the InvariantChecker
+    with zero violations."""
+    import bench_provision
+
+    blast = bench_provision.run_chaos_blast_radius_drill()
+    assert blast["lost_slices"] == 32 and blast["num_slices"] == 256
+    assert blast["breaker_open_only_lost_domain"] is True
+    assert blast["heals_flowed_in_healthy_domains"] is True
+    assert blast["exactly_one_canary"] is True
+    assert blast["all_healed"] is True
+    assert blast["violations"] == []
+    assert blast["converged"] is True
+
+
+@pytest.mark.perf
+def test_chaos_bench_json_document(tmp_path, capsys):
+    import bench_provision
+
+    out = tmp_path / "BENCH_chaos.json"
+    assert bench_provision.main(
+        ["--chaos", "--campaigns", "3", "--out", str(out)]
+    ) == 0
+    doc = json.loads(out.read_text())
+    assert doc["benchmark"] == "provision_chaos"
+    assert doc["passes"] is True
+    assert doc["campaigns"]["violation_count"] == 0
+    assert doc["campaigns"]["converged"] == 3
+    assert "chaos campaigns (simulated)" in capsys.readouterr().err
+
+
+@pytest.mark.perf
+def test_chaos_committed_baseline_still_green():
+    """The committed BENCH_chaos.json must describe a passing run —
+    the --check gate trusts its campaign count and MTTR figures."""
+    doc = json.loads(bench_baseline().read_text())
+    assert doc["passes"] is True
+    assert doc["campaigns"]["campaigns"] >= 25
+    assert doc["campaigns"]["violation_count"] == 0
+    assert doc["blast_radius"]["exactly_one_canary"] is True
+
+
+def bench_baseline():
+    import bench_provision
+
+    return bench_provision.CHAOS_BASELINE
+
+
+# ------------------------------------------------------- 100-seed (chaos)
+
+
+@pytest.mark.chaos
+def test_chaos_hundred_seed_campaign(tmp_path):
+    """The full sweep: 100 seeded campaigns, zero violations, all
+    converged. ~40 s of wall clock — behind the chaos marker."""
+    failures = []
+    for seed in range(1, 101):
+        scenario = chaos.generate_scenario(seed)
+        out = chaos.run_campaign(scenario, tmp_path / f"seed-{seed}")
+        if out["violations"] or not out["converged"]:
+            failures.append((seed, out["events"], out["violations"]))
+    assert failures == []
+
+
+# --------------------------------------------- supervisor policy coverage
+
+
+def test_default_policy_has_domain_knobs():
+    policy = chaos.default_policy()
+    assert policy.domain_threshold >= 1
+    assert policy.domain_window_s > 0
+    assert isinstance(policy, sup_mod.SupervisePolicy)
+
+
+def test_supervise_policy_domain_env_overrides(monkeypatch):
+    monkeypatch.setenv("TK8S_SUPERVISE_DOMAIN_THRESHOLD", "5")
+    monkeypatch.setenv("TK8S_SUPERVISE_DOMAIN_WINDOW", "120")
+    monkeypatch.setenv("TK8S_SUPERVISE_QUOTA_DEFER_CAP", "450")
+    policy = sup_mod.SupervisePolicy.from_env()
+    assert policy.domain_threshold == 5
+    assert policy.domain_window_s == 120.0
+    assert policy.quota_defer_cap_s == 450.0

@@ -197,6 +197,70 @@ def test_fold_job_ack_events_and_suppression():
     assert view.acked_degraded == set()
 
 
+def domain_records():
+    """A blast-radius episode: outage classified, breaker opened, one
+    deferred heal, the canary, gate lift, full recovery."""
+    return [
+        {"ts": 30.0, "kind": ev.TICK, "tick": 1,
+         "states": {"1": "missing", "4": "missing"}},
+        {"ts": 30.0, "kind": ev.VERDICT, "slice": 1, "state": "missing",
+         "domain": "z-fd1", "streak": 1},
+        {"ts": 30.0, "kind": ev.VERDICT, "slice": 4, "state": "missing",
+         "domain": "z-fd1", "streak": 1},
+        {"ts": 60.0, "kind": ev.DOMAIN_OUTAGE, "domain": "z-fd1",
+         "slices": [1, 4], "unhealthy": 2, "threshold": 2},
+        {"ts": 60.0, "kind": ev.DOMAIN_BREAKER_OPEN, "domain": "z-fd1",
+         "reopen_at": 360.0, "trip": 1, "classified": True},
+        {"ts": 90.0, "kind": ev.HEAL_DEFERRED, "slice": 4,
+         "domain": "z-fd1", "incident_age_s": 60.0},
+        {"ts": 360.0, "kind": ev.DOMAIN_BREAKER_HALF_OPEN,
+         "domain": "z-fd1", "slice": 1},
+        {"ts": 360.0, "kind": ev.HEAL_START, "id": "c1", "slices": [1],
+         "domains": ["z-fd1"], "canary": True, "domain": "z-fd1"},
+        {"ts": 480.0, "kind": ev.HEAL_DONE, "id": "c1", "slices": [1],
+         "domains": ["z-fd1"], "canary": True, "domain": "z-fd1",
+         "mttr_s": [450.0]},
+        {"ts": 480.0, "kind": ev.DOMAIN_BREAKER_CLOSE, "domain": "z-fd1",
+         "canary": True},
+    ]
+
+
+def test_fold_domain_outage_episode():
+    view = ev.fold(domain_records())
+    assert view.domain_outages == 1
+    assert view.heals_deferred == 1
+    dv = view.domains["z-fd1"]
+    assert dv.outages == 1
+    assert dv.breaker_state == "closed"
+    assert dv.breaker_trips == 1
+    # gate lifted (breaker closed) but the EPISODE survives until the
+    # domain reads fully healthy — DOMAIN_RECOVERED ends it
+    assert dv.outage_active is True
+    ev.apply(view, {"ts": 540.0, "kind": ev.DOMAIN_RECOVERED,
+                    "domain": "z-fd1"})
+    assert view.domains["z-fd1"].outage_active is False
+    assert view.slices[1].domain == "z-fd1"
+
+    doc = ev.fleet_status(view, now=600.0)
+    assert doc["domain_outages"] == 1
+    assert doc["domains"]["z-fd1"]["breaker"] == "closed"
+    assert doc["domains"]["z-fd1"]["outages"] == 1
+    assert doc["domains"]["z-fd1"]["outage_active"] is False
+    assert doc["heals"]["deferred"] == 1
+
+
+def test_fold_heal_failed_feeds_domain_failure_window():
+    records = [
+        {"ts": 10.0, "kind": ev.HEAL_START, "id": "h1", "slices": [2],
+         "domains": ["z-fd2"]},
+        {"ts": 70.0, "kind": ev.HEAL_FAILED, "id": "h1", "slices": [2],
+         "domains": ["z-fd2"], "error": "boom"},
+    ]
+    view = ev.fold(records)
+    assert view.domains["z-fd2"].breaker_failures == [70.0]
+    assert view.breaker_failures == [70.0]  # global window records too
+
+
 # ------------------------------------------------------------- compaction
 
 
@@ -279,6 +343,95 @@ def test_compact_generation_monotonic_across_boundary(tmp_path):
     assert [p.name for p in led.path.parent.iterdir()] == [led.path.name]
 
 
+def test_compact_roundtrip_preserves_domain_state(tmp_path):
+    """The domain block survives fold-to-snapshot: breaker state, trips,
+    failure window, outage counters, AND the live episode flag — a
+    restart mid-episode must not re-classify the same outage."""
+    led = quiet_ledger(tmp_path)
+    write_records(led, domain_records()[:-1])  # breaker still half-open
+    before = ev.fold(led.replay())
+    led.compact()
+    after = ev.fold(led.replay())
+    assert after.domain_outages == before.domain_outages == 1
+    assert after.heals_deferred == before.heals_deferred == 1
+    dv_b, dv_a = before.domains["z-fd1"], after.domains["z-fd1"]
+    assert dv_a.breaker_state == dv_b.breaker_state == "half-open"
+    assert dv_a.breaker_trips == dv_b.breaker_trips
+    assert dv_a.breaker_failures == dv_b.breaker_failures
+    assert dv_a.outage_active is dv_b.outage_active is True
+    assert after.slices[1].domain == "z-fd1"
+    assert (ev.fleet_status(after, 900.0)
+            == ev.fleet_status(before, 900.0))
+
+
+PRE_DOMAIN_FIXTURE = """\
+{"kind": "supervisor-start", "pid": 7, "ts": 0.0, "v": 1}
+{"kind": "tick", "states": {"0": "healthy", "1": "healthy"}, "tick": 1, "ts": 30.0, "v": 1}
+{"kind": "verdict", "detail": "absent from the Cloud TPU listing", "slice": 1, "state": "missing", "streak": 2, "ts": 60.0, "v": 1}
+{"kind": "heal-start", "attempt": 1, "id": "heal-60-1", "slices": [1], "ts": 62.0, "v": 1}
+{"kind": "heal-done", "id": "heal-60-1", "mttr_s": [122.0], "seconds": 120.0, "slices": [1], "ts": 182.0, "v": 1}
+{"kind": "rate-limited", "retry_at": 700.0, "slice": 1, "ts": 300.0, "v": 1}
+{"kind": "breaker-open", "failures": 3, "reopen_at": 900.0, "trip": 1, "ts": 600.0, "v": 1}
+"""
+
+PRE_DOMAIN_SNAPSHOT = (
+    '{"kind": "snapshot", "ts": 500.0, "v": 1, "started": 0.0, '
+    '"stopped": null, "ticks": 12, "heals_attempted": 1, '
+    '"heals_succeeded": 1, "heals_failed": 0, "rate_limited": 1, '
+    '"held_ticks": 0, "heals_suppressed": 0, '
+    '"membership_generation": 3, "job_phase": "", '
+    '"breaker_state": "closed", "breaker_failures": [], '
+    '"pending_heals": {}, "mttr_samples": [], '
+    '"slices": {"1": {"state": "healthy", "detail": "", "since": 182.0, '
+    '"streak": 0, "heal_starts": [62.0], "heals_succeeded": 1, '
+    '"heals_failed": 0}}}\n'
+)
+
+
+def test_pre_domain_ledger_folds_and_compacts(tmp_path):
+    """Satellite backward-compat pin: a ledger written BEFORE the
+    failure-domain model — no domain tags, no DOMAIN_* kinds, snapshot
+    records without the domains/heals_deferred fields — must fold and
+    compact() without error, with the new fields at their empty
+    defaults."""
+    path = tmp_path / "old-events.jsonl"
+    path.write_text(PRE_DOMAIN_FIXTURE)
+    led = ev.EventLedger(path, echo=lambda line: None)
+    view = ev.fold(led.replay())
+    assert view.heals_attempted == 1
+    assert view.domains == {} and view.domain_outages == 0
+    assert view.heals_deferred == 0
+    assert view.slices[1].domain == ""  # untagged, not invented
+    doc = ev.fleet_status(view, now=700.0)
+    assert doc["domains"] == {} and doc["domain_outages"] == 0
+
+    assert led.compact() > 0
+    after = ev.fold(led.replay())
+    assert after.heals_attempted == 1
+    assert after.breaker_state == "open"
+    assert after.slices[1].heal_starts == [62.0]
+    # and new-era records fold on top of the compacted old history
+    led._clock = lambda: 800.0
+    led.append(ev.DOMAIN_OUTAGE, domain="z-fd0", slices=[0, 2])
+    final = ev.fold(led.replay())
+    assert final.domain_outages == 1
+    assert final.domains["z-fd0"].outage_active is True
+
+
+def test_pre_domain_snapshot_record_restores(tmp_path):
+    """A SNAPSHOT record compacted by the previous release (no domain
+    fields at all) restores wholesale with empty domain state."""
+    path = tmp_path / "old-snap.jsonl"
+    path.write_text(PRE_DOMAIN_SNAPSHOT)
+    led = ev.EventLedger(path, echo=lambda line: None)
+    view = ev.fold(led.replay())
+    assert view.heals_attempted == 1
+    assert view.membership_generation == 3
+    assert view.domains == {}
+    assert view.slices[1].domain == ""
+    assert led.compact() == 0  # already one record; still no error
+
+
 def test_compact_empty_and_single_record_noop(tmp_path):
     led = quiet_ledger(tmp_path)
     assert led.compact() == 0  # no ledger at all
@@ -307,7 +460,7 @@ def test_fleet_status_document_shape():
     assert doc["heals"] == {
         "attempted": 2, "succeeded": 1, "failed": 1,
         "rate_limited": 1, "held_ticks": 1, "suppressed": 0,
-        "in_flight": 0,
+        "deferred": 0, "in_flight": 0,
     }
     assert doc["mttr_s"]["mean"] == 180.0
     assert doc["breaker"]["state"] == "open"

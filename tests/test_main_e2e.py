@@ -399,7 +399,7 @@ def test_cli_supervise_heals_lost_slice_unattended(fake_world, capsys):
     assert status["heals"] == {
         "attempted": 1, "succeeded": 1, "failed": 0,
         "rate_limited": 0, "held_ticks": 0, "in_flight": 0,
-        "suppressed": 0,
+        "suppressed": 0, "deferred": 0,
     }
     assert status["mttr_s"]["count"] == 1
     assert main(["status", "--workdir", str(work)]) == 0
@@ -411,6 +411,35 @@ def test_cli_supervise_without_deployment_is_friendly(fake_world, capsys):
     assert main(["supervise", "--yes", "--workdir", str(work)]) == 1
     err = capsys.readouterr().err
     assert "ERROR:" in err and "provision first" in err
+
+
+def test_cli_status_surfaces_domain_outages(fake_world, capsys):
+    """Satellite: `./setup.sh status` surfaces DOMAIN_OUTAGE counts and
+    the per-domain breaker states, in both the human summary and the
+    JSON document."""
+    work, _ = fake_world
+    paths = RunPaths(work)
+    paths.fleet_status.write_text(json.dumps({
+        "verdict": "degraded-hold",
+        "supervisor": {"running": False},
+        "slice_states": {"healthy": 224, "missing": 32},
+        "slices_total": 256,
+        "slices": {}, "degraded": [], "heals": {}, "mttr_s": {},
+        "breaker": {"state": "closed"},
+        "domain_outages": 1,
+        "domains": {"us-west4-a-fd3": {
+            "breaker": "open", "trips": 1, "outages": 1,
+            "outage_active": True, "reopen_at": 900.0,
+        }},
+    }))
+    assert main(["status", "--workdir", str(work)]) == 2
+    out = capsys.readouterr().out
+    assert "domains: 1 outage(s) on record" in out
+    assert "breaker open: us-west4-a-fd3" in out
+    assert "outage active: us-west4-a-fd3" in out
+    assert main(["status", "--json", "--workdir", str(work)]) == 2
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["domain_outages"] == 1
 
 
 def test_cli_status_without_supervisor_is_friendly(fake_world, capsys):

@@ -11,10 +11,18 @@ import base64
 import subprocess
 import sys
 import tarfile
-import tomllib
 from pathlib import Path
 
+import pytest
 import yaml
+
+try:
+    import tomllib  # Python >= 3.11
+except ModuleNotFoundError:  # pragma: no cover - version-dependent
+    try:
+        import tomli as tomllib  # the 3.10-and-under backport
+    except ModuleNotFoundError:
+        tomllib = None  # only the pyproject test needs it; it skips
 
 import tritonk8ssupervisor_tpu
 from tritonk8ssupervisor_tpu import packaging
@@ -84,6 +92,8 @@ def test_archive_pip_installs_and_module_runs(tmp_path):
 
 
 def test_pyproject_version_and_pin_agree():
+    if tomllib is None:
+        pytest.skip("needs tomllib (py311+) or the tomli backport")
     data = tomllib.loads((REPO / "pyproject.toml").read_text())
     attr = data["tool"]["setuptools"]["dynamic"]["version"]["attr"]
     assert attr == "tritonk8ssupervisor_tpu.__version__"

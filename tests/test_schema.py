@@ -75,3 +75,30 @@ def test_flat_round_trip():
 def test_from_flat_ignores_unknown_keys():
     cfg = ClusterConfig.from_flat({"PROJECT": "p", "SDC_URL": "legacy"})
     assert cfg.project == "p"
+
+
+def test_failure_domains_striping_and_flat_round_trip():
+    cfg = good_config(num_slices=8, failure_domains=4)
+    cfg.validate()
+    # slices stripe modulo N; every domain gets an equal share
+    assert cfg.domain_of(0) == cfg.domain_of(4) == "us-west4-a-fd0"
+    assert cfg.domain_of(3) == "us-west4-a-fd3"
+    assert len(set(cfg.domain_map().values())) == 4
+    assert cfg.domain_slices()["us-west4-a-fd1"] == [1, 5]
+    restored = ClusterConfig.from_flat(cfg.to_flat())
+    assert restored.failure_domains == 4 and restored == cfg
+
+
+def test_failure_domains_default_is_one_domain_per_zone():
+    cfg = good_config(num_slices=4)
+    assert cfg.failure_domains == 0
+    assert set(cfg.domain_map().values()) == {"us-west4-a"}
+    # a single explicit domain is the same flat model
+    assert good_config(failure_domains=1).domain_of(0) == "us-west4-a"
+
+
+def test_failure_domains_validation():
+    with pytest.raises(ConfigError, match="failure_domains"):
+        good_config(failure_domains=-1).validate()
+    with pytest.raises(ConfigError, match="exceeds"):
+        good_config(num_slices=2, failure_domains=5).validate()

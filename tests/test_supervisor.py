@@ -51,10 +51,10 @@ class FleetSim:
     under the supervisor consumes."""
 
     def __init__(self, tmp_path, clock, num_slices=3, heal_seconds=120.0,
-                 heal_works=True):
+                 heal_works=True, failure_domains=0):
         self.paths = RunPaths(tmp_path)
         self.paths.terraform_module("tpu-vm").mkdir(parents=True)
-        self.config = cfg(num_slices)
+        self.config = cfg(num_slices, failure_domains=failure_domains)
         self.clock = clock
         self.heal_seconds = heal_seconds
         self.heal_works = heal_works
@@ -320,7 +320,7 @@ def test_preemption_drill_drain_observed_then_healed_once(tmp_path):
     assert status["heals"] == {
         "attempted": 1, "succeeded": 1, "failed": 0,
         "rate_limited": 0, "held_ticks": 0, "suppressed": 0,
-        "in_flight": 0,
+        "deferred": 0, "in_flight": 0,
     }
     assert status["mttr_s"]["last"] == pytest.approx(210.0)
     # the membership generation moved for the loss AND the return, and a
@@ -709,6 +709,211 @@ def test_parallel_heal_failures_trip_breaker_and_stop_next_wave(tmp_path):
     assert status["verdict"] == "degraded-hold"
     assert status["heals"]["attempted"] == 4
     assert status["heals"]["failed"] == 4
+
+
+# ------------------------------------- failure domains (blast radius)
+
+
+def test_domain_outage_isolates_blast_radius(tmp_path):
+    """THE blast-radius pin at unit scale: losing BOTH slices of one
+    failure domain (a correlated outage) plus one slice of another
+    domain must (a) classify DOMAIN_OUTAGE and open the per-domain
+    breaker for the outaged domain ONLY, (b) heal the healthy-domain
+    slice immediately while the outaged domain is held, (c) re-enter
+    the outaged domain via exactly ONE canary heal, then drain the
+    rest — ending fully healthy with the episode closed on the
+    ledger."""
+    clock = SimClock()
+    world = FleetSim(tmp_path, clock, num_slices=6, failure_domains=3)
+    # domains stripe i % 3: fd1 = slices {1, 4}; fd0 = {0, 3}
+    lost_domain = world.config.domain_of(1)
+    for i in (1, 4, 0):
+        world.preempt(i, at=60.0)
+    policy = sup_mod.SupervisePolicy(
+        interval=30.0, flap_threshold=2, heal_burst=2,
+        heal_refill_s=3600.0, domain_threshold=2, domain_window_s=300.0,
+        domain_cooldown_s=300.0, heal_workers=2,
+    )
+    say = Say()
+    supervisor = build(world, clock, prompter=say, policy=policy,
+                       hooks=clock)
+    run_sim(supervisor, clock, ticks=20)
+    records = ev.EventLedger(world.paths.events).replay()
+    kinds_list = [r["kind"] for r in records]
+
+    outages = [r for r in records if r["kind"] == ev.DOMAIN_OUTAGE]
+    assert [r["domain"] for r in outages] == [lost_domain]
+    assert sorted(outages[0]["slices"]) == [1, 4]
+    opens = [r for r in records if r["kind"] == ev.DOMAIN_BREAKER_OPEN]
+    assert {r["domain"] for r in opens} == {lost_domain}
+
+    # the healthy-domain slice healed WHILE the outaged domain was held
+    close = next(r for r in records
+                 if r["kind"] == ev.DOMAIN_BREAKER_CLOSE
+                 and r["domain"] == lost_domain)
+    done_healthy = next(r for r in records if r["kind"] == ev.HEAL_DONE
+                        and r["slices"] == [0])
+    assert done_healthy["ts"] < close["ts"]
+
+    # exactly one canary, and the FIRST heal into the outaged domain
+    canaries = [r for r in records if r["kind"] == ev.HEAL_START
+                and r.get("canary")]
+    assert len(canaries) == 1
+    assert canaries[0]["domain"] == lost_domain
+    first_into_domain = next(
+        r for r in records if r["kind"] == ev.HEAL_START
+        and set(r["slices"]) & {1, 4}
+    )
+    assert first_into_domain.get("canary") is True
+    assert ev.DOMAIN_RECOVERED in kinds_list
+
+    status = json.loads(world.paths.fleet_status.read_text())
+    assert status["verdict"] == "healthy"
+    assert status["slice_states"] == {"healthy": 6}
+    assert status["domain_outages"] == 1
+    assert status["domains"][lost_domain]["breaker"] == "closed"
+    assert status["domains"][lost_domain]["outages"] == 1
+    assert status["domains"][lost_domain]["outage_active"] is False
+    assert "DOMAIN OUTAGE" in say.text()
+
+    # the ledger passes the full invariant sweep
+    from tritonk8ssupervisor_tpu.testing.chaos import InvariantChecker
+
+    assert InvariantChecker(world.config, policy).check(records) == []
+
+
+def test_domain_failures_trip_domain_breaker_before_global(tmp_path):
+    """Below the classifier threshold, heal FAILURES still trip the
+    slice's domain breaker first; the global breaker (last resort)
+    accrues the domain trip — one struggling domain stops its own
+    heals without freezing the healthy domains' budget."""
+    clock = SimClock()
+    world = FleetSim(tmp_path, clock, num_slices=6, failure_domains=3,
+                     heal_works=False)
+    world.preempt(2, at=0.0)  # fd2 — and only one slice, so no outage
+    policy = sup_mod.SupervisePolicy(
+        interval=30.0, flap_threshold=2, heal_burst=3,
+        heal_refill_s=600.0, breaker_threshold=3,
+        breaker_window_s=36_000.0, breaker_cooldown_s=6_000.0,
+        domain_threshold=3, domain_cooldown_s=6_000.0, max_degraded=1,
+    )
+    supervisor = build(world, clock, policy=policy,
+                       readiness_timeout=60.0)
+    run_sim(supervisor, clock, ticks=24)
+    records = ev.EventLedger(world.paths.events).replay()
+    domain = world.config.domain_of(2)
+    opens = [r for r in records if r["kind"] == ev.DOMAIN_BREAKER_OPEN]
+    assert opens and all(r["domain"] == domain for r in opens)
+    # the domain breaker tripped on its 3rd windowed failure; the
+    # global breaker saw ONE domain-level failure — not three — and
+    # stays closed (last resort, not first responder)
+    assert ev.DOMAIN_OUTAGE not in [r["kind"] for r in records]
+    assert ev.BREAKER_OPEN not in [r["kind"] for r in records]
+    status = json.loads(world.paths.fleet_status.read_text())
+    assert status["breaker"]["state"] == "closed"
+    assert status["domains"][domain]["breaker"] in ("open", "half-open")
+
+
+def test_kill_mid_half_open_canary_resumes_breaker_open(tmp_path):
+    """Satellite crash pin: SIGKILLed while the HALF_OPEN probe heal is
+    in flight, the restarted supervisor must resume the breaker OPEN —
+    never CLOSED (and not HALF_OPEN: that would hand the restart a
+    second probe while the first one's outcome is unknown). The orphaned
+    probe stays charged; recovery then runs ONE fresh probe which
+    closes the breaker for real."""
+    from tritonk8ssupervisor_tpu.testing.chaos import InvariantChecker
+    from tritonk8ssupervisor_tpu.testing.faults import (
+        FaultPlan,
+        FaultRule,
+        SupervisorKilled,
+    )
+
+    clock = SimClock()
+    world = FleetSim(tmp_path, clock, heal_works=False)
+    world.preempt(2, at=0.0)
+    policy = sup_mod.SupervisePolicy(
+        interval=30.0, flap_threshold=2, heal_burst=3,
+        heal_refill_s=600.0, breaker_threshold=2,
+        breaker_window_s=36_000.0, breaker_cooldown_s=300.0,
+    )
+    # two failing heals trip the breaker; the THIRD terraform apply is
+    # the half-open probe — kill there, mid-canary
+    plan = FaultPlan([FaultRule(match="terraform apply", after=2,
+                                kill=True)], echo=lambda line: None)
+    world_run = world.run
+    world.run = plan.wrap(world_run)
+    supervisor = build(world, clock, policy=policy,
+                       readiness_timeout=60.0)
+    clock.begin()
+    try:
+        with pytest.raises(SupervisorKilled):
+            supervisor.run(ticks=40)
+    finally:
+        clock.release()
+    recorded = kinds(world)
+    assert ev.BREAKER_OPEN in recorded
+    assert ev.BREAKER_HALF_OPEN in recorded
+    view = ev.fold(ev.EventLedger(world.paths.events).replay())
+    assert view.breaker_state == "half-open"
+    assert len(view.open_heals) == 1  # the orphaned probe
+
+    # restart: the fold says half-open + orphan => the breaker resumes
+    # OPEN, with its reopen time preserved
+    world.run = world_run
+    world.heal_works = True
+    second = build(world, clock, policy=policy, readiness_timeout=60.0)
+    restored = second.restore()
+    assert second.breaker.state == sup_mod.OPEN
+    assert second.breaker.reopen_at == restored.breaker_reopen_at
+
+    # the recovery run proper (run() does its own restore; `second`
+    # above only inspected the fold)
+    third = build(world, clock, policy=policy, readiness_timeout=60.0)
+    run_sim(third, clock, ticks=10)
+    status = json.loads(world.paths.fleet_status.read_text())
+    assert status["verdict"] == "healthy"
+    assert status["breaker"]["state"] == "closed"
+    records = ev.EventLedger(world.paths.events).replay()
+    assert InvariantChecker(world.config, policy).check(records) == []
+
+
+def test_quota_parked_page_defers_heal(tmp_path):
+    """Satellite: while a slice's fleet-listing page is quota-parked
+    (429 backoff, stale-served), its heal is DEFERRED — the supervisor
+    must not deepen an API quota storm — and dispatched as soon as the
+    storm lifts. The deferral lands on the ledger exactly once."""
+    clock = SimClock()
+    world = FleetSim(tmp_path, clock)
+    world.preempt(1, at=0.0)
+    orig_quiet = world.run_quiet
+
+    def stormy_quiet(args, cwd=None, **kwargs):
+        if (args and args[0] == "gcloud"
+                and 10.0 <= clock.time() < 200.0):
+            raise run_mod.CommandError(
+                list(args), 1,
+                tail="Error 429: Too Many Requests (RESOURCE_EXHAUSTED)",
+            )
+        return orig_quiet(args, cwd=cwd, **kwargs)
+
+    world.run_quiet = stormy_quiet
+    policy = sup_mod.SupervisePolicy(
+        interval=30.0, flap_threshold=2, quota_defer_cap_s=600.0,
+    )
+    say = Say()
+    supervisor = build(world, clock, prompter=say, policy=policy)
+    run_sim(supervisor, clock, ticks=12)
+    records = ev.EventLedger(world.paths.events).replay()
+    deferrals = [r for r in records if r["kind"] == ev.HEAL_DEFERRED]
+    assert len(deferrals) == 1 and deferrals[0]["slice"] == 1
+    starts = [r for r in records if r["kind"] == ev.HEAL_START]
+    # no heal during the storm; the heal lands once the page unparks
+    assert starts and starts[0]["ts"] >= 200.0
+    assert world.applies == [[1]]
+    assert "quota-parked" in say.text()
+    status = json.loads(world.paths.fleet_status.read_text())
+    assert status["verdict"] == "healthy"
+    assert status["heals"]["deferred"] == 1
 
 
 # --------------------------------------- ledger compaction + restart drill

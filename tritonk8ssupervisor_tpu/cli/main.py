@@ -172,6 +172,35 @@ def build_parser() -> argparse.ArgumentParser:
         "(env TK8S_SUPERVISE_HEAL_WORKERS)",
     )
     parser.add_argument(
+        "--domain-threshold", type=int, default=None, metavar="K",
+        help="supervise: K slices of one failure domain lost within "
+        "--domain-window is classified a DOMAIN_OUTAGE — heals into "
+        "that domain are held behind its per-domain breaker and "
+        "re-entry is gated by ONE canary heal, while healthy domains "
+        "keep healing (default 3; 0 disables the classifier; domains "
+        "come from the config's FAILURE_DOMAINS striping; "
+        "env TK8S_SUPERVISE_DOMAIN_THRESHOLD)",
+    )
+    parser.add_argument(
+        "--domain-window", type=float, default=None, metavar="SECONDS",
+        help="supervise: incident-start span that counts as one "
+        "correlated domain failure (default 300; "
+        "env TK8S_SUPERVISE_DOMAIN_WINDOW)",
+    )
+    parser.add_argument(
+        "--domain-cooldown", type=float, default=None, metavar="SECONDS",
+        help="supervise: base hold before the canary heal re-enters an "
+        "outaged domain; grows between re-trips (default 300; "
+        "env TK8S_SUPERVISE_DOMAIN_COOLDOWN)",
+    )
+    parser.add_argument(
+        "--quota-defer-cap", type=float, default=None, metavar="SECONDS",
+        help="supervise: longest a heal is deferred because its "
+        "fleet-listing page is quota-parked (429 backoff floor) — past "
+        "this incident age the repair outweighs the API pressure "
+        "(default 900; env TK8S_SUPERVISE_QUOTA_DEFER_CAP)",
+    )
+    parser.add_argument(
         "--compact-records", type=int, default=None, metavar="N",
         help="supervise: auto-compact the event ledger to one snapshot "
         "record once it holds N records (default 20000; 0 disables) — "
@@ -498,6 +527,10 @@ def supervise_policy_from_args(args) -> supervisor_mod.SupervisePolicy:
         "sweep_slices": args.sweep_slices,
         "heal_workers": args.heal_workers,
         "compact_records": args.compact_records,
+        "domain_threshold": args.domain_threshold,
+        "domain_window_s": args.domain_window,
+        "domain_cooldown_s": args.domain_cooldown,
+        "quota_defer_cap_s": args.quota_defer_cap,
     }
     for field, value in overrides.items():
         if value is not None:
@@ -635,6 +668,24 @@ def status_cmd(args, paths: state.RunPaths, prompter: Prompter) -> int:
             + (f" (reopen at {breaker.get('reopen_at'):.0f})"
                if breaker.get("reopen_at") else "")
         )
+        domains = doc.get("domains") or {}
+        if domains or doc.get("domain_outages"):
+            open_domains = sorted(
+                name for name, entry in domains.items()
+                if entry.get("breaker", "closed") != "closed"
+            )
+            active = sorted(
+                name for name, entry in domains.items()
+                if entry.get("outage_active")
+            )
+            prompter.say(
+                f"domains: {doc.get('domain_outages', 0)} outage(s) on "
+                f"record across {len(domains)} tracked domain(s)"
+                + (f"; breaker open: {', '.join(open_domains)}"
+                   if open_domains else "")
+                + (f"; outage active: {', '.join(active)}"
+                   if active else "")
+            )
         membership = doc.get("membership", {})
         if membership:
             prompter.say(

@@ -215,6 +215,12 @@ def config_rows(config: ClusterConfig) -> list[tuple[str, str]]:
         ("Network", f"{config.network} / {config.subnetwork}"),
         ("Runtime version", config.effective_runtime_version),
     ]
+    if config.failure_domains > 1:
+        rows.append((
+            "Failure domains",
+            f"{config.failure_domains} (slice i -> "
+            f"{config.zone or 'zone'}-fd(i % {config.failure_domains}))",
+        ))
     if config.mode == "gke":
         rows.append(("GKE machine type", config.gke_machine_type))
     return rows

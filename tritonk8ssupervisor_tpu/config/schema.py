@@ -68,6 +68,16 @@ class ClusterConfig:
     # reference's VMs effectively ran with — as an explicit opt-in for
     # clusters that can't use WI bindings yet.
     broad_node_scopes: bool = False
+    # Failure domains: how many blast-radius compartments the slices are
+    # striped across. 0 (default) = one domain per zone — every slice
+    # shares fate (the pre-domain model, exactly). N > 1 stripes slice i
+    # into domain `<zone>-fd<i % N>`: machines that share a power feed /
+    # ToR / maintenance window share a domain, and the supervisor reacts
+    # to a CORRELATED loss (K-of-domain inside a window) with a
+    # per-domain circuit breaker + canary re-entry instead of storming
+    # heals into the dead compartment (docs/failure-modes.md, "blast
+    # radius & correlated failures").
+    failure_domains: int = 0
 
     @property
     def region(self) -> str:
@@ -108,6 +118,29 @@ class ClusterConfig:
                 f"chips on one host"
             ) from None
 
+    # ---- failure domains ----
+
+    def domain_of(self, slice_index: int) -> str:
+        """The failure domain slice `slice_index` belongs to. One domain
+        per zone by default; `failure_domains` N stripes slices modulo N
+        so every domain holds an equal share of the fleet."""
+        n = int(self.failure_domains)
+        zone = self.zone or "default"
+        if n <= 1:
+            return zone
+        return f"{zone}-fd{int(slice_index) % n}"
+
+    def domain_map(self) -> dict[int, str]:
+        """{slice index: domain name} for the whole fleet."""
+        return {i: self.domain_of(i) for i in range(self.num_slices)}
+
+    def domain_slices(self) -> dict[str, list[int]]:
+        """{domain name: sorted slice indices} — the classifier's view."""
+        grouped: dict[str, list[int]] = {}
+        for i in range(self.num_slices):
+            grouped.setdefault(self.domain_of(i), []).append(i)
+        return grouped
+
     def validate(self) -> None:
         """Raise ConfigError listing *all* problems (the reference re-prompted
         per field; batch validation serves both wizard and file-loaded configs)."""
@@ -127,6 +160,17 @@ class ClusterConfig:
             errors.append(
                 f"num_slices must be 1-{MAX_SLICES} (no HA support yet), "
                 f"got {self.num_slices}"
+            )
+        if self.failure_domains < 0:
+            errors.append(
+                f"failure_domains must be >= 0 (0 = one domain per "
+                f"zone), got {self.failure_domains}"
+            )
+        elif self.failure_domains > self.num_slices:
+            errors.append(
+                f"failure_domains {self.failure_domains} exceeds "
+                f"num_slices {self.num_slices} — a domain with no slices "
+                "cannot isolate anything"
             )
         try:
             spec = catalog.get_spec(self.generation)
@@ -153,7 +197,7 @@ class ClusterConfig:
 
     # ---- flat KEY=value round-trip (store.py uses these) ----
 
-    _INT_FIELDS = ("num_slices",)
+    _INT_FIELDS = ("num_slices", "failure_domains")
     _BOOL_FIELDS = ("broad_node_scopes",)
 
     def to_flat(self) -> dict[str, str]:

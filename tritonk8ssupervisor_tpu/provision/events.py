@@ -64,6 +64,20 @@ JOB_NOTIFIED = "job-notified"  # trainer saw the membership change
 JOB_RESUMED = "job-resumed"  # trainer is stepping again (new world)
 DEGRADED_ACK = "degraded-ack"  # trainer continues WITHOUT these slices
 HEAL_SUPPRESSED = "heal-suppressed"  # heal skipped: the job owns the loss
+# Failure-domain vocabulary (blast-radius isolation): the correlated-
+# failure classifier's verdict and the per-domain breaker's transitions.
+# A DOMAIN_OUTAGE means K-of-domain slices went unhealthy inside one
+# window — one correlated incident, not K independent faults — and the
+# domain's breaker holds heals into that domain until a single CANARY
+# heal (a HEAL_START carrying canary=true) proves the domain takes
+# repairs again. Ledgers written before these kinds existed fold
+# unchanged: the fields default empty (tests/test_events.py pins it).
+DOMAIN_OUTAGE = "domain-outage"  # K-of-domain unhealthy in a window
+DOMAIN_BREAKER_OPEN = "domain-breaker-open"
+DOMAIN_BREAKER_HALF_OPEN = "domain-breaker-half-open"  # canary gate
+DOMAIN_BREAKER_CLOSE = "domain-breaker-close"  # canary landed: gate lifts
+DOMAIN_RECOVERED = "domain-recovered"  # every slice healthy: episode over
+HEAL_DEFERRED = "heal-deferred"  # quota-parked listing page: postponed
 
 # Slice states the membership fold reasons about — mirrors
 # provision/heal.py's vocabulary (imported lazily there to avoid the
@@ -207,6 +221,26 @@ class SliceView:
     heal_starts: list = dataclasses.field(default_factory=list)  # ts list
     heals_succeeded: int = 0
     heals_failed: int = 0
+    domain: str = ""  # failure domain ("" on pre-domain ledgers)
+
+
+@dataclasses.dataclass
+class DomainView:
+    """One failure domain's folded history: its breaker state (the
+    per-domain sibling of the global breaker block) and the outage
+    record. `outage_active` is the classifier's episode flag — set by
+    DOMAIN_OUTAGE, cleared by DOMAIN_BREAKER_CLOSE — so a restarted
+    supervisor knows the domain is still under the canary gate."""
+
+    name: str
+    breaker_state: str = "closed"
+    breaker_since: float | None = None
+    breaker_reopen_at: float | None = None
+    breaker_trips: int = 0
+    breaker_failures: list = dataclasses.field(default_factory=list)  # ts
+    outages: int = 0
+    last_outage_ts: float | None = None
+    outage_active: bool = False
 
 
 @dataclasses.dataclass
@@ -226,6 +260,9 @@ class LedgerView:
     rate_limited: int = 0
     held_ticks: int = 0  # degraded-hold observations
     heals_suppressed: int = 0  # skipped: trainer acked the loss
+    heals_deferred: int = 0  # postponed: listing page quota-parked
+    domain_outages: int = 0  # correlated-failure classifications
+    domains: dict = dataclasses.field(default_factory=dict)  # str -> DomainView
     # Monotonic membership generation: bumped whenever a slice LEAVES
     # the serving set (healthy/draining -> missing/unready) or RETURNS
     # to it (missing/unready -> healthy, i.e. a heal landed — replaced
@@ -257,6 +294,9 @@ class LedgerView:
     def slice_view(self, index: int) -> SliceView:
         return self.slices.setdefault(int(index), SliceView(int(index)))
 
+    def domain_view(self, name: str) -> DomainView:
+        return self.domains.setdefault(str(name), DomainView(str(name)))
+
 
 def snapshot_fields(view: LedgerView) -> dict:
     """Serialise a LedgerView into the snapshot record's fields — the
@@ -273,6 +313,21 @@ def snapshot_fields(view: LedgerView) -> dict:
         "rate_limited": view.rate_limited,
         "held_ticks": view.held_ticks,
         "heals_suppressed": view.heals_suppressed,
+        "heals_deferred": view.heals_deferred,
+        "domain_outages": view.domain_outages,
+        "domains": {
+            dv.name: {
+                "breaker_state": dv.breaker_state,
+                "breaker_since": dv.breaker_since,
+                "breaker_reopen_at": dv.breaker_reopen_at,
+                "breaker_trips": dv.breaker_trips,
+                "breaker_failures": list(dv.breaker_failures),
+                "outages": dv.outages,
+                "last_outage_ts": dv.last_outage_ts,
+                "outage_active": dv.outage_active,
+            }
+            for dv in view.domains.values()
+        },
         "membership_generation": view.membership_generation,
         "job_phase": view.job_phase,
         "job_generation": view.job_generation,
@@ -299,6 +354,7 @@ def snapshot_fields(view: LedgerView) -> dict:
                 "heal_starts": list(sv.heal_starts),
                 "heals_succeeded": sv.heals_succeeded,
                 "heals_failed": sv.heals_failed,
+                "domain": sv.domain,
             }
             for sv in view.slices.values()
         },
@@ -317,6 +373,22 @@ def _apply_snapshot(view: LedgerView, record: dict) -> None:
     view.rate_limited = record.get("rate_limited", 0)
     view.held_ticks = record.get("held_ticks", 0)
     view.heals_suppressed = record.get("heals_suppressed", 0)
+    view.heals_deferred = record.get("heals_deferred", 0)
+    view.domain_outages = record.get("domain_outages", 0)
+    view.domains = {}
+    # snapshots from before the failure-domain model simply have no
+    # "domains" entry — they restore to the flat (global-only) view
+    for name, entry in (record.get("domains") or {}).items():
+        dv = DomainView(str(name))
+        dv.breaker_state = entry.get("breaker_state", "closed")
+        dv.breaker_since = entry.get("breaker_since")
+        dv.breaker_reopen_at = entry.get("breaker_reopen_at")
+        dv.breaker_trips = entry.get("breaker_trips", 0)
+        dv.breaker_failures = list(entry.get("breaker_failures") or [])
+        dv.outages = entry.get("outages", 0)
+        dv.last_outage_ts = entry.get("last_outage_ts")
+        dv.outage_active = bool(entry.get("outage_active", False))
+        view.domains[dv.name] = dv
     view.membership_generation = record.get("membership_generation", 1)
     view.job_phase = record.get("job_phase", "")
     view.job_generation = record.get("job_generation")
@@ -343,6 +415,7 @@ def _apply_snapshot(view: LedgerView, record: dict) -> None:
         sv.heal_starts = list(entry.get("heal_starts") or [])
         sv.heals_succeeded = entry.get("heals_succeeded", 0)
         sv.heals_failed = entry.get("heals_failed", 0)
+        sv.domain = entry.get("domain", "")
         view.slices[sv.index] = sv
     view.last_ts = record.get("last_ts")
 
@@ -392,6 +465,8 @@ def apply(view: LedgerView, record: dict) -> LedgerView:
         sv.detail = record.get("detail", "")
         sv.since = ts
         sv.streak = record.get("streak", 0)
+        if record.get("domain"):
+            sv.domain = record["domain"]
     elif kind == HEAL_START:
         view.heals_attempted += 1
         view.pending_heals[record.get("id",
@@ -413,10 +488,41 @@ def apply(view: LedgerView, record: dict) -> LedgerView:
             view.breaker_failures.append(ts)
             for index in record.get("slices", []):
                 view.slice_view(index).heals_failed += 1
+            for name in record.get("domains") or []:
+                view.domain_view(name).breaker_failures.append(ts)
     elif kind == RATE_LIMITED:
         view.rate_limited += 1
     elif kind == DEGRADED_HOLD:
         view.held_ticks += 1
+    elif kind == HEAL_DEFERRED:
+        view.heals_deferred += 1
+    elif kind == DOMAIN_OUTAGE:
+        dv = view.domain_view(record.get("domain", ""))
+        dv.outages += 1
+        dv.last_outage_ts = ts
+        dv.outage_active = True
+        view.domain_outages += 1
+    elif kind == DOMAIN_BREAKER_OPEN:
+        dv = view.domain_view(record.get("domain", ""))
+        dv.breaker_state = "open"
+        dv.breaker_since = ts
+        dv.breaker_reopen_at = record.get("reopen_at")
+        dv.breaker_trips += 1
+    elif kind == DOMAIN_BREAKER_HALF_OPEN:
+        dv = view.domain_view(record.get("domain", ""))
+        dv.breaker_state = "half-open"
+        dv.breaker_since = ts
+    elif kind == DOMAIN_BREAKER_CLOSE:
+        # the canary-gate lifts, but the outage EPISODE runs until the
+        # domain reads fully healthy (DOMAIN_RECOVERED) — otherwise the
+        # still-unhealthy remainder would re-classify as a fresh outage
+        dv = view.domain_view(record.get("domain", ""))
+        dv.breaker_state = "closed"
+        dv.breaker_since = ts
+        dv.breaker_reopen_at = None
+        dv.breaker_failures = []
+    elif kind == DOMAIN_RECOVERED:
+        view.domain_view(record.get("domain", "")).outage_active = False
     elif kind == JOB_NOTIFIED:
         view.job_phase = "notified"
         view.job_generation = record.get("generation")
@@ -565,7 +671,23 @@ def fleet_status(
             "rate_limited": view.rate_limited,
             "held_ticks": view.held_ticks,
             "suppressed": view.heals_suppressed,
+            "deferred": view.heals_deferred,
             "in_flight": len(view.open_heals),
+        },
+        # Blast-radius block: one entry per failure domain the ledger
+        # has seen (bounded — domains are counted in single digits, not
+        # slices). DOMAIN_OUTAGE counts surface here and in
+        # `./setup.sh status`.
+        "domain_outages": view.domain_outages,
+        "domains": {
+            dv.name: {
+                "breaker": dv.breaker_state,
+                "reopen_at": dv.breaker_reopen_at,
+                "trips": dv.breaker_trips,
+                "outages": dv.outages,
+                "outage_active": dv.outage_active,
+            }
+            for dv in sorted(view.domains.values(), key=lambda d: d.name)
         },
         "mttr_s": {
             "count": len(mttr),

@@ -64,6 +64,10 @@ class SliceHealth:
     state: str  # HEALTHY / MISSING / UNREADY / DRAINING
     detail: str = ""
     hosts: tuple = ()
+    # the failure domain this slice shares fate with
+    # (ClusterConfig.domain_of); "" when the caller has no config in
+    # hand — consumers must treat "" as "unknown", never as a domain
+    domain: str = ""
 
 
 @dataclasses.dataclass
@@ -79,6 +83,14 @@ class FleetHealth:
     @property
     def degraded(self) -> list:
         return [s.index for s in self.slices if s.state != HEALTHY]
+
+    def by_domain(self) -> dict:
+        """{domain: [SliceHealth, ...]} — what the correlated-failure
+        classifier (provision/supervisor.py) groups over."""
+        grouped: dict = {}
+        for s in self.slices:
+            grouped.setdefault(s.domain, []).append(s)
+        return grouped
 
     def summary(self) -> list:
         lines = []
@@ -192,26 +204,30 @@ def diagnose(
     slices = []
     for i in indices:
         name = f"{config.node_prefix}-{i}"
+        domain = config.domain_of(i)
         slice_ips = tuple(host_ips[i]) if i < len(host_ips) else ()
         if not slice_ips:
-            slices.append(SliceHealth(i, MISSING, "no hosts recorded"))
+            slices.append(SliceHealth(i, MISSING, "no hosts recorded",
+                                      domain=domain))
         elif listing and name not in listing:
             slices.append(SliceHealth(
                 i, MISSING, "absent from the Cloud TPU listing",
-                hosts=slice_ips,
+                hosts=slice_ips, domain=domain,
             ))
         elif listing and listing.get(name) != "READY":
             slices.append(SliceHealth(
-                i, UNREADY, f"TPU state {listing[name]}", hosts=slice_ips
+                i, UNREADY, f"TPU state {listing[name]}", hosts=slice_ips,
+                domain=domain,
             ))
         elif i in drains:
             slices.append(SliceHealth(i, DRAINING, drains[i],
-                                      hosts=slice_ips))
+                                      hosts=slice_ips, domain=domain))
         elif ssh_verdicts.get(i):
             slices.append(SliceHealth(i, UNREADY, ssh_verdicts[i],
-                                      hosts=slice_ips))
+                                      hosts=slice_ips, domain=domain))
         else:
-            slices.append(SliceHealth(i, HEALTHY, hosts=slice_ips))
+            slices.append(SliceHealth(i, HEALTHY, hosts=slice_ips,
+                                      domain=domain))
     return FleetHealth(slices)
 
 
@@ -337,7 +353,7 @@ def heal(
     # the record of which slices were condemned (and why) survives.
     record_quarantine(paths, {
         s.index: {"state": s.state, "detail": s.detail,
-                  "hosts": list(s.hosts)}
+                  "hosts": list(s.hosts), "domain": s.domain}
         for s in health.slices if s.index in bad
     })
     prompter.say(

@@ -439,6 +439,28 @@ class FleetSnapshot:
                 merged.update(page.states)
             return merged
 
+    def parked_slices(self, now: float | None = None) -> set:
+        """Slice indices whose listing page is currently quota-parked
+        (a 429/RESOURCE_EXHAUSTED fetch put the page behind the backoff
+        floor; its data is being served STALE). The supervisor DEFERS
+        non-urgent heals for these slices: a heal is itself a burst of
+        API calls, and dispatching it into an already-throttled API on
+        stale evidence deepens the very quota storm that parked the
+        page."""
+        with self._lock:
+            now = self._clock() if now is None else now
+            parked: set = set()
+            for page in self._pages:
+                if page.backoff_until <= now:
+                    continue
+                for name in page.names:
+                    _, _, suffix = str(name).rpartition("-")
+                    try:
+                        parked.add(int(suffix))
+                    except ValueError:
+                        continue
+            return parked
+
     def staleness(self, now: float | None = None) -> float:
         """Age of the OLDEST page's data (inf when a page has never been
         fetched) — what "how stale could this verdict be" means once

@@ -29,6 +29,16 @@ to spec through the existing slice-scoped heal path — governed by:
   that grows between consecutive trips with the retry engine's
   decorrelated-jitter formula (retry.Cooldown), then HALF-OPENs for one
   probe heal;
+- **failure-domain isolation** (blast radius): slices are striped into
+  failure domains (ClusterConfig.failure_domains); K-of-domain slices
+  lost inside one window is classified a DOMAIN_OUTAGE — one correlated
+  incident, not K independent faults — and heals into that domain are
+  held behind a PER-DOMAIN breaker whose re-entry is gated by a single
+  canary heal, while heal-eligible slices in healthy domains keep
+  draining in waves. The global breaker survives as last resort above
+  the domain breakers (it accrues domain trips and canary failures).
+  Quota-parked listing pages (429 floor) defer non-urgent heals so the
+  supervisor never deepens an API quota storm;
 - a durable **event ledger** (provision/events.py): every observation,
   verdict change, heal attempt, rate-limit refusal, and breaker
   transition is fsync'd, and a restarted supervisor REPLAYS it — heal
@@ -171,6 +181,16 @@ class CircuitBreaker:
                 return True
             return False
         return True
+
+    def trip(self, now: float) -> float:
+        """Force the breaker OPEN without a heal failure — the
+        correlated-failure classifier's move: a DOMAIN_OUTAGE verdict
+        opens the domain's breaker BEFORE any heal is stormed into the
+        dead compartment. Returns the reopen (canary) time."""
+        self.state = OPEN
+        self.trips += 1
+        self.reopen_at = now + self.cooldown.next()
+        return self.reopen_at
 
     def record_failure(self, now: float) -> bool:
         """Returns True when this failure TRIPS the breaker (closed ->
@@ -330,6 +350,19 @@ class SupervisePolicy:
     # host) is caught within ceil(num_slices / sweep_slices) ticks
     heal_workers: int = 8  # parallel slice-scoped heals per wave
     compact_records: int = 20000  # ledger records before auto-compact
+    # ---- blast-radius knobs (failure domains, quota deferral) ----
+    domain_threshold: int = 3  # K-of-domain unhealthy in the window
+    # => DOMAIN_OUTAGE: one correlated incident, not K independent
+    # faults. 0 disables the classifier (per-domain breakers then trip
+    # only on their own heal failures).
+    domain_window_s: float = 300.0  # incident-start span that counts
+    # as "correlated" — K losses spread over hours are K faults
+    domain_cooldown_s: float = 300.0  # base hold before the canary
+    # heal re-enters an outaged domain (grows per re-trip, capped by
+    # breaker_cooldown_cap_s)
+    quota_defer_cap_s: float = 900.0  # a quota-parked slice's heal is
+    # deferred at most this long — past it the incident is old enough
+    # that repair outweighs API pressure
 
     _ENV = {
         "interval": ("TK8S_SUPERVISE_INTERVAL", float),
@@ -345,6 +378,10 @@ class SupervisePolicy:
         "sweep_slices": ("TK8S_SUPERVISE_SWEEP", int),
         "heal_workers": ("TK8S_SUPERVISE_HEAL_WORKERS", int),
         "compact_records": ("TK8S_SUPERVISE_COMPACT", int),
+        "domain_threshold": ("TK8S_SUPERVISE_DOMAIN_THRESHOLD", int),
+        "domain_window_s": ("TK8S_SUPERVISE_DOMAIN_WINDOW", float),
+        "domain_cooldown_s": ("TK8S_SUPERVISE_DOMAIN_COOLDOWN", float),
+        "quota_defer_cap_s": ("TK8S_SUPERVISE_QUOTA_DEFER_CAP", float),
     }
 
     @classmethod
@@ -463,6 +500,7 @@ class Supervisor:
             config, run_quiet=run_quiet,
             ttl=min(10.0, max(0.0, self.policy.interval / 2.0)),
             page_size=self.policy.page_size,
+            clock=clock,  # quota parking must age on the LOOP's clock
         )
         self.flaps = FlapFilter(self.policy.flap_threshold)
         self.buckets: dict[int, TokenBucket] = {}
@@ -472,6 +510,16 @@ class Supervisor:
             retry.Cooldown(self.policy.breaker_cooldown_s,
                            self.policy.breaker_cooldown_cap_s, rng=rng),
         )
+        # ---- failure domains (blast-radius isolation) ----
+        # slice -> domain from the config's striping; with a single
+        # domain every per-domain mechanism is bypassed and the loop is
+        # byte-for-byte the flat PR-7 behavior.
+        self._domains: dict[int, str] = config.domain_map()
+        self._multi_domain = len(set(self._domains.values())) > 1
+        self._rng = rng
+        self.domain_breakers: dict[str, CircuitBreaker] = {}
+        self._outage_active: dict[str, bool] = {}
+        self._defer_logged: set = set()  # slices with a ledgered deferral
         self.ticks = 0
         self._heal_seq = 0
         self._last_states: dict[int, str] = {}
@@ -497,6 +545,31 @@ class Supervisor:
                 self.policy.heal_burst, self.policy.heal_refill_s
             )
         return self.buckets[index]
+
+    def _domain_breaker(self, name: str) -> CircuitBreaker:
+        """The per-domain breaker (lazily created): same windowed-failure
+        arithmetic as the global one, but its cooldown is the domain
+        re-entry hold (domain_cooldown_s) and tripping it is what the
+        DOMAIN_OUTAGE classifier does. The GLOBAL breaker stays the last
+        resort above these: it accrues a failure only when a domain
+        breaker trips (or a canary fails) — domains failing one by one
+        across the fleet still freeze everything."""
+        if name not in self.domain_breakers:
+            self.domain_breakers[name] = CircuitBreaker(
+                self.policy.breaker_threshold,
+                self.policy.breaker_window_s,
+                retry.Cooldown(self.policy.domain_cooldown_s,
+                               self.policy.breaker_cooldown_cap_s,
+                               rng=self._rng),
+            )
+        return self.domain_breakers[name]
+
+    def _slice_domains(self, slices) -> list:
+        """Sorted distinct failure domains of `slices` (multi-domain
+        mode only — flat fleets tag nothing)."""
+        if not self._multi_domain:
+            return []
+        return sorted({self._domains.get(int(i), "") for i in slices})
 
     def request_stop(self) -> None:
         self._stop = True
@@ -540,8 +613,39 @@ class Supervisor:
             self.breaker.reopen_at = view.breaker_reopen_at
             self.breaker.trips = view.breaker_trips
         elif view.breaker_state == HALF_OPEN:
-            self.breaker.state = HALF_OPEN
+            # THE crash pin: killed while the half-open probe heal was in
+            # flight (an orphaned heal-start on the ledger) must resume
+            # OPEN — never CLOSED, and not HALF_OPEN either: HALF_OPEN
+            # would hand the restart a SECOND probe while the first one's
+            # outcome is unknown. The preserved reopen_at re-arms the
+            # canary gate; a clean half-open (no orphan) resumes as-is.
             self.breaker.trips = view.breaker_trips
+            if view.open_heals:
+                self.breaker.state = OPEN
+                self.breaker.reopen_at = (view.breaker_reopen_at
+                                          if view.breaker_reopen_at
+                                          is not None else view.last_ts)
+            else:
+                self.breaker.state = HALF_OPEN
+        for name, dv in view.domains.items():
+            br = self._domain_breaker(name)
+            br.failures = collections.deque(dv.breaker_failures)
+            br.trips = dv.breaker_trips
+            orphaned_canary = any(
+                r.get("canary") and r.get("domain") == name
+                for r in view.open_heals
+            )
+            if dv.breaker_state == OPEN or (
+                dv.breaker_state == HALF_OPEN and orphaned_canary
+            ):
+                br.state = OPEN  # same kill-mid-canary pin, per domain
+                br.reopen_at = (dv.breaker_reopen_at
+                                if dv.breaker_reopen_at is not None
+                                else view.last_ts)
+            elif dv.breaker_state == HALF_OPEN:
+                br.state = HALF_OPEN
+            if dv.outage_active:
+                self._outage_active[name] = True
         self._view = view
         if view.open_heals:
             slices = sorted(
@@ -625,7 +729,7 @@ class Supervisor:
             if self._last_states.get(s.index) != s.state:
                 self._record(
                     events_mod.VERDICT, slice=s.index, state=s.state,
-                    detail=s.detail,
+                    detail=s.detail, domain=s.domain,
                     streak=self.flaps.streaks.get(s.index, 0),
                 )
                 if s.state == heal_mod.DRAINING:
@@ -641,8 +745,11 @@ class Supervisor:
             if s.state == heal_mod.HEALTHY:
                 self._incidents.pop(s.index, None)
                 self._suppress_logged.discard(s.index)
+                self._defer_logged.discard(s.index)
             else:
                 self._incidents.setdefault(s.index, now)
+        if self._multi_domain:
+            self._settle_recovered_domains(now)
 
         # the training job's acknowledgement file, folded into the ledger
         # BEFORE the heal decision so a fresh degraded-continuation ack
@@ -692,8 +799,176 @@ class Supervisor:
         self._publish(now)
         return summary
 
+    def _settle_recovered_domains(self, now: float) -> None:
+        """End an outage EPISODE once its domain reads fully healthy
+        again: the canary-gate lifted at breaker-close, but the episode
+        flag lives until recovery — otherwise the still-unhealthy
+        remainder of the domain would re-classify as a fresh outage
+        every tick. A domain that recovered WITHOUT a canary (listing
+        glitch cleared, operator healed by hand) also closes its
+        breaker here instead of holding it armed forever."""
+        for name in list(self._outage_active):
+            bad = [
+                i for i, s in self._health_cache.items()
+                if self._domains.get(i) == name
+                and s.state not in (heal_mod.HEALTHY, heal_mod.DRAINING)
+            ]
+            if bad:
+                continue
+            self._outage_active.pop(name, None)
+            br = self.domain_breakers.get(name)
+            if br is not None and br.record_success(now):
+                self._record(events_mod.DOMAIN_BREAKER_CLOSE, domain=name,
+                             recovered=True)
+                self.say(f"  domain {name}: recovered without a canary; "
+                         "breaker closed")
+            self._record(events_mod.DOMAIN_RECOVERED, domain=name)
+            self.say(f"  domain {name}: fully healthy — outage episode "
+                     "over")
+
+    def _defer_quota_parked(self, eligible: list, now: float,
+                            out: dict) -> list:
+        """Heals for slices whose listing page is quota-parked (429
+        floor, stale-served) are DEFERRED: a heal is its own burst of
+        API calls, and the evidence behind it is stale — dispatching it
+        deepens the quota storm that parked the page. The deferral is
+        bounded: past quota_defer_cap_s of incident age the repair
+        outweighs the API pressure and the heal goes through."""
+        parked = self.snapshot.parked_slices(now)
+        if not parked:
+            return eligible
+        kept: list = []
+        for index in eligible:
+            age = now - self._incidents.get(index, now)
+            if index in parked and age < self.policy.quota_defer_cap_s:
+                if index not in self._defer_logged:
+                    self._record(events_mod.HEAL_DEFERRED, slice=index,
+                                 domain=self._domains.get(index, ""),
+                                 incident_age_s=round(age, 3))
+                    self.say(
+                        f"  slice {index}: heal deferred — its listing "
+                        "page is quota-parked (429 backoff); not adding "
+                        "API load to a throttled API"
+                    )
+                    self._defer_logged.add(index)
+                out["deferred"].append(index)
+            else:
+                kept.append(index)
+        return kept
+
+    def _classify_domains(self, now: float) -> None:
+        """The correlated-failure classifier: K-of-domain slices whose
+        incidents OPENED within domain_window_s of each other is one
+        DOMAIN_OUTAGE, not K independent faults — policy switches from
+        'heal each' to 'hold the domain behind its breaker, re-enter via
+        one canary'. Runs on the raw health cache (not flap-confirmed):
+        classification is a policy input and must beat the heal wave."""
+        threshold = int(self.policy.domain_threshold)
+        if threshold <= 0:
+            return
+        by_domain: dict[str, list[int]] = {}
+        for i, s in self._health_cache.items():
+            if s.state in (heal_mod.MISSING, heal_mod.UNREADY):
+                by_domain.setdefault(
+                    self._domains.get(i, ""), []
+                ).append(i)
+        for name, bad in by_domain.items():
+            if self._outage_active.get(name) or len(bad) < threshold:
+                continue
+            opened = sorted(self._incidents.get(i, now) for i in bad)
+            window = self.policy.domain_window_s
+            correlated = any(
+                opened[j + threshold - 1] - opened[j] <= window
+                for j in range(len(opened) - threshold + 1)
+            )
+            if not correlated:
+                continue
+            self._outage_active[name] = True
+            self._record(
+                events_mod.DOMAIN_OUTAGE, domain=name, slices=sorted(bad),
+                unhealthy=len(bad), threshold=threshold, window_s=window,
+            )
+            self.say(
+                f"  DOMAIN OUTAGE: {len(bad)} slice(s) of domain {name} "
+                f"lost within {window:.0f}s — correlated failure, "
+                "holding heals into that domain behind its breaker"
+            )
+            br = self._domain_breaker(name)
+            if br.state == CLOSED:
+                br.trip(now)
+                self._record(
+                    events_mod.DOMAIN_BREAKER_OPEN, domain=name,
+                    reopen_at=br.reopen_at, trip=br.trips,
+                    classified=True,
+                )
+                self.say(
+                    f"  domain {name} breaker OPEN (classified outage); "
+                    f"canary heal at t={br.reopen_at:.0f}"
+                )
+
+    def _gate_domains(
+        self, eligible: list, now: float, out: dict
+    ) -> tuple[list, dict]:
+        """Consult each eligible slice's DOMAIN breaker. Returns the
+        slices allowed through plus {slice: domain} for the canaries —
+        a domain past its hold gets EXACTLY one canary heal; its other
+        slices stay held until the canary proves the domain takes
+        repairs again. Healthy domains pass through untouched, so one
+        dead compartment never starves the rest of the fleet."""
+        allowed: list = []
+        canaries: dict = {}
+        grouped: dict[str, list] = {}
+        for index in sorted(eligible):
+            grouped.setdefault(self._domains.get(index, ""),
+                               []).append(index)
+        for name, slices in sorted(grouped.items()):
+            br = self.domain_breakers.get(name)
+            if br is None or br.state == CLOSED:
+                allowed.extend(slices)
+                continue
+            if not br.allow(now):
+                self._record(
+                    events_mod.DEGRADED_HOLD, slices=slices, domain=name,
+                    reopen_at=br.reopen_at,
+                    max_degraded=self.policy.max_degraded,
+                )
+                self.say(
+                    f"  domain {name} breaker OPEN: holding slice(s) "
+                    f"{', '.join(str(i) for i in slices)} "
+                    f"(canary at t={br.reopen_at:.0f})"
+                )
+                out["held"] = True
+                continue
+            # allow() flipped (or found) the breaker HALF_OPEN: one
+            # canary re-enters; the rest keep their tokens and wait
+            canary = slices[0]
+            self._record(events_mod.DOMAIN_BREAKER_HALF_OPEN,
+                         domain=name, slice=canary)
+            self.say(f"  domain {name} breaker half-open: one canary "
+                     f"heal (slice {canary})")
+            allowed.append(canary)
+            canaries[canary] = name
+            rest = slices[1:]
+            if rest:
+                self._record(
+                    events_mod.DEGRADED_HOLD, slices=rest, domain=name,
+                    reopen_at=br.reopen_at,
+                    max_degraded=self.policy.max_degraded,
+                )
+                out["held"] = True
+        return allowed, canaries
+
     def _reconcile(self, eligible: list[int], health, now: float) -> dict:
-        out: dict = {"healed": [], "held": False, "rate_limited": []}
+        out: dict = {"healed": [], "held": False, "rate_limited": [],
+                     "deferred": [], "canary": []}
+        eligible = self._defer_quota_parked(sorted(eligible), now, out)
+        canaries: dict = {}
+        if self._multi_domain:
+            self._classify_domains(now)
+            eligible, canaries = self._gate_domains(eligible, now, out)
+            out["canary"] = sorted(canaries)
+        if not eligible:
+            return out
         if not self.breaker.allow(now):
             self._record(
                 events_mod.DEGRADED_HOLD, slices=sorted(eligible),
@@ -732,11 +1007,14 @@ class Supervisor:
                 )
                 out["rate_limited"].append(index)
         if to_heal:
-            out["healed"] = self._dispatch_heals(to_heal, health, now)
+            canaries = {i: d for i, d in canaries.items() if i in to_heal}
+            out["healed"] = self._dispatch_heals(to_heal, health, now,
+                                                 canaries=canaries)
         return out
 
     def _dispatch_heals(
-        self, slices: list[int], health, now: float
+        self, slices: list[int], health, now: float,
+        canaries: dict | None = None,
     ) -> list[int]:
         """Order the heals: one slice-scoped heal per slice, dispatched
         in waves of `heal_workers` concurrent workers (scheduler.run_dag
@@ -749,11 +1027,15 @@ class Supervisor:
         retries). `heal_workers <= 1` keeps the PR-5 single combined
         heal order (one terraform apply covering every slice). A
         HALF-OPEN breaker dispatches exactly one probe heal."""
+        canaries = canaries or {}
         order = sorted(slices)
         if self.breaker.state == HALF_OPEN:
             order = order[:1]  # one probe heal decides the breaker
         if len(order) == 1 or self.policy.heal_workers <= 1:
-            return order if self._heal(order, health, now) else []
+            ok = self._heal(order, health, now,
+                            canary_domain=canaries.get(order[0])
+                            if len(order) == 1 else None)
+            return order if ok else []
         healed: list[int] = []
         width = max(1, int(self.policy.heal_workers))
         for start in range(0, len(order), width):
@@ -776,7 +1058,8 @@ class Supervisor:
                 def fn(_results: dict):
                     self._hooks.begin()
                     return (index,
-                            self._heal([index], health, self._clock()))
+                            self._heal([index], health, self._clock(),
+                                       canary_domain=canaries.get(index)))
                 return fn
 
             tasks = [Task(f"heal-slice-{i}", make(i)) for i in wave]
@@ -796,19 +1079,26 @@ class Supervisor:
             healed.extend(i for i, ok in results.values() if ok)
         return sorted(healed)
 
-    def _heal(self, slices: list[int], health, now: float) -> bool:
+    def _heal(self, slices: list[int], health, now: float,
+              canary_domain: str | None = None) -> bool:
         """One heal order through the existing slice-scoped path. The
         heal-start record is fsync'd BEFORE any repair runs: a kill
         anywhere inside leaves the attempt on the ledger (spent token on
-        resume — no double-heal). Safe to run from parallel heal
-        workers: bookkeeping (ledger folds, breaker, streaks, incidents)
-        is serialised under the supervisor mutex while the repair itself
+        resume — no double-heal; an orphaned CANARY start resumes the
+        domain breaker OPEN). Safe to run from parallel heal workers:
+        bookkeeping (ledger folds, breakers, streaks, incidents) is
+        serialised under the supervisor mutex while the repair itself
         runs unlocked."""
+        domains = self._slice_domains(slices)
+        extra = {"domains": domains} if domains else {}
+        if canary_domain:
+            extra.update(canary=True, domain=canary_domain)
         with self._mutex:
             self._heal_seq += 1
             heal_id = f"heal-{int(now)}-{self._heal_seq}"
             self._record(events_mod.HEAL_START, id=heal_id,
-                         slices=sorted(slices), attempt=self._heal_seq)
+                         slices=sorted(slices), attempt=self._heal_seq,
+                         **extra)
         started = self._clock()
         phase = (self._timer.phase("supervise-heal")
                  if self._timer is not None else contextlib.nullcontext())
@@ -832,10 +1122,33 @@ class Supervisor:
                     events_mod.HEAL_FAILED, id=heal_id,
                     slices=sorted(slices),
                     seconds=round(done - started, 3), error=str(e)[:500],
+                    **extra,
                 )
                 self.say(f"  heal of slice(s) "
                          f"{', '.join(str(i) for i in slices)} FAILED: {e}")
-                if self.breaker.record_failure(done):
+                # Breaker hierarchy: multi-domain fleets charge the
+                # failure to the slice's DOMAIN breaker first; the
+                # GLOBAL breaker (last resort) accrues one failure only
+                # when a domain breaker trips or a canary fails — so one
+                # struggling domain stops ITS heals, while domains
+                # failing across the fleet still freeze everything.
+                # Flat fleets feed the global breaker directly (the
+                # pre-domain behavior, exactly).
+                feed_global = not domains
+                for name in domains:
+                    br = self._domain_breaker(name)
+                    if br.record_failure(done):
+                        feed_global = True
+                        self._record(
+                            events_mod.DOMAIN_BREAKER_OPEN, domain=name,
+                            failures=len(br.failures),
+                            reopen_at=br.reopen_at, trip=br.trips,
+                        )
+                        self.say(
+                            f"  domain {name} breaker OPEN (trip "
+                            f"{br.trips}); canary at t={br.reopen_at:.0f}"
+                        )
+                if feed_global and self.breaker.record_failure(done):
                     self._record(
                         events_mod.BREAKER_OPEN,
                         failures=len(self.breaker.failures),
@@ -863,7 +1176,23 @@ class Supervisor:
             self._record(
                 events_mod.HEAL_DONE, id=heal_id, slices=sorted(slices),
                 seconds=round(done - started, 3), mttr_s=mttr,
+                **extra,
             )
+            for name in domains:
+                br = self.domain_breakers.get(name)
+                if br is not None and br.record_success(done):
+                    # the EPISODE flag (_outage_active) deliberately
+                    # stays set until the whole domain reads healthy
+                    # (_settle_recovered_domains) — only the gate lifts
+                    self._record(events_mod.DOMAIN_BREAKER_CLOSE,
+                                 domain=name,
+                                 canary=bool(canary_domain == name))
+                    self.say(
+                        f"  domain {name} breaker closed "
+                        + ("(canary heal succeeded — re-entering the "
+                           "domain)" if canary_domain == name
+                           else "(heal succeeded)")
+                    )
             if self.breaker.record_success(done):
                 self._record(events_mod.BREAKER_CLOSE)
                 self.say("  circuit breaker closed (heal succeeded)")
@@ -929,6 +1258,7 @@ class Supervisor:
                 heal_refill_s=self.policy.heal_refill_s,
                 breaker_threshold=self.policy.breaker_threshold,
                 max_degraded=self.policy.max_degraded,
+                failure_domains=len(set(self._domains.values())),
             )
             self.say(
                 f"supervising {self.config.num_slices} slice(s) every "
