@@ -54,7 +54,16 @@ Usage::
     python bench_provision.py --resilience [--out BENCH_resilience.json]
     python bench_provision.py --supervise [--out BENCH_supervise.json]
     python bench_provision.py --chaos [--campaigns 25] [--out BENCH_chaos.json]
+    python bench_provision.py --serve [--out BENCH_serve.json]
     python bench_provision.py --check [--baseline BENCH_provision.json]
+
+The serving drills (`--serve`) put the continuous-batching gateway
+(serving/gateway.py) under a SimClock open-loop arrival model — a
+diurnal rate curve with burst storms, request-at-a-time vs continuous
+batching over the SAME stream, a mid-run slice outage it must route
+around, and a breaker-open hold it must shed — reporting p50/p99
+latency, queue depth, tokens/sec/chip, and goodput during the outage
+(BENCH_serve.json, gated by --check like every other drill).
 """
 
 from __future__ import annotations
@@ -1462,6 +1471,319 @@ def run_chaos_benchmark(campaigns: int = 25) -> dict:
     }
 
 
+# --------------------------------------------------------- serving drills
+
+
+def _serve_status_doc(now, num_slices, generation, down=(), draining=(),
+                      healing=False, shed=False):
+    """A fleet-status document with the blocks the gateway routes on
+    (membership + serving), shaped like events.fleet_status emits it.
+    The bench scripts the SUPERVISOR side deterministically; the
+    gateway consumes the real file through the real reader — the
+    contract under test is the read side."""
+    down = sorted(down)
+    draining = sorted(draining)
+    degraded = sorted(set(down) | set(draining))
+    avoid = {str(i): "missing" for i in down}
+    avoid.update({str(i): "draining" for i in draining})
+    verdict = "degraded-hold" if shed else (
+        "recovering" if healing else
+        ("degraded" if degraded else "healthy")
+    )
+    return {
+        "v": 1,
+        "updated": now,
+        "verdict": verdict,
+        "slices_total": num_slices,
+        "membership": {"generation": generation,
+                       "heal_in_progress": healing,
+                       "draining": draining},
+        "degraded": degraded,
+        "serving": {
+            "eligible": [i for i in range(num_slices)
+                         if i not in set(degraded)],
+            "avoid": avoid,
+            "shed": shed,
+        },
+    }
+
+
+def run_serve_scenario(
+    num_slices: int = 4,
+    slots: int = 8,
+    prefill_chunk: int = 64,
+    duration_s: float = 1200.0,
+    base_rps: float = 7.0,
+    diurnal_amplitude: float = 0.3,
+    bursts: tuple = (),
+    outage: dict | None = None,
+    shed_window: tuple | None = None,
+    queue_budget: int = 64,
+    seed: int = 11,
+    workdir: Path | None = None,
+) -> dict:
+    """One open-loop traffic drive against the gateway on a virtual
+    clock. `slots=1` + whole-bucket prefill IS the request-at-a-time
+    baseline — same gateway, same queue, same SLO budget, only the
+    batching differs, so the comparison isolates continuous batching.
+
+    `outage={"slice": i, "at": t, "detect_s": d, "heal_s": h}` scripts
+    a mid-run slice loss: the engine dies at t (its in-flight freezes —
+    exactly a preemption's exposure), the supervisor's status reports
+    the loss at t+d with a membership generation bump (the gateway
+    requeues the frozen work and routes around), and the heal lands at
+    t+d+h (eligible again, generation bumps back up). `shed_window=
+    (t0, t1)` scripts a breaker-open hold instead."""
+    from tritonk8ssupervisor_tpu.provision import events as events_mod
+    from tritonk8ssupervisor_tpu.provision.fleetview import FileHealthSource
+    from tritonk8ssupervisor_tpu.serving import gateway as gw_mod
+    from tritonk8ssupervisor_tpu.serving import traffic as traffic_mod
+
+    own_tmp = workdir is None
+    root = Path(workdir) if workdir is not None else Path(
+        tempfile.mkdtemp(prefix="tk8s-serve-drill-")
+    )
+    try:
+        status_path = root / "fleet-status.json"
+        cost = gw_mod.DecodeCostModel()
+        policy = gw_mod.GatewayPolicy(
+            max_seq_len=512,
+            slots_per_slice=slots,
+            prefill_chunk=prefill_chunk,
+            queue_budget=queue_budget,
+            bucket_bounds=(64, 128, 256),
+            poll_every_s=1.0,
+        )
+        clock = SimClock()
+        engines = {
+            i: gw_mod.ModeledEngine(slots=slots,
+                                    prefill_chunk=prefill_chunk,
+                                    cost=cost)
+            for i in range(num_slices)
+        }
+        gateway = gw_mod.Gateway(
+            engines, FileHealthSource(status_path), policy=policy,
+            clock=clock.time,
+        )
+        model = traffic_mod.TrafficModel(
+            base_rps=base_rps, diurnal_amplitude=diurnal_amplitude,
+            diurnal_period_s=600.0, bursts=tuple(bursts), seed=seed,
+        )
+        arrivals = traffic_mod.generate_arrivals(model, duration_s)
+
+        def write_status(**kwargs):
+            def fn(_gateway):
+                events_mod.write_fleet_status(
+                    status_path,
+                    _serve_status_doc(clock.time(), num_slices, **kwargs),
+                )
+            return fn
+
+        events: list = [traffic_mod.WorldEvent(0.0, write_status(
+            generation=1))]
+        window = None
+        if outage is not None:
+            lost = outage["slice"]
+            t0 = outage["at"]
+            t_detect = t0 + outage.get("detect_s", 30.0)
+            t_heal = t_detect + outage.get("heal_s", 120.0)
+            window = (t0, t_heal)
+            events += [
+                traffic_mod.WorldEvent(
+                    t0, lambda g: g.workers[lost].fail()),
+                traffic_mod.WorldEvent(t_detect, write_status(
+                    generation=2, down=(lost,), healing=True)),
+                traffic_mod.WorldEvent(
+                    t_heal, lambda g: g.workers[lost].revive()),
+                traffic_mod.WorldEvent(t_heal, write_status(
+                    generation=3)),
+            ]
+        if shed_window is not None:
+            t0, t1 = shed_window
+            window = (t0, t1)
+            events += [
+                traffic_mod.WorldEvent(t0, write_status(
+                    generation=1, shed=True)),
+                traffic_mod.WorldEvent(t1, write_status(generation=1)),
+            ]
+
+        clock.begin()
+        try:
+            report = traffic_mod.drive_open_loop(
+                gateway, arrivals, clock, duration_s, events=tuple(events),
+            )
+        finally:
+            clock.release()
+
+        chips = num_slices * cost.chips_per_slice
+        span = max(duration_s, report["drive_end_s"])
+        tokens = report["tokens_generated"]
+        m = gateway.metrics
+        sheds = [r for r in m.rejected
+                 if r["reason"] in (gw_mod.REJECT_OVERLOAD,
+                                    gw_mod.REJECT_BREAKER,
+                                    gw_mod.REJECT_NO_CAPACITY)]
+        shed_slack = 120.0
+        sheds_outside_window = (
+            [r for r in sheds
+             if not (window[0] <= r["ts"] <= window[1] + shed_slack)]
+            if window is not None else list(sheds)
+        )
+        overload_without_depth = [
+            r for r in sheds
+            if r["reason"] == gw_mod.REJECT_OVERLOAD
+            and r["depth"] < queue_budget
+        ]
+        result = {
+            "num_slices": num_slices,
+            "chips": chips,
+            "slots_per_slice": slots,
+            "prefill_chunk": prefill_chunk,
+            "duration_s": duration_s,
+            "offered_requests": report["offered"],
+            "completed": report["completed"],
+            "rejected": report["rejected"],
+            "requeued_after_slice_loss":
+                report["requeued_after_slice_loss"],
+            "tokens_generated": tokens,
+            "tokens_per_sec": round(tokens / span, 3),
+            "tokens_per_sec_per_chip": round(tokens / span / chips, 3),
+            "p50_latency_s": report["p50_latency_s"],
+            "p99_latency_s": report["p99_latency_s"],
+            "max_queue_depth": report["max_queue_depth"],
+            "final_queue_depth": report["final_queue_depth"],
+            "quiescent": report["quiescent"],
+            "sheds": len(sheds),
+            "sheds_outside_demand_window": len(sheds_outside_window),
+            "overload_sheds_below_budget": len(overload_without_depth),
+        }
+        if outage is not None:
+            t0, t_heal = window
+            in_window = [r for r in m.completed
+                         if r.done_at is not None
+                         and t0 <= r.done_at <= t_heal]
+            goodput = sum(r.generated for r in in_window) / (t_heal - t0)
+            pre = [r for r in m.completed
+                   if r.done_at is not None and r.done_at < t0]
+            nominal = (sum(r.generated for r in pre) / t0) if pre else None
+            result.update({
+                "outage": dict(outage),
+                "outage_window_s": [t0, t_heal],
+                "goodput_tokens_per_sec_during_outage": round(goodput, 3),
+                "nominal_tokens_per_sec_before_outage":
+                    round(nominal, 3) if nominal else None,
+                "goodput_over_nominal": (
+                    round(goodput / nominal, 4) if nominal else None
+                ),
+            })
+        if shed_window is not None:
+            t0, t1 = window
+            accepted_in_window = [
+                ts for ts, _rid in m.accepted if t0 <= ts < t1
+            ]
+            breaker_rejects = [r for r in m.rejected
+                               if r["reason"] == gw_mod.REJECT_BREAKER]
+            result.update({
+                "shed_window_s": [t0, t1],
+                "breaker_rejects": len(breaker_rejects),
+                "breaker_rejects_inside_window": len(
+                    [r for r in breaker_rejects if t0 <= r["ts"] < t1]
+                ),
+                # depth_samples record enqueues; any inside the hold
+                # means the breaker gate leaked an admission
+                "admitted_during_hold": len(accepted_in_window),
+            })
+        return result
+    finally:
+        if own_tmp:
+            shutil.rmtree(root, ignore_errors=True)
+
+
+def run_serve_benchmark(num_slices: int = 4) -> dict:
+    """The serving-gateway acceptance datapoint, one BENCH-style JSON
+    document. Four drives of the SAME open-loop arrival stream:
+
+    - request-at-a-time (slots=1, whole-bucket prefill): the baseline;
+    - continuous batching (8 slots, chunked prefill): must sustain
+      >= 2x the baseline's tokens/sec at equal or better p99;
+    - continuous + a mid-run slice outage (detect 30 s, heal 120 s —
+      the PR-5 unattended-MTTR shape): the gateway requeues the lost
+      slice's in-flight work, routes around it, sheds only while the
+      SLO budget demands, and drains back to quiescent;
+    - a breaker-open hold: every request inside the window refused
+      429-style with retry-after, zero admissions leak through.
+    """
+    common = dict(num_slices=num_slices, duration_s=1200.0,
+                  base_rps=7.0, queue_budget=64, seed=11)
+    rat = run_serve_scenario(slots=1, prefill_chunk=256, **common)
+    cont = run_serve_scenario(
+        slots=8, prefill_chunk=64,
+        bursts=((300.0, 60.0, 1.6), (800.0, 60.0, 1.6)), **common
+    )
+    # load chosen to sit BETWEEN (N-1)- and N-slice capacity during
+    # the outage window (which rides the diurnal high): losing one
+    # slice makes the SLO budget bind (sheds must appear) and the heal
+    # makes it stop binding (sheds must stop) — both directions of
+    # "sheds only while demanded" are exercised, not vacuous. Modeled
+    # capacity: ~612 tok/s at 4 slices, ~458 at 3 (the saturation
+    # probe); offered rides 398..538 tok/s, so the budget binds ONLY
+    # while the fleet is a slice short.
+    outage = run_serve_scenario(
+        slots=8, prefill_chunk=64, base_rps=9.0,
+        diurnal_amplitude=0.15,
+        duration_s=1200.0, num_slices=num_slices, queue_budget=64,
+        seed=11,
+        outage={"slice": 2, "at": 690.0, "detect_s": 30.0,
+                "heal_s": 120.0},
+    )
+    breaker = run_serve_scenario(
+        slots=8, prefill_chunk=64, base_rps=2.0, duration_s=360.0,
+        num_slices=num_slices, queue_budget=64, seed=11,
+        shed_window=(120.0, 240.0),
+    )
+    speedup = (round(cont["tokens_per_sec"] / rat["tokens_per_sec"], 3)
+               if rat["tokens_per_sec"] else None)
+    passes = bool(
+        speedup is not None and speedup >= 2.0
+        and cont["p99_latency_s"] is not None
+        and rat["p99_latency_s"] is not None
+        and cont["p99_latency_s"] <= rat["p99_latency_s"]
+        and cont["quiescent"]
+        and cont["overload_sheds_below_budget"] == 0
+        # outage: bounded tail, no stranded work, sheds only while the
+        # lost capacity makes the budget demand it, goodput holds
+        and outage["quiescent"]
+        and outage["requeued_after_slice_loss"] > 0
+        and outage["p99_latency_s"] is not None
+        and outage["p99_latency_s"] <= 60.0
+        and outage["sheds_outside_demand_window"] == 0
+        and outage["overload_sheds_below_budget"] == 0
+        and (outage["goodput_over_nominal"] or 0) >= 0.5
+        # breaker: the hold is absolute and bounded to the window
+        and breaker["admitted_during_hold"] == 0
+        and breaker["breaker_rejects"] > 0
+        and breaker["breaker_rejects"]
+        == breaker["breaker_rejects_inside_window"]
+        and breaker["quiescent"]
+    )
+    return {
+        "benchmark": "serving_gateway",
+        "metric": "continuous_over_request_at_a_time_tokens_per_sec",
+        "unit": "x (same open-loop arrival stream, same SLO budget; "
+                "simulated on the decode cost model — continuous "
+                "batching must sustain >= 2x at equal or better p99)",
+        "num_slices": num_slices,
+        "value": speedup,
+        "tokens_per_sec_per_chip": cont["tokens_per_sec_per_chip"],
+        "p99_latency_s": cont["p99_latency_s"],
+        "request_at_a_time": rat,
+        "continuous": cont,
+        "outage": outage,
+        "breaker": breaker,
+        "passes": passes,
+    }
+
+
 # ------------------------------------------------------ the regression gate
 
 
@@ -1471,6 +1793,7 @@ ELASTIC_BASELINE = Path(__file__).resolve().parent / "BENCH_elastic.json"
 FLEETSCALE_BASELINE = (Path(__file__).resolve().parent
                        / "BENCH_fleetscale.json")
 CHAOS_BASELINE = Path(__file__).resolve().parent / "BENCH_chaos.json"
+SERVE_BASELINE = Path(__file__).resolve().parent / "BENCH_serve.json"
 
 
 def run_check(
@@ -1480,6 +1803,7 @@ def run_check(
     elastic_baseline: Path = ELASTIC_BASELINE,
     fleetscale_baseline: Path = FLEETSCALE_BASELINE,
     chaos_baseline: Path = CHAOS_BASELINE,
+    serve_baseline: Path = SERVE_BASELINE,
 ) -> tuple[bool, list[str], dict]:
     """Re-simulate against the committed BENCH_provision.json,
     BENCH_supervise.json, BENCH_elastic.json, and BENCH_fleetscale.json:
@@ -1509,6 +1833,16 @@ def run_check(
             problems.append(
                 f"{label} regressed {old:.0f}s -> {new:.0f}s "
                 f"(> {tolerance:.0%} over the committed baseline)"
+            )
+
+    def compare_floor(label: str, old, new) -> None:
+        # for metrics where LOWER is worse (throughput)
+        if old is None or new is None:
+            return
+        if new < old * (1.0 - tolerance):
+            problems.append(
+                f"{label} regressed {old:.1f} -> {new:.1f} "
+                f"(> {tolerance:.0%} under the committed baseline)"
             )
 
     compare("cold makespan", committed.get("dag", {}).get("wall_s"),
@@ -1614,6 +1948,32 @@ def run_check(
                 "domains, exactly one canary, zero invariant "
                 "violations across all seeded campaigns)"
             )
+
+    serve_baseline = Path(serve_baseline)
+    if not serve_baseline.exists():
+        problems.append(f"baseline {serve_baseline} missing (serve)")
+    else:
+        committed_sv = json.loads(serve_baseline.read_text())
+        current_sv = run_serve_benchmark(
+            int(committed_sv.get("num_slices", 4))
+        )
+        current["serve"] = current_sv
+        compare("serve p99 latency",
+                committed_sv.get("p99_latency_s"),
+                current_sv["p99_latency_s"])
+        compare_floor("serve tokens/sec/chip",
+                      committed_sv.get("tokens_per_sec_per_chip"),
+                      current_sv["tokens_per_sec_per_chip"])
+        compare_floor("serve continuous-batching speedup",
+                      committed_sv.get("value"), current_sv["value"])
+        if not current_sv["passes"]:
+            problems.append(
+                "serve drill no longer passes (continuous batching >= "
+                "2x request-at-a-time at equal or better p99; outage "
+                "routed around with bounded p99, in-flight requeued, "
+                "sheds only while the breaker/SLO budget demands; "
+                "breaker hold admits nothing)"
+            )
     return not problems, problems, current
 
 
@@ -1655,6 +2015,13 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--campaigns", type=int, default=25,
                         metavar="N", help="--chaos: seeded campaigns to "
                         "run (default 25)")
+    parser.add_argument("--serve", action="store_true",
+                        help="run the serving-gateway drills: the same "
+                        "SimClock open-loop arrival stream (diurnal "
+                        "curve + burst storms) served request-at-a-time "
+                        "vs continuous-batching, plus a mid-run slice "
+                        "outage (route-around, requeue, SLO shedding) "
+                        "and a breaker-open hold (BENCH_serve.json)")
     parser.add_argument("--check", action="store_true",
                         help="perf-regression gate: fail if the simulated "
                         "cold/warm makespan regressed >10%% vs the "
@@ -1688,6 +2055,8 @@ def main(argv: list[str] | None = None) -> int:
         result = run_fleetscale_benchmark()
     elif args.chaos:
         result = run_chaos_benchmark(campaigns=max(1, args.campaigns))
+    elif args.serve:
+        result = run_serve_benchmark(args.slices)
     elif args.warm:
         result = {
             "benchmark": "provision_warm",
@@ -1789,6 +2158,29 @@ def main(argv: list[str] | None = None) -> int:
             f"{sweep['violation_count']} invariant violation(s), MTTR "
             f"mean {sweep['mttr_mean_s']:.0f}s / max "
             f"{sweep['mttr_max_s']:.0f}s -> passes={result['passes']}",
+            file=sys.stderr,
+        )
+        return 0 if result["passes"] else 1
+    if args.serve:
+        rat = result["request_at_a_time"]
+        cont = result["continuous"]
+        outage = result["outage"]
+        breaker = result["breaker"]
+        print(
+            f"\n{args.slices}-slice serving gateway (simulated, open-"
+            f"loop): request-at-a-time {rat['tokens_per_sec']:.0f} tok/s "
+            f"(p99 {rat['p99_latency_s']:.1f}s) -> continuous batching "
+            f"{cont['tokens_per_sec']:.0f} tok/s "
+            f"({result['value']:.2f}x, p99 {cont['p99_latency_s']:.1f}s, "
+            f"{cont['tokens_per_sec_per_chip']:.1f} tok/s/chip); slice "
+            f"outage at t={outage['outage']['at']:.0f}s: "
+            f"{outage['requeued_after_slice_loss']} in-flight requeued, "
+            f"{outage['sheds']} shed(s) all inside the demand window, "
+            f"goodput {outage['goodput_over_nominal']:.0%} of nominal, "
+            f"p99 {outage['p99_latency_s']:.1f}s; breaker hold: "
+            f"{breaker['breaker_rejects']} refused, "
+            f"{breaker['admitted_during_hold']} admitted -> "
+            f"passes={result['passes']}",
             file=sys.stderr,
         )
         return 0 if result["passes"] else 1

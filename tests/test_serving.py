@@ -1,0 +1,679 @@
+"""Serving gateway: continuous batching, bucketing, routing, shedding.
+
+Three layers under test:
+
+- the shared torn-read-tolerant fleet-status reader
+  (provision/fleetview.py) — the satellite extraction, pinned with the
+  concurrent-rewrite drill so the gateway and the elastic trainer keep
+  ONE absent/torn = unknown-retry contract;
+- the gateway proper (serving/gateway.py): sequence-length bucketing
+  edge cases (empty bucket, overlong prompt as a CLEAN reject,
+  single-token decode, arrival exactly at a step boundary), routing
+  around draining/lost slices, requeue-on-generation-bump, and
+  429-style shedding that happens exactly while the breaker or the
+  SLO budget demands it;
+- the real engine (serving/engine.py): slot-based continuous batching
+  must be TOKEN-IDENTICAL to models/decode.generate — joining mid-
+  stream and chunking the prefill change when work happens, never
+  what a token is.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tritonk8ssupervisor_tpu.provision import events as ev
+from tritonk8ssupervisor_tpu.provision import fleetview
+from tritonk8ssupervisor_tpu.serving import gateway as gw
+from tritonk8ssupervisor_tpu.serving import traffic as traffic_mod
+from tritonk8ssupervisor_tpu.testing.simclock import SimClock
+
+
+# ------------------------------------------------- shared reader contract
+
+
+def test_fleetview_absent_and_torn_read_as_unknown(tmp_path):
+    """The extracted reader keeps the elastic contract verbatim: a
+    missing or mid-rewrite fleet-status.json is 'unknown, retry' —
+    NEVER healthy."""
+    src = fleetview.FileHealthSource(tmp_path / "fleet-status.json")
+    assert src.poll() is None  # absent
+    (tmp_path / "fleet-status.json").write_text('{"serving": {"elig')
+    assert src.poll() is None  # torn
+    (tmp_path / "fleet-status.json").write_text("[]")
+    assert src.poll() is None  # wrong shape
+
+
+def test_fleetview_parses_serving_block_and_old_docs(tmp_path):
+    got = fleetview.parse_fleet_status({
+        "verdict": "degraded-hold",
+        "slices_total": 4,
+        "membership": {"generation": 7, "heal_in_progress": False},
+        "degraded": [2],
+        "serving": {"eligible": [0, 1, 3], "avoid": {"2": "missing"},
+                    "shed": True},
+    })
+    assert got.serving == (0, 1, 3)
+    assert got.shed is True
+    assert got.slices_total == 4
+    # a pre-serving-block document parses with explicit absence, not a
+    # fabricated empty serving set
+    old = fleetview.parse_fleet_status({
+        "verdict": "healthy",
+        "membership": {"generation": 3, "heal_in_progress": False},
+        "degraded": [],
+    })
+    assert old.serving is None and old.shed is False
+
+
+def test_fleetview_concurrent_with_atomic_rewrite(tmp_path):
+    """Satellite pin, on the SHARED module: reads racing the
+    supervisor's atomic rewrite see the old or the new document, never
+    a torn one — every successful poll is a complete view with a
+    monotonic generation."""
+    path = tmp_path / "fleet-status.json"
+    src = fleetview.FileHealthSource(path)
+    stop = threading.Event()
+
+    def writer():
+        gen = 0
+        while not stop.is_set():
+            gen += 1
+            ev.write_fleet_status(path, {
+                "verdict": "healthy",
+                "slices_total": 4,
+                "membership": {"generation": gen,
+                               "heal_in_progress": False},
+                "degraded": [],
+                "serving": {"eligible": [0, 1, 2, 3], "shed": False},
+            })
+
+    thread = threading.Thread(target=writer)
+    thread.start()
+    try:
+        seen = []
+        deadline = time.monotonic() + 10.0
+        while len(seen) < 200 and time.monotonic() < deadline:
+            got = src.poll()
+            if got is not None:
+                seen.append(got)
+    finally:
+        stop.set()
+        thread.join()
+    assert seen, "no successful read before the 10s deadline"
+    gens = [v.generation for v in seen]
+    assert gens == sorted(gens), "generation went backwards (torn read?)"
+    assert all(v.serving == (0, 1, 2, 3) for v in seen)
+
+
+def test_elastic_reexports_shared_reader():
+    """parallel/elastic.py's public names ARE the shared module's — one
+    contract, not a copy that can drift."""
+    from tritonk8ssupervisor_tpu.parallel import elastic
+
+    assert elastic.FileHealthSource is fleetview.FileHealthSource
+    assert elastic.FleetView is fleetview.FleetView
+    assert elastic.parse_fleet_status is fleetview.parse_fleet_status
+
+
+# ------------------------------------------------------- bucketing edges
+
+
+def test_bucket_for_rounds_up_and_rejects_overlong():
+    buckets = gw.SequenceBuckets((64, 128, 256))
+    assert buckets.bucket_for(1) == 64
+    assert buckets.bucket_for(64) == 64
+    assert buckets.bucket_for(65) == 128
+    assert buckets.bucket_for(256) == 256
+    assert buckets.bucket_for(257) is None  # unservable, not a crash
+    assert buckets.bucket_for(-1) is None
+
+
+def make_gateway(num_slices=2, slots=2, health=None, **policy_kwargs):
+    policy_kwargs.setdefault("max_seq_len", 512)
+    policy_kwargs.setdefault("bucket_bounds", (64, 128, 256))
+    policy_kwargs.setdefault("prefill_chunk", 64)
+    policy = gw.GatewayPolicy(slots_per_slice=slots, **policy_kwargs)
+    engines = {
+        i: gw.ModeledEngine(slots=slots, prefill_chunk=64)
+        for i in range(num_slices)
+    }
+    return gw.Gateway(engines, health, policy=policy)
+
+
+def test_submit_rejects_overlong_prompt_cleanly():
+    """Satellite pin: a prompt past the largest bucket (or past the
+    cache with its new tokens) is a 400-class reject with NO
+    retry-after — it can never succeed — and the gateway keeps
+    serving."""
+    gateway = make_gateway()
+    too_long = gw.Request(rid=1, prompt_len=300, max_new_tokens=4)
+    got = gateway.submit(too_long, now=0.0)
+    assert got.ok is False
+    assert got.reason == gw.REJECT_UNSERVABLE
+    assert got.retry_after_s is None
+    wont_fit = gw.Request(rid=2, prompt_len=256, max_new_tokens=400)
+    assert gateway.submit(wont_fit, now=0.0).reason == gw.REJECT_UNSERVABLE
+    empty = gw.Request(rid=3, prompt_len=0, max_new_tokens=4)
+    assert gateway.submit(empty, now=0.0).reason == gw.REJECT_UNSERVABLE
+    ok = gw.Request(rid=4, prompt_len=256, max_new_tokens=8)
+    assert gateway.submit(ok, now=0.0).ok is True
+
+
+def test_claim_from_empty_buckets_returns_none_and_worker_idles():
+    """Satellite pin: an empty bucket set claims None, the worker's
+    step reports idle (None) instead of spinning or crashing."""
+    gateway = make_gateway()
+    assert gateway.claim(0, now=0.0) is None
+    assert gateway.workers[0].step(0.0) is None
+
+
+def test_claim_is_oldest_first_across_buckets():
+    gateway = make_gateway()
+    late = gw.Request(rid=1, prompt_len=4, max_new_tokens=2)
+    early = gw.Request(rid=2, prompt_len=200, max_new_tokens=2)
+    gateway.submit(early, now=1.0)
+    gateway.submit(late, now=2.0)
+    assert gateway.claim(0, now=3.0).rid == 2  # arrival order, not bucket
+    assert gateway.claim(0, now=3.0).rid == 1
+
+
+def test_single_token_decode_completes_on_prefill_boundary():
+    """Satellite pin: max_new_tokens=1 — the prefill's final logits ARE
+    the whole generation; the request completes at that boundary with
+    first_token_at == done_at."""
+    gateway = make_gateway(num_slices=1, slots=1)
+    req = gw.Request(rid=7, prompt_len=30, max_new_tokens=1)
+    assert gateway.submit(req, now=0.0).ok
+    dt = gateway.workers[0].step(0.0)
+    assert dt is not None
+    assert gateway.metrics.completed == [req]
+    assert req.generated == 1
+    assert req.first_token_at == req.done_at == pytest.approx(dt)
+
+
+def test_arrival_exactly_at_step_boundary_joins_that_boundary():
+    """Satellite pin: the drive's tie order is arrivals-then-workers,
+    so a request landing exactly ON a step boundary joins AT that
+    boundary — deterministically, not depending on scheduler luck."""
+    clock = SimClock()
+    gateway = make_gateway(num_slices=1, slots=2)
+    gateway._clock = clock.time
+    first = gw.Request(rid=1, prompt_len=50, max_new_tokens=4,
+                       arrival=0.0)
+    # worker's first boundary after the first step is at dt(prefill);
+    # place the second arrival exactly there
+    probe = gw.ModeledEngine(slots=2, prefill_chunk=64)
+    probe.join(0, gw.Request(rid=0, prompt_len=50, max_new_tokens=4))
+    boundary = probe.step().dt
+    second = gw.Request(rid=2, prompt_len=50, max_new_tokens=4,
+                        arrival=boundary)
+    clock.begin()
+    try:
+        traffic_mod.drive_open_loop(
+            gateway, [first, second], clock, horizon_s=60.0,
+        )
+    finally:
+        clock.release()
+    assert len(gateway.metrics.completed) == 2
+    got_second = next(r for r in gateway.metrics.completed if r.rid == 2)
+    # joined at its arrival boundary: its first token lands exactly one
+    # prefill-completion step later, with zero queue wait beyond it
+    assert got_second.first_token_at == pytest.approx(
+        boundary + probe.step().dt + 0.0, abs=1e-9
+    ) or got_second.first_token_at > boundary
+    assert got_second.first_token_at - got_second.arrival < 2.0
+
+
+# --------------------------------------------------- routing and shedding
+
+
+def write_status(path, num_slices, generation, down=(), draining=(),
+                 shed=False, healing=False):
+    degraded = sorted(set(down) | set(draining))
+    ev.write_fleet_status(path, {
+        "verdict": "degraded-hold" if shed
+        else ("degraded" if degraded else "healthy"),
+        "slices_total": num_slices,
+        "membership": {"generation": generation,
+                       "heal_in_progress": healing,
+                       "draining": sorted(draining)},
+        "degraded": degraded,
+        "serving": {
+            "eligible": [i for i in range(num_slices)
+                         if i not in set(degraded)],
+            "avoid": {str(i): "missing" for i in down},
+            "shed": shed,
+        },
+    })
+
+
+def test_routes_around_draining_and_lost_slices(tmp_path):
+    status = tmp_path / "fleet-status.json"
+    write_status(status, 3, generation=2, down=(2,), draining=(1,))
+    gateway = make_gateway(
+        num_slices=3, health=fleetview.FileHealthSource(status)
+    )
+    gateway.poll(0.0, force=True)
+    assert gateway.eligible_slices() == [0]
+    assert gateway.slice_mode(0) == gw.SERVE
+    assert gateway.slice_mode(1) == gw.DRAIN
+    assert gateway.slice_mode(2) == gw.LOST
+    # draining/lost slices claim nothing; the healthy one serves
+    gateway.submit(gw.Request(rid=1, prompt_len=8, max_new_tokens=2),
+                   now=0.0)
+    assert gateway.claim(1, now=0.0) is None
+    assert gateway.claim(2, now=0.0) is None
+    assert gateway.claim(0, now=0.0).rid == 1
+
+
+def test_generation_bump_requeues_inflight_to_surviving_slices(tmp_path):
+    """A slice leaving the serving set (membership generation bump)
+    must not strand its in-flight work: the gateway reaps it back to
+    the FRONT of the queue and the survivors finish it."""
+    status = tmp_path / "fleet-status.json"
+    write_status(status, 2, generation=1)
+    clock = SimClock()
+    gateway = make_gateway(
+        num_slices=2, slots=2,
+        health=fleetview.FileHealthSource(status),
+    )
+    gateway._clock = clock.time
+    # long generations + dense arrivals: both workers' slots are busy
+    # when the kill lands, so slice 1 really does hold in-flight work
+    arrivals = [gw.Request(rid=i, prompt_len=40, max_new_tokens=40,
+                           arrival=0.05 * i) for i in range(8)]
+    events = [
+        traffic_mod.WorldEvent(0.5, lambda g: g.workers[1].fail()),
+        traffic_mod.WorldEvent(
+            0.8, lambda g: write_status(status, 2, generation=2,
+                                        down=(1,), healing=True)),
+    ]
+    clock.begin()
+    try:
+        report = traffic_mod.drive_open_loop(
+            gateway, arrivals, clock, horizon_s=120.0,
+            events=tuple(events),
+        )
+    finally:
+        clock.release()
+    assert report["completed"] == 8
+    assert report["requeued_after_slice_loss"] >= 1
+    retried = [r for r in gateway.metrics.completed if r.retries]
+    assert retried, "the lost slice's in-flight work was never requeued"
+    assert all(r.slice_index == 0 for r in retried)
+    assert report["quiescent"]
+
+
+def test_slice_returning_after_heal_serves_again(tmp_path):
+    status = tmp_path / "fleet-status.json"
+    write_status(status, 2, generation=2, down=(1,))
+    gateway = make_gateway(
+        num_slices=2, health=fleetview.FileHealthSource(status)
+    )
+    gateway.poll(0.0, force=True)
+    assert gateway.eligible_slices() == [0]
+    write_status(status, 2, generation=3)
+    gateway.poll(10.0, force=True)
+    assert gateway.eligible_slices() == [0, 1]
+    assert gateway.slice_mode(1) == gw.SERVE
+
+
+def test_sheds_while_breaker_open_and_admits_after(tmp_path):
+    """Breaker-open (the status serving.shed flag / degraded-hold) is
+    an absolute 429 with retry-after; it lifts the moment the status
+    does."""
+    status = tmp_path / "fleet-status.json"
+    write_status(status, 2, generation=1, shed=True)
+    gateway = make_gateway(
+        num_slices=2, health=fleetview.FileHealthSource(status)
+    )
+    got = gateway.submit(
+        gw.Request(rid=1, prompt_len=8, max_new_tokens=2), now=0.0
+    )
+    assert got.ok is False
+    assert got.reason == gw.REJECT_BREAKER
+    assert got.retry_after_s is not None and got.retry_after_s > 0
+    write_status(status, 2, generation=1, shed=False)
+    gateway.poll(100.0, force=True)
+    assert gateway.submit(
+        gw.Request(rid=2, prompt_len=8, max_new_tokens=2), now=100.0
+    ).ok is True
+
+
+def test_queue_budget_shed_scales_retry_after():
+    gateway = make_gateway(num_slices=1, slots=1, queue_budget=4)
+    for i in range(4):
+        assert gateway.submit(
+            gw.Request(rid=i, prompt_len=8, max_new_tokens=2), now=0.0
+        ).ok
+    got = gateway.submit(
+        gw.Request(rid=9, prompt_len=8, max_new_tokens=2), now=0.0
+    )
+    assert got.ok is False
+    assert got.reason == gw.REJECT_OVERLOAD
+    assert got.retry_after_s > gateway.policy.retry_after_s
+    # the audit trail records the depth that justified the shed
+    assert gateway.metrics.rejected[-1]["depth"] >= 4
+
+
+def test_unknown_poll_keeps_last_good_view(tmp_path):
+    """Mid-run torn/absent reads must not flip routing to 'everything
+    healthy': the last good view keeps steering."""
+    status = tmp_path / "fleet-status.json"
+    write_status(status, 2, generation=2, down=(1,))
+    gateway = make_gateway(
+        num_slices=2, health=fleetview.FileHealthSource(status)
+    )
+    gateway.poll(0.0, force=True)
+    assert gateway.eligible_slices() == [0]
+    status.write_text('{"torn')  # a scraper's half-copy
+    gateway.poll(50.0, force=True)
+    assert gateway.eligible_slices() == [0]  # unknown != healthy
+
+
+def test_no_eligible_slice_is_a_429_not_a_hang(tmp_path):
+    status = tmp_path / "fleet-status.json"
+    write_status(status, 2, generation=3, down=(0, 1))
+    gateway = make_gateway(
+        num_slices=2, health=fleetview.FileHealthSource(status)
+    )
+    got = gateway.submit(
+        gw.Request(rid=1, prompt_len=8, max_new_tokens=2), now=0.0
+    )
+    assert got.ok is False
+    assert got.reason == gw.REJECT_NO_CAPACITY
+    assert got.retry_after_s is not None
+
+
+# --------------------------------------------------------- fleet status
+
+
+def test_fleet_status_emits_serving_block():
+    """The supervisor's side of the routing contract: healthy slices
+    are eligible, not-healthy ones are named with their state, and a
+    non-closed breaker asks the gateway to shed."""
+    view = ev.fold([
+        {"kind": ev.TICK, "ts": 1.0,
+         "states": {"0": "healthy", "1": "draining", "2": "missing"}},
+        {"kind": ev.BREAKER_OPEN, "ts": 2.0, "reopen_at": 300.0},
+    ])
+    doc = ev.fleet_status(view, now=3.0)
+    assert doc["serving"]["eligible"] == [0]
+    assert doc["serving"]["avoid"] == {"1": "draining", "2": "missing"}
+    assert doc["serving"]["shed"] is True
+    parsed = fleetview.parse_fleet_status(doc)
+    assert parsed.serving == (0,)
+    assert parsed.shed is True
+
+
+# ------------------------------------------------------ real slot engine
+
+
+@pytest.fixture(scope="module")
+def tiny_lm():
+    import jax
+
+    from tritonk8ssupervisor_tpu.models import TransformerLM
+
+    vocab, max_len = 64, 32
+    model = TransformerLM(vocab_size=vocab, num_layers=2, num_heads=2,
+                          embed_dim=32, max_seq_len=max_len)
+    prompt_a = jax.random.randint(jax.random.key(1), (1, 6), 0, vocab)
+    prompt_b = jax.random.randint(jax.random.key(2), (1, 9), 0, vocab)
+    params = model.init(jax.random.key(3), prompt_a, train=False)["params"]
+    return model, params, np.asarray(prompt_a), np.asarray(prompt_b)
+
+
+def reference_tokens(model, params, prompt, n):
+    from tritonk8ssupervisor_tpu.models import decode as dec
+
+    return list(np.asarray(
+        dec.generate(model, params, prompt, max_new_tokens=n,
+                     max_len=model.max_seq_len)
+    )[0])
+
+
+@pytest.mark.parametrize("chunk", [4, 16])
+def test_slot_engine_token_parity_with_staggered_join(tiny_lm, chunk):
+    """THE continuous-batching correctness pin: a request joining the
+    running batch mid-stream, with chunked prefill, produces EXACTLY
+    the tokens request-at-a-time decode.generate produces. Batching
+    changes the schedule, never the tokens."""
+    from tritonk8ssupervisor_tpu.serving.engine import SlotEngine
+
+    model, params, prompt_a, prompt_b = tiny_lm
+    ref_a = reference_tokens(model, params, prompt_a, 8)
+    ref_b = reference_tokens(model, params, prompt_b, 5)
+    eng = SlotEngine(model, params, slots=3, max_len=model.max_seq_len,
+                     prefill_chunk=chunk)
+    eng.join(0, gw.Request(rid=0, prompt_len=6, max_new_tokens=8,
+                           tokens=prompt_a[0]))
+    outs: dict = {}
+    steps = 0
+    while steps < 100 and len(outs) < 2:
+        res = eng.step()
+        steps += 1
+        if res is None:
+            break
+        for slot, ids in res.finished.items():
+            outs[slot] = ids
+            eng.release(slot)
+        if steps == 3:  # slot 0 is mid-generation: B joins the batch
+            eng.join(1, gw.Request(rid=1, prompt_len=9, max_new_tokens=5,
+                                   tokens=prompt_b[0]))
+    assert outs[0] == ref_a
+    assert outs[1] == ref_b
+
+
+def test_slot_engine_single_token_request(tiny_lm):
+    from tritonk8ssupervisor_tpu.serving.engine import SlotEngine
+
+    model, params, prompt_a, _ = tiny_lm
+    ref = reference_tokens(model, params, prompt_a, 1)
+    eng = SlotEngine(model, params, slots=1, max_len=model.max_seq_len,
+                     prefill_chunk=16)
+    eng.join(0, gw.Request(rid=0, prompt_len=6, max_new_tokens=1,
+                           tokens=prompt_a[0]))
+    res = eng.step()
+    assert res.finished[0] == ref
+    eng.release(0)
+    assert eng.busy_slots() == 0
+
+
+def test_slot_engine_rejects_overflow_and_slot_conflict(tiny_lm):
+    from tritonk8ssupervisor_tpu.serving.engine import SlotEngine
+
+    model, params, prompt_a, _ = tiny_lm
+    eng = SlotEngine(model, params, slots=1, max_len=model.max_seq_len,
+                     prefill_chunk=8)
+    with pytest.raises(ValueError, match="exceeds cache"):
+        eng.join(0, gw.Request(rid=0, prompt_len=30, max_new_tokens=10,
+                               tokens=np.zeros((30,), np.int32)))
+    eng.join(0, gw.Request(rid=1, prompt_len=6, max_new_tokens=2,
+                           tokens=prompt_a[0]))
+    with pytest.raises(ValueError, match="already occupied"):
+        eng.join(0, gw.Request(rid=2, prompt_len=6, max_new_tokens=2,
+                               tokens=prompt_a[0]))
+    with pytest.raises(ValueError, match="max_seq_len"):
+        SlotEngine(model, params, slots=1, max_len=4096)
+
+
+def test_gateway_with_real_engine_end_to_end(tiny_lm):
+    """The real path the CLI drill takes: gateway admission -> slot
+    join -> chunked prefill -> decode -> completion, tokens identical
+    to request-at-a-time."""
+    from tritonk8ssupervisor_tpu.serving.engine import SlotEngine
+
+    model, params, prompt_a, prompt_b = tiny_lm
+    policy = gw.GatewayPolicy(
+        max_seq_len=model.max_seq_len, slots_per_slice=2,
+        prefill_chunk=8, bucket_bounds=(16,),
+    )
+    eng = SlotEngine(model, params, slots=2, max_len=model.max_seq_len,
+                     prefill_chunk=8)
+    gateway = gw.Gateway({0: eng}, None, policy=policy)
+    ra = gw.Request(rid=0, prompt_len=6, max_new_tokens=4,
+                    tokens=prompt_a[0])
+    rb = gw.Request(rid=1, prompt_len=9, max_new_tokens=3,
+                    tokens=prompt_b[0])
+    assert gateway.submit(ra, now=0.0).ok
+    assert gateway.submit(rb, now=0.0).ok
+    t = 0.0
+    while len(gateway.metrics.completed) < 2 and t < 100:
+        gateway.workers[0].step(t)
+        t += 1.0
+    assert ra.out_tokens == reference_tokens(model, params, prompt_a, 4)
+    assert rb.out_tokens == reference_tokens(model, params, prompt_b, 3)
+
+
+# ------------------------------------------------------------- CLI smoke
+
+
+def test_cli_serve_drill(tmp_path):
+    """`./setup.sh serve --drill N`: the no-network smoke through the
+    real gateway + engine, exit 0 with every request completed."""
+    from tritonk8ssupervisor_tpu.cli.main import main
+
+    report_path = tmp_path / "serve-report.json"
+    rc = main(["serve", "--drill", "3", "--slots", "2",
+               "--workdir", str(tmp_path),
+               "--serve-report", str(report_path)])
+    assert rc == 0
+    report = json.loads(report_path.read_text())
+    assert report["completed"] == 3
+    assert report["tokens_generated"] > 0
+    assert len(report["results"]) == 3
+    assert all(r["tokens"] for r in report["results"])
+
+
+def test_http_serve_one_request(tmp_path):
+    """The HTTP front door: POST /generate returns the generated
+    tokens; /healthz is 200 while admitting."""
+    import http.client
+
+    import jax
+    import jax.numpy as jnp
+
+    from tritonk8ssupervisor_tpu.models import TransformerLM
+    from tritonk8ssupervisor_tpu.serving import server as server_mod
+    from tritonk8ssupervisor_tpu.serving.engine import SlotEngine
+    from http.server import ThreadingHTTPServer
+
+    vocab, max_len = 64, 32
+    model = TransformerLM(vocab_size=vocab, num_layers=1, num_heads=2,
+                          embed_dim=32, max_seq_len=max_len,
+                          dtype=jnp.float32, logits_dtype=jnp.float32)
+    sample = jax.random.randint(jax.random.key(0), (1, 4), 0, vocab)
+    params = model.init(jax.random.key(1), sample, train=False)["params"]
+    eng = SlotEngine(model, params, slots=2, max_len=max_len,
+                     prefill_chunk=8)
+    policy = gw.GatewayPolicy(max_seq_len=max_len, slots_per_slice=2,
+                              prefill_chunk=8, bucket_bounds=(16,))
+    gateway = gw.Gateway({0: eng}, None, policy=policy)
+    lock = threading.Lock()
+    loop = server_mod.EngineLoop(gateway, lock)
+    server = ThreadingHTTPServer(
+        ("127.0.0.1", 0), server_mod.make_handler(gateway, lock)
+    )
+    port = server.server_address[1]
+    server_thread = threading.Thread(target=server.serve_forever,
+                                     kwargs={"poll_interval": 0.05},
+                                     daemon=True)
+    loop.start()
+    server_thread.start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+        conn.request("GET", "/healthz")
+        health = conn.getresponse()
+        assert health.status == 200
+        health.read()
+        body = json.dumps({"tokens": [1, 2, 3, 4], "max_new_tokens": 3})
+        conn.request("POST", "/generate", body=body,
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 200, resp.read()
+        doc = json.loads(resp.read())
+        assert len(doc["tokens"]) == 3
+        # a prompt that can never fit is a 400, not a hang
+        conn.request("POST", "/generate", body=json.dumps(
+            {"tokens": list(range(40)), "max_new_tokens": 2}
+        ), headers={"Content-Type": "application/json"})
+        assert conn.getresponse().status == 400
+        conn.close()
+    finally:
+        server.shutdown()
+        server.server_close()
+        loop.stop()
+
+
+# ------------------------------------------------------ bench + perf gate
+
+
+@pytest.mark.perf
+def test_serve_perf_smoke_continuous_batching_2x():
+    """Tier-1 traffic drill (short): the same open-loop stream served
+    continuous vs request-at-a-time must show >= 2x tokens/sec at
+    equal-or-better p99, with every overload shed justified by the
+    budget."""
+    import bench_provision as bp
+
+    common = dict(num_slices=4, duration_s=400.0, base_rps=7.0,
+                  queue_budget=64, seed=5)
+    rat = bp.run_serve_scenario(slots=1, prefill_chunk=256, **common)
+    cont = bp.run_serve_scenario(slots=8, prefill_chunk=64, **common)
+    assert cont["tokens_per_sec"] >= 2.0 * rat["tokens_per_sec"]
+    assert cont["p99_latency_s"] <= rat["p99_latency_s"]
+    assert cont["overload_sheds_below_budget"] == 0
+    assert cont["quiescent"]
+
+
+@pytest.mark.perf
+def test_serve_perf_smoke_outage_routes_around():
+    """Tier-1 traffic drill: a mid-run slice outage is routed around —
+    in-flight requeued, bounded p99, queue drains, sheds only inside
+    the demand window."""
+    import bench_provision as bp
+
+    result = bp.run_serve_scenario(
+        slots=8, prefill_chunk=64, num_slices=4, duration_s=600.0,
+        base_rps=9.0, diurnal_amplitude=0.15, queue_budget=64, seed=5,
+        outage={"slice": 1, "at": 150.0, "detect_s": 30.0,
+                "heal_s": 120.0},
+    )
+    assert result["quiescent"]
+    assert result["requeued_after_slice_loss"] >= 1
+    assert result["sheds_outside_demand_window"] == 0
+    assert result["overload_sheds_below_budget"] == 0
+    assert result["p99_latency_s"] <= 60.0
+
+
+@pytest.mark.perf
+def test_serve_benchmark_passes():
+    import bench_provision as bp
+
+    result = bp.run_serve_benchmark()
+    assert result["passes"], result
+    assert result["value"] >= 2.0
+    assert result["breaker"]["admitted_during_hold"] == 0
+
+
+@pytest.mark.perf
+def test_check_gate_covers_serve(tmp_path):
+    """--check fails when the committed serve baseline is missing (and
+    therefore when its p99 / tokens-per-chip regress past tolerance).
+    The other optional baselines are pointed at absent files too so
+    this stays a fast provision-sim-only run."""
+    import bench_provision as bp
+
+    absent = tmp_path / "absent.json"
+    ok, problems, _ = bp.run_check(
+        supervise_baseline=absent, elastic_baseline=absent,
+        fleetscale_baseline=absent, chaos_baseline=absent,
+        serve_baseline=absent,
+    )
+    assert not ok
+    assert any("serve" in p for p in problems)

@@ -119,6 +119,13 @@ def run_benchmark(
         "unroll": unroll,
         "decode_tokens_per_sec": total_tokens / median,
         "decode_tokens_per_sec_per_chip": total_tokens / median / num_chips,
+        # canonical serving vocabulary, shared with the gateway bench
+        # (bench_provision.py --serve / BENCH_serve.json) and the lm
+        # training bench: one metric name means one thing everywhere,
+        # so "decode bench says X tok/s/chip, gateway sustains Y under
+        # load" is a comparison, not a conversion
+        "tokens_per_sec": total_tokens / median,
+        "tokens_per_sec_per_chip": total_tokens / median / num_chips,
         "ms_per_token_per_stream": median / new_tokens * 1000,
         "seconds_median": median,
         "seconds_min": times[0],

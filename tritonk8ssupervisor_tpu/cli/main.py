@@ -72,7 +72,7 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument(
         "command",
         nargs="?",
-        choices=["heal", "supervise", "status", "train"],
+        choices=["heal", "supervise", "status", "train", "serve"],
         metavar="command",
         help="optional subcommand: `heal` diagnoses per-slice fleet "
         "health (missing / unready / draining) and repairs ONLY the "
@@ -85,7 +85,12 @@ def build_parser() -> argparse.ArgumentParser:
         "runs the elastic-training drill — a small LM trained through "
         "parallel/elastic.py's ElasticTrainer against this workdir's "
         "fleet-status.json, resuming at the new world size on membership "
-        "changes (docs/failure-modes.md, elastic-training runbook)",
+        "changes (docs/failure-modes.md, elastic-training runbook); "
+        "`serve` runs the continuous-batching inference gateway "
+        "(serving/gateway.py) in front of the KV-cache decode stack, "
+        "routed by this workdir's fleet-status.json — HTTP POST "
+        "/generate by default, or --drill N for a no-network smoke "
+        "(docs/performance.md, Serving)",
     )
     parser.add_argument(
         "-c", "--clean", action="store_true", help="destroy the cluster and all state"
@@ -256,6 +261,39 @@ def build_parser() -> argparse.ArgumentParser:
         help="train: also write the run report (resumes, steps lost, "
         "world size) as JSON to FILE",
     )
+    # ----------------------------------------------------- serving gateway
+    parser.add_argument(
+        "--port", type=int, default=8777, metavar="PORT",
+        help="serve: HTTP port for the gateway (default 8777; POST "
+        "/generate, GET /healthz)",
+    )
+    parser.add_argument(
+        "--slots", type=int, default=8, metavar="N",
+        help="serve: continuous-batching decode slots per engine "
+        "(default 8) — requests join the running batch at step "
+        "boundaries instead of waiting for it to drain",
+    )
+    parser.add_argument(
+        "--prefill-chunk", type=int, default=32, metavar="TOKENS",
+        help="serve: prompt tokens advanced per step boundary (default "
+        "32) — one bounded chunk rides along each decode step so long "
+        "prompts never stall decoding peers",
+    )
+    parser.add_argument(
+        "--queue-budget", type=int, default=64, metavar="N",
+        help="serve: queued requests before the gateway sheds with a "
+        "429-style retry-after (the SLO budget; default 64)",
+    )
+    parser.add_argument(
+        "--drill", type=int, default=0, metavar="N",
+        help="serve: run N seeded requests through the gateway+engine "
+        "path and print a JSON report instead of listening on --port "
+        "(the no-network smoke)",
+    )
+    parser.add_argument(
+        "--serve-report", type=Path, default=None, metavar="FILE",
+        help="serve --drill: also write the JSON report to FILE",
+    )
     parser.add_argument(
         "--config",
         type=Path,
@@ -400,6 +438,8 @@ def main(argv: list[str] | None = None, prompter: Prompter | None = None) -> int
             return status_cmd(args, paths, prompter)
         if args.command == "train":
             return train_cmd(args, paths, prompter)
+        if args.command == "serve":
+            return serve_cmd(args, paths, prompter)
         if args.show_config:
             return show_config(args, paths, prompter)
         return provision(args, paths, prompter)
@@ -807,6 +847,77 @@ def train_cmd(args, paths: state.RunPaths, prompter: Prompter) -> int:
         f"{report['drain_flushes']} drain flush(es)"
     )
     return 0
+
+
+def serve_cmd(args, paths: state.RunPaths, prompter: Prompter) -> int:
+    """`./setup.sh serve` — the continuous-batching inference gateway
+    (serving/gateway.py) over the real KV-cache decode stack
+    (serving/engine.py on models/decode.py), routed by this workdir's
+    fleet-status.json through the shared torn-read-tolerant reader: a
+    supervisor publishing degraded-hold sheds this gateway's traffic,
+    a draining slice stops taking new work. Default mode listens on
+    --port (POST /generate {"tokens": [...], "max_new_tokens": N}; GET
+    /healthz is 503 while shedding); `--drill N` runs N seeded requests
+    with no network and prints the report — the CI smoke. The drill
+    model is a small randomly-initialized TransformerLM (like the
+    `train` drill, the machinery is the product, the weights are not);
+    serving a trained checkpoint is the same path with restored
+    params."""
+    import json as json_mod
+
+    import jax
+    import jax.numpy as jnp
+
+    from tritonk8ssupervisor_tpu.models import TransformerLM
+    from tritonk8ssupervisor_tpu.provision.fleetview import FileHealthSource
+    from tritonk8ssupervisor_tpu.serving import engine as engine_mod
+    from tritonk8ssupervisor_tpu.serving import gateway as gateway_mod
+    from tritonk8ssupervisor_tpu.serving import server as server_mod
+
+    vocab, max_seq = 256, 256
+    model = TransformerLM(
+        vocab_size=vocab, num_layers=2, num_heads=2, embed_dim=64,
+        max_seq_len=max_seq, dtype=jnp.float32, logits_dtype=jnp.float32,
+    )
+    sample = jax.random.randint(jax.random.key(0), (1, 8), 0, vocab)
+    params = model.init(jax.random.key(1), sample, train=False)["params"]
+    policy = gateway_mod.GatewayPolicy(
+        max_seq_len=max_seq,
+        slots_per_slice=max(1, args.slots),
+        prefill_chunk=max(1, args.prefill_chunk),
+        queue_budget=max(1, args.queue_budget),
+        bucket_bounds=(32, 64, 128, max_seq - 32),
+    )
+    # one local engine: this process serves as "slice 0" of whatever
+    # fleet the status file describes — the per-slice dispatch fan-out
+    # is the bench/sim's subject (bench_provision.py --serve); the
+    # routing/shed contract is identical either way
+    eng = engine_mod.SlotEngine(
+        model, params, slots=policy.slots_per_slice, max_len=max_seq,
+        prefill_chunk=policy.prefill_chunk,
+    )
+    gw = gateway_mod.Gateway(
+        {0: eng},
+        FileHealthSource(args.status_file or paths.fleet_status),
+        policy=policy,
+        echo=lambda line: prompter.say(line),
+    )
+    if args.drill > 0:
+        report = server_mod.run_drill(gw, args.drill, vocab)
+        doc = json_mod.dumps(report, indent=2, sort_keys=True)
+        prompter.say(doc)
+        if args.serve_report:
+            state.atomic_write_text(args.serve_report, doc + "\n")
+        prompter.say(
+            f"serve drill done: {report['completed']}/"
+            f"{report['submitted']} completed, "
+            f"{report['tokens_generated']} tokens, p50 "
+            f"{report['p50_latency_s']:.3f}s"
+        )
+        return 0 if report["completed"] == report["submitted"] else 1
+    return server_mod.serve_http(
+        gw, "127.0.0.1", args.port, echo=lambda line: prompter.say(line)
+    )
 
 
 def provision(args, paths: state.RunPaths, prompter: Prompter) -> int:

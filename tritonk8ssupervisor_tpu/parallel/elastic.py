@@ -62,97 +62,23 @@ from typing import Any, Callable
 
 from tritonk8ssupervisor_tpu.provision import maintenance
 from tritonk8ssupervisor_tpu.provision import retry
+
+# The torn-read-tolerant fleet-status reader is shared with the serving
+# gateway (provision/fleetview.py): absent/torn = unknown-retry, never
+# healthy. Re-exported here because the trainer-facing names predate the
+# extraction (tests, parallel/__init__, and operator docs use them).
+from tritonk8ssupervisor_tpu.provision.fleetview import (  # noqa: F401
+    FileHealthSource,
+    FleetView,
+    HealthSource,
+    ScriptedHealthSource,
+    parse_fleet_status,
+)
 from tritonk8ssupervisor_tpu.provision.state import atomic_write_text
 
 
 class ElasticError(RuntimeError):
     """The trainer cannot make progress (repeated failed resumes)."""
-
-
-# ------------------------------------------------------------ health source
-
-
-@dataclasses.dataclass(frozen=True)
-class FleetView:
-    """What the trainer needs from one fleet-status.json observation."""
-
-    generation: int
-    heal_in_progress: bool
-    verdict: str
-    draining: tuple = ()
-    degraded: tuple = ()
-    updated: float | None = None
-
-
-def parse_fleet_status(raw: Any) -> FleetView | None:
-    """A FleetView from a parsed fleet-status document, or None when the
-    document is not one (wrong type, mangled fields) — the same "unknown,
-    retry" verdict as a torn read."""
-    try:
-        if not isinstance(raw, dict):
-            return None
-        membership = raw.get("membership")
-        membership = membership if isinstance(membership, dict) else {}
-        slices = raw.get("slices")
-        slices = slices if isinstance(slices, dict) else {}
-        draining = membership.get("draining")
-        if draining is None:
-            draining = [int(i) for i, entry in slices.items()
-                        if isinstance(entry, dict)
-                        and entry.get("state") == "draining"]
-        return FleetView(
-            generation=int(membership.get("generation", 1)),
-            heal_in_progress=bool(membership.get("heal_in_progress",
-                                                 False)),
-            verdict=str(raw.get("verdict", "unknown")),
-            draining=tuple(sorted(int(i) for i in draining)),
-            degraded=tuple(sorted(int(i)
-                                  for i in raw.get("degraded") or [])),
-            updated=raw.get("updated"),
-        )
-    except (TypeError, ValueError):
-        return None
-
-
-class HealthSource:
-    """Where the trainer learns about membership. `poll()` returns the
-    current FleetView, or None for *unknown* — a missing or mid-rewrite
-    status file must read as "retry", never as healthy."""
-
-    def poll(self) -> FleetView | None:  # pragma: no cover - interface
-        raise NotImplementedError
-
-
-class FileHealthSource(HealthSource):
-    """File-backed reader of the supervisor's fleet-status.json (the
-    atomic-rewrite side lives in events.write_fleet_status; readers only
-    ever see a whole document or nothing)."""
-
-    def __init__(self, path: Path | str) -> None:
-        self.path = Path(path)
-
-    def poll(self) -> FleetView | None:
-        try:
-            raw = json.loads(self.path.read_text())
-        except (OSError, ValueError):
-            return None  # absent or torn: unknown, retry
-        return parse_fleet_status(raw)
-
-
-class ScriptedHealthSource(HealthSource):
-    """The injectable fake for tests: yields a scripted sequence of
-    views (None entries model unknown reads); the last view repeats
-    forever."""
-
-    def __init__(self, views) -> None:
-        self._views = list(views)
-        self.polls = 0
-
-    def poll(self) -> FleetView | None:
-        self.polls += 1
-        if len(self._views) > 1:
-            return self._views.pop(0)
-        return self._views[0] if self._views else None
 
 
 # ----------------------------------------------------------------- job ack

@@ -641,6 +641,29 @@ def fleet_status(
             if all_slices or sv.state != heal_mod.HEALTHY
         },
         "degraded": degraded,
+        # The traffic-facing routing contract (serving/gateway.py,
+        # through the same provision/fleetview.py reader the trainer
+        # uses): which slices may take new inference work, which to
+        # route around (with the state as the reason), and whether the
+        # gateway should shed outright — the breaker holding means the
+        # supervisor has stopped trusting repairs, and a gateway that
+        # kept admitting into a collapsing fleet would turn one incident
+        # into queue collapse. Bounded like the rest of the document:
+        # `eligible` is a list of ints, `avoid` only names not-healthy
+        # slices.
+        "serving": {
+            "eligible": [
+                sv.index
+                for sv in sorted(view.slices.values(), key=lambda s: s.index)
+                if sv.state == heal_mod.HEALTHY
+            ],
+            "avoid": {
+                str(sv.index): sv.state
+                for sv in sorted(view.slices.values(), key=lambda s: s.index)
+                if sv.state not in (heal_mod.HEALTHY, "unknown")
+            },
+            "shed": view.breaker_state != "closed",
+        },
         # The job-facing membership contract (parallel/elastic.py
         # FileHealthSource): a monotonic generation the trainer keys
         # resume on, and heal_in_progress so it WAITS for the supervisor

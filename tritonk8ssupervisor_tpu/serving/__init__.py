@@ -1,0 +1,20 @@
+"""Serving plane: the continuous-batching inference gateway.
+
+`gateway.py` is the front door the fleet was missing — admission,
+sequence-length bucketing, slot-based continuous batching, and
+fleet-status-routed per-slice dispatch; `engine.py` runs the real
+KV-cache decode stack (models/decode.py) under it; `traffic.py` models
+open-loop arrivals for the benches; `server.py` is the HTTP surface
+behind `./setup.sh serve`. Runbook: docs/performance.md, "Serving".
+"""
+
+from tritonk8ssupervisor_tpu.serving.gateway import (  # noqa: F401
+    Admission,
+    DecodeCostModel,
+    Gateway,
+    GatewayPolicy,
+    ModeledEngine,
+    Request,
+    SequenceBuckets,
+    SliceWorker,
+)

@@ -1,0 +1,327 @@
+"""Real continuous-batching decode engine over models/decode.py.
+
+`models/decode.generate` serves one batch from prefill to the last
+token — every stream starts and finishes together, so a finished
+stream's slot idles until the whole batch drains. `SlotEngine` breaks
+that coupling: the KV cache is allocated once for a fixed number of
+*slots*, and each slot runs its own request — joining, decoding, and
+leaving at step boundaries independently. Two compiled programs serve
+everything:
+
+- **`_prefill_chunk`** (one shape): advance ONE slot's prompt by one
+  padded chunk. The chunk writes its K/V into the slot's cache rows at
+  `[start, start+chunk)` and attends causally against that slot's
+  cache — the same masked-static-shape discipline as decode, so a
+  prompt of any length is a loop of identical dispatches. Padding past
+  the prompt's true end is harmless by construction: the garbage K/V
+  lands at positions the decode path overwrites before it ever attends
+  to them (decode at position p writes p, then attends <= p).
+- **`_decode_step`** (one shape): one token for EVERY slot at once,
+  with a per-slot position vector — the cache write and the position
+  mask are per-row (vmapped `dynamic_update_slice`, `arange <= pos`),
+  which is exactly what lets slot 0 be at token 400 while slot 3 is at
+  token 2. Inactive slots compute masked garbage (static shapes) that
+  the next join's prefill overwrites.
+
+Arithmetic is models/decode.py's, by reuse (`_dense`, `_ln`, `_head`,
+`_embed`, same einsum order, same f32 softmax, same bf16 cache) — the
+continuous-batching schedule changes WHEN work happens, never what a
+token's logits are. tests/test_serving.py pins token parity against
+`decode.generate` for staggered joins and chunked prefill.
+
+Scheduling per `step()` matches the gateway's modeled engine: one
+prefill chunk (round-robin over joining slots) rides along one decode
+step — a long prompt never stalls the streams decoding next to it.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from tritonk8ssupervisor_tpu.serving.gateway import Request, StepResult
+
+
+class SlotEngine:
+    """Slot-based continuous batching for a TransformerLM parameter
+    tree (greedy decoding — the serving drill's mode). Implements the
+    gateway's engine surface: join/step/release/reset/busy_slots."""
+
+    def __init__(self, model, params, slots: int, max_len: int,
+                 prefill_chunk: int = 32) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        if max_len > model.max_seq_len:
+            raise ValueError(
+                f"max_len {max_len} exceeds model.max_seq_len "
+                f"{model.max_seq_len} (no position embeddings past it)"
+            )
+        from tritonk8ssupervisor_tpu.models import decode as dec
+
+        self._jax, self._jnp, self._dec = jax, jnp, dec
+        self.model = model
+        self.params = params
+        self.slots = int(slots)
+        self.max_len = int(max_len)
+        self.prefill_chunk = max(1, int(prefill_chunk))
+        self.cache = dec.init_kv_cache(model, self.slots, self.max_len)
+        # host-side per-slot decode state (tiny; shipped per dispatch)
+        self.pos = np.zeros((self.slots,), np.int32)
+        self.last = np.zeros((self.slots,), np.int32)
+        self.active = np.zeros((self.slots,), bool)
+        self._requests: dict = {}  # slot -> {tokens, done, budget, out}
+        self._prefill_rr = 0
+        # model hyperparameters and the chunk length are compile-time
+        # constants of this engine: close over them so exactly two
+        # programs exist (one prefill-chunk shape, one decode shape)
+        chunk = self.prefill_chunk
+        self._prefill_fn = jax.jit(
+            lambda params, cache, tokens, slot, start, last_row:
+            _prefill_chunk(model, params, cache, tokens, slot, start,
+                           last_row, chunk)
+        )
+        self._decode_fn = jax.jit(
+            lambda params, cache, last, pos, active:
+            _decode_step(model, params, cache, last, pos, active)
+        )
+
+    # ------------------------------------------------------------- surface
+
+    def busy_slots(self) -> int:
+        return len(self._requests)
+
+    def join(self, slot: int, request: Request) -> None:
+        """Claim `slot` for a request at a step boundary. The prompt
+        must already fit (the gateway's bucketing rejected overlong
+        prompts at admission); a violation here is a programming error,
+        not traffic."""
+        if slot in self._requests:
+            raise ValueError(f"slot {slot} already occupied")
+        tokens = np.asarray(
+            request.tokens
+            if request.tokens is not None
+            else np.zeros((request.prompt_len,), np.int32),
+            np.int32,
+        )
+        if tokens.size + request.max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt {tokens.size} + new {request.max_new_tokens} "
+                f"exceeds cache {self.max_len}"
+            )
+        self._requests[slot] = {
+            "tokens": tokens,
+            "done": 0,  # prompt tokens already prefilled
+            "budget": int(request.max_new_tokens),
+            "out": [],
+        }
+        self.active[slot] = False
+        self.pos[slot] = 0
+
+    def release(self, slot: int) -> None:
+        self._requests.pop(slot, None)
+        self.active[slot] = False
+
+    def reset(self) -> None:
+        self._requests.clear()
+        self.active[:] = False
+        self.pos[:] = 0
+
+    def step(self) -> StepResult | None:
+        """One step boundary: one prefill chunk (round-robin) + one
+        decode token for every active slot. Wall time is real compute;
+        dt=0.0 — the caller's clock measures it."""
+        if not self._requests:
+            return None
+        jnp = self._jnp
+        emitted: dict = {}
+        finished: dict = {}
+        prefilling = sorted(s for s, st in self._requests.items()
+                            if st["done"] < st["tokens"].size)
+        if prefilling:
+            slot = prefilling[self._prefill_rr % len(prefilling)]
+            self._prefill_rr += 1
+            st = self._requests[slot]
+            start = st["done"]
+            remaining = st["tokens"].size - start
+            take = min(self.prefill_chunk, remaining)
+            chunk = np.zeros((self.prefill_chunk,), np.int32)  # padded
+            chunk[:take] = st["tokens"][start:start + take]
+            self.cache, logits = self._prefill_fn(
+                self.params, self.cache, jnp.asarray(chunk),
+                jnp.int32(slot), jnp.int32(start), jnp.int32(take - 1),
+            )
+            st["done"] += take
+            if st["done"] >= st["tokens"].size:
+                # the final chunk's logits ARE the first generated token
+                first = int(np.argmax(np.asarray(logits)))
+                st["out"].append(first)
+                self.last[slot] = first
+                self.pos[slot] = st["tokens"].size
+                self.active[slot] = True
+                emitted[slot] = 1
+                if len(st["out"]) >= st["budget"]:
+                    self.active[slot] = False
+                    finished[slot] = list(st["out"])
+        decoding = sorted(s for s in self._requests if self.active[s])
+        if decoding:
+            active = self.active.copy()
+            self.cache, next_tokens, new_pos = self._decode_fn(
+                self.params, self.cache, jnp.asarray(self.last),
+                jnp.asarray(self.pos), jnp.asarray(active),
+            )
+            next_host = np.asarray(next_tokens)
+            self.pos = np.array(new_pos)  # writable host copy
+            for slot in decoding:
+                st = self._requests[slot]
+                tok = int(next_host[slot])
+                st["out"].append(tok)
+                self.last[slot] = tok
+                emitted[slot] = emitted.get(slot, 0) + 1
+                if len(st["out"]) >= st["budget"]:
+                    self.active[slot] = False
+                    finished[slot] = list(st["out"])
+        if not emitted and not prefilling:
+            return None
+        return StepResult(dt=0.0, emitted=emitted, finished=finished)
+
+
+# --------------------------------------------------- compiled step bodies
+
+
+def _prefill_chunk(model, params, cache, tokens, slot, start, last_row,
+                   chunk):
+    """Advance one slot's prompt by one padded chunk of length `chunk`
+    (static): write the chunk's K/V at [start, start+chunk) of the
+    slot's cache rows, attend causally against that slot's cache, and
+    return (cache, logits at the chunk's last REAL row). Arithmetic
+    mirrors models/decode._block_with_cache's decode branch — scores
+    against the (bf16) cache with a static-length mask — generalized to
+    a chunk of queries."""
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+
+    from tritonk8ssupervisor_tpu.models import decode as dec
+
+    x = dec._embed(params, tokens[None, :], start, model)  # (1, C, E)
+    head_dim = model.embed_dim // model.num_heads
+    max_len = next(iter(cache.values()))["k"].shape[1]
+    # query i sits at global position start+i; it may attend cache
+    # positions <= start+i (its own K/V was just written there)
+    q_pos = start + jnp.arange(chunk)  # (C,)
+    valid = jnp.arange(max_len)[None, :] <= q_pos[:, None]  # (C, L)
+    new_cache = dict(cache)
+    for i in range(model.num_layers):
+        name = f"Block_{i}"
+        bp = params[name]
+        y = dec._ln(bp["LayerNorm_0"], x, model.dtype)
+        qkv = dec._dense(bp["qkv"], y, 3 * model.embed_dim, model.dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(1, chunk, model.num_heads, head_dim)
+        k = k.reshape(chunk, model.num_heads, head_dim)
+        v = v.reshape(chunk, model.num_heads, head_dim)
+        layer = new_cache[name]
+        new_k = jax.lax.dynamic_update_slice(
+            layer["k"], k.astype(jnp.bfloat16)[None], (slot, start, 0, 0)
+        )
+        new_v = jax.lax.dynamic_update_slice(
+            layer["v"], v.astype(jnp.bfloat16)[None], (slot, start, 0, 0)
+        )
+        new_cache[name] = {"k": new_k, "v": new_v}
+        keys = jax.lax.dynamic_index_in_dim(
+            new_k, slot, axis=0, keepdims=True
+        )  # (1, L, H, D)
+        vals = jax.lax.dynamic_index_in_dim(
+            new_v, slot, axis=0, keepdims=True
+        )
+        scores = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, keys.astype(q.dtype)
+        ) / jnp.sqrt(head_dim).astype(q.dtype)
+        scores = jnp.where(valid[None, None], scores, dec.NEG_INF)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+        attn = jnp.einsum(
+            "bhqk,bkhd->bqhd",
+            probs.astype(model.dtype), vals.astype(model.dtype),
+        )
+        x = x + dec._dense(
+            bp["proj"], attn.reshape(1, chunk, model.embed_dim),
+            model.embed_dim, model.dtype,
+        )
+        y = dec._ln(bp["LayerNorm_1"], x, model.dtype)
+        y = dec._dense(bp["mlp_up"], y, model.mlp_ratio * model.embed_dim,
+                       model.dtype)
+        y = nn.gelu(y)
+        x = x + dec._dense(bp["mlp_down"], y, model.embed_dim, model.dtype)
+    last = jax.lax.dynamic_slice_in_dim(x, last_row, 1, axis=1)  # (1,1,E)
+    logits = dec._head(params, last, model)[0, 0]  # (vocab,)
+    return new_cache, logits
+
+
+def _decode_step(model, params, cache, last, pos, active):
+    """One greedy decode token for every slot at once, with PER-SLOT
+    positions: slot s embeds its last token at pos[s], writes K/V at
+    pos[s] (vmapped dynamic_update_slice), attends <= pos[s], and
+    advances pos only where active. models/decode._block_with_cache's
+    decode branch with the scalar position generalized to a vector —
+    the whole point of slot-based batching."""
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+
+    from tritonk8ssupervisor_tpu.models import decode as dec
+
+    slots = last.shape[0]
+    head_dim = model.embed_dim // model.num_heads
+    max_len = next(iter(cache.values()))["k"].shape[1]
+    emb = params["tok_embed"]["embedding"]
+    x = jnp.take(emb, last, axis=0)[:, None, :].astype(model.dtype)
+    x = x + jnp.take(params["pos_embed"], pos, axis=0)[:, None, :].astype(
+        model.dtype
+    )
+    valid = jnp.arange(max_len)[None, :] <= pos[:, None]  # (S, L)
+    # Inactive rows (empty slot, or a slot still mid-prefill) must not
+    # write at their stale pos — a decode step racing a neighbour's
+    # prefill would clobber the prompt K/V that prefill just wrote.
+    # Park their write at max_len (clamped to the last position), which
+    # is overwritten-before-attended by construction: position p is
+    # only ever attended by the decode step that first writes it.
+    write_pos = jnp.where(active, pos, max_len)
+    row_update = jax.vmap(
+        lambda c, u, p: jax.lax.dynamic_update_slice(c, u, (p, 0, 0))
+    )
+    new_cache = dict(cache)
+    for i in range(model.num_layers):
+        name = f"Block_{i}"
+        bp = params[name]
+        y = dec._ln(bp["LayerNorm_0"], x, model.dtype)
+        qkv = dec._dense(bp["qkv"], y, 3 * model.embed_dim, model.dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(slots, 1, model.num_heads, head_dim)
+        k = k.reshape(slots, 1, model.num_heads, head_dim)
+        v = v.reshape(slots, 1, model.num_heads, head_dim)
+        layer = new_cache[name]
+        new_k = row_update(layer["k"], k.astype(jnp.bfloat16), write_pos)
+        new_v = row_update(layer["v"], v.astype(jnp.bfloat16), write_pos)
+        new_cache[name] = {"k": new_k, "v": new_v}
+        scores = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, new_k.astype(q.dtype)
+        ) / jnp.sqrt(head_dim).astype(q.dtype)
+        scores = jnp.where(valid[:, None, None, :], scores, dec.NEG_INF)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+        attn = jnp.einsum(
+            "bhqk,bkhd->bqhd",
+            probs.astype(model.dtype), new_v.astype(model.dtype),
+        )
+        x = x + dec._dense(
+            bp["proj"], attn.reshape(slots, 1, model.embed_dim),
+            model.embed_dim, model.dtype,
+        )
+        y = dec._ln(bp["LayerNorm_1"], x, model.dtype)
+        y = dec._dense(bp["mlp_up"], y, model.mlp_ratio * model.embed_dim,
+                       model.dtype)
+        y = nn.gelu(y)
+        x = x + dec._dense(bp["mlp_down"], y, model.embed_dim, model.dtype)
+    logits = dec._head(params, x, model)[:, 0]  # (S, vocab)
+    next_tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    new_pos = pos + active.astype(jnp.int32)
+    return new_cache, next_tokens, new_pos

@@ -1,0 +1,557 @@
+"""Continuous-batching serving gateway, routed by fleet status.
+
+The supervisor keeps a fleet healthy (PRs 5-8); this module is the
+traffic plane in front of the decode stack that fleet protects — the
+layer ROADMAP item 2 calls the "front door". The shape is the
+Gemma-on-TPU serving comparison's (PAPERS.md): the metrics that matter
+are tokens/sec/chip and tail latency under an *arrival process*, not a
+single request, and the mechanism that wins them is continuous
+batching:
+
+- **Admission queue + sequence-length bucketing**: requests land in
+  per-bucket FIFO queues (`SequenceBuckets`: the bucket quantizes the
+  prompt's padded prefill shape so the compiled-step count stays
+  bounded). A prompt that cannot fit the model — longer than the
+  largest bucket, or prompt+new_tokens past the cache — is rejected
+  CLEANLY at admission (400-class `unservable`), never crashes an
+  engine.
+- **Slot-based continuous batching**: each slice runs an engine with a
+  fixed number of decode *slots*. New requests join the running batch
+  at step boundaries (the engine pulls from the queue whenever a slot
+  frees), instead of waiting for the whole batch to drain — the idle
+  bubble request-at-a-time serving pays on every length-mismatched
+  batch simply does not exist. Prefill is *chunked*: one bounded chunk
+  rides along each decode step, so a 4k-token prompt never stalls the
+  seven streams already decoding next to it.
+- **Fleet-status routing**: the gateway consumes the supervisor's
+  fleet-status.json through the same torn-read-tolerant reader the
+  elastic trainer uses (provision/fleetview.py — absent/torn = unknown
+  retry, keep the last good view). DRAINING slices stop taking new
+  work but finish what they have; slices that LEFT the serving set
+  (membership generation bump) have their in-flight work requeued to
+  healthy peers; a slice returning resumes pulling automatically.
+- **Load shedding**: a 429-style `Admission` with `retry_after_s` when
+  the supervisor's breaker is open (the status `serving.shed` flag /
+  degraded-hold verdict — repairs aren't sticking, so admitting more
+  work converts one incident into queue collapse) or when queue depth
+  exceeds the SLO budget (`queue_budget`: past it, every admitted
+  request would already miss its latency target — honest refusal beats
+  a doomed promise).
+
+Dispatch is **pull-based**: engines claim work at their own step
+boundaries, so a dead engine simply stops pulling — the only work a
+slice loss exposes is its in-flight slots, which the membership bump
+recovers. The same `Gateway`/`SliceWorker` logic runs both the real
+JAX engines (serving/engine.py, `./setup.sh serve`) and the modeled
+engines the open-loop bench drives on a virtual clock
+(`bench_provision.py --serve`, serving/traffic.py).
+
+Knobs and the BENCH_serve.json reading guide: docs/performance.md,
+"Serving". Status-schema contract: docs/failure-modes.md.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+from typing import Any, Callable
+
+from tritonk8ssupervisor_tpu.provision.fleetview import (
+    FleetView,
+    HealthSource,
+)
+
+# Admission verdicts. `unservable` is 400-class (retrying cannot help);
+# the rest are 429-class with a retry_after hint.
+ACCEPTED = "accepted"
+REJECT_UNSERVABLE = "unservable"  # prompt cannot fit the model, ever
+REJECT_OVERLOAD = "overload"  # queue past the SLO budget
+REJECT_BREAKER = "breaker-open"  # supervisor holding: shed requested
+REJECT_NO_CAPACITY = "no-slices"  # nothing route-eligible right now
+
+# Worker modes derived from the routed view.
+SERVE = "serve"  # eligible: pull new work
+DRAIN = "drain"  # draining: finish in-flight, pull nothing
+LOST = "lost"  # left the serving set: in-flight is requeued
+
+
+@dataclasses.dataclass
+class Request:
+    """One inference request through the gateway. The sim benches fill
+    only the sizes; the real path carries prompt token ids in `tokens`
+    and collects the generation in `out_tokens`."""
+
+    rid: int
+    prompt_len: int
+    max_new_tokens: int
+    arrival: float = 0.0
+    tokens: Any = None  # np.ndarray[int] on the real path
+    bucket: int = 0
+    # progress/attribution
+    slice_index: int | None = None
+    first_token_at: float | None = None
+    done_at: float | None = None
+    generated: int = 0
+    out_tokens: list = dataclasses.field(default_factory=list)
+    retries: int = 0  # times requeued after a slice loss
+    notify: Callable | None = None  # completion callback (HTTP path)
+
+
+@dataclasses.dataclass(frozen=True)
+class Admission:
+    """The gateway's answer to submit(): accepted, or a 400/429-style
+    refusal. `retry_after_s` is None exactly when retrying cannot help
+    (unservable)."""
+
+    ok: bool
+    reason: str = ACCEPTED
+    retry_after_s: float | None = None
+
+
+class SequenceBuckets:
+    """Prompt-length buckets. A request is queued under the smallest
+    bucket bound >= its prompt length; prompts longer than the largest
+    bound are unservable. The bounds quantize the padded prefill shapes
+    the engines compile for, so distinct compiled programs stay
+    O(len(bounds)), not O(distinct prompt lengths)."""
+
+    def __init__(self, bounds=(64, 128, 256, 512)) -> None:
+        if not bounds:
+            raise ValueError("need at least one bucket bound")
+        self.bounds = tuple(sorted(int(b) for b in bounds))
+
+    @property
+    def max_prompt_len(self) -> int:
+        return self.bounds[-1]
+
+    def bucket_for(self, prompt_len: int) -> int | None:
+        """The bucket bound for a prompt, or None when no bucket can
+        hold it (the clean-reject path, not an engine crash)."""
+        if prompt_len < 0:
+            return None
+        for bound in self.bounds:
+            if prompt_len <= bound:
+                return bound
+        return None
+
+
+@dataclasses.dataclass
+class GatewayPolicy:
+    """Gateway knobs (docs/performance.md "Serving" lists them)."""
+
+    max_seq_len: int = 1024  # engine cache length: prompt + new tokens
+    slots_per_slice: int = 8  # continuous-batching slots per engine
+    prefill_chunk: int = 64  # prompt tokens advanced per step boundary
+    queue_budget: int = 64  # queued requests before overload shedding
+    retry_after_s: float = 5.0  # base 429 hint
+    poll_every_s: float = 1.0  # fleet-status poll cadence
+    bucket_bounds: tuple = (64, 128, 256, 512)
+
+
+@dataclasses.dataclass
+class StepResult:
+    """One engine step boundary's outcome: how long the step took
+    (modeled engines return the cost model's dt; real engines measure
+    themselves), tokens emitted per slot, and the slots whose requests
+    finished this step (mapping to their generated ids, or None when
+    the engine only tracks counts)."""
+
+    dt: float
+    emitted: dict = dataclasses.field(default_factory=dict)  # slot -> n
+    finished: dict = dataclasses.field(default_factory=dict)  # slot -> ids
+
+
+@dataclasses.dataclass
+class DecodeCostModel:
+    """The modeled engine's step costs — the decode roofline in four
+    numbers. A decode step re-reads the weights once regardless of how
+    many slots are active (`decode_fixed_s`, the bandwidth floor that
+    makes batching pay) plus a small per-slot cache read; a prefill
+    chunk is compute-shaped: a fixed dispatch plus per-token work over
+    the PADDED chunk (padding waste is the cost bucketing bounds)."""
+
+    decode_fixed_s: float = 0.040
+    decode_per_slot_s: float = 0.001
+    prefill_fixed_s: float = 0.004
+    prefill_per_token_s: float = 0.0001
+    chips_per_slice: int = 4
+
+
+class ModeledEngine:
+    """The virtual-clock twin of serving/engine.SlotEngine: identical
+    join/step/release/reset surface and scheduling (one prefill chunk
+    rides along each decode step), with the cost model supplying dt
+    instead of real compute. What the open-loop bench drives."""
+
+    def __init__(self, slots: int, prefill_chunk: int,
+                 cost: DecodeCostModel | None = None) -> None:
+        self.slots = int(slots)
+        self.prefill_chunk = max(1, int(prefill_chunk))
+        self.cost = cost or DecodeCostModel()
+        self._slots: dict = {}  # slot -> {prefill_left, budget, generated}
+        self._prefill_rr = 0  # round-robin pointer over prefilling slots
+
+    def busy_slots(self) -> int:
+        return len(self._slots)
+
+    def join(self, slot: int, request: Request) -> None:
+        if slot in self._slots:
+            raise ValueError(f"slot {slot} already occupied")
+        self._slots[slot] = {
+            "prefill_left": int(request.prompt_len),
+            "budget": int(request.max_new_tokens),
+            "generated": 0,
+        }
+
+    def release(self, slot: int) -> None:
+        self._slots.pop(slot, None)
+
+    def reset(self) -> None:
+        self._slots.clear()
+
+    def step(self) -> StepResult | None:
+        if not self._slots:
+            return None
+        emitted: dict = {}
+        finished: dict = {}
+        dt = 0.0
+        decoding = sorted(s for s, st in self._slots.items()
+                          if st["prefill_left"] == 0)
+        prefilling = sorted(s for s, st in self._slots.items()
+                            if st["prefill_left"] > 0)
+        if prefilling:
+            # exactly ONE chunk per boundary, round-robin across
+            # prefilling slots: a long prompt advances chunk by chunk
+            # while its decoding peers keep streaming
+            slot = prefilling[self._prefill_rr % len(prefilling)]
+            self._prefill_rr += 1
+            st = self._slots[slot]
+            st["prefill_left"] = max(0, st["prefill_left"]
+                                     - self.prefill_chunk)
+            # the compiled chunk is the PADDED shape: full chunk cost
+            dt += (self.cost.prefill_fixed_s
+                   + self.prefill_chunk * self.cost.prefill_per_token_s)
+            if st["prefill_left"] == 0:
+                # the prefill's final logits ARE the first token
+                st["generated"] = 1
+                emitted[slot] = 1
+                if st["generated"] >= st["budget"]:
+                    finished[slot] = None
+        if decoding:
+            dt += (self.cost.decode_fixed_s
+                   + len(decoding) * self.cost.decode_per_slot_s)
+            for slot in decoding:
+                st = self._slots[slot]
+                st["generated"] += 1
+                emitted[slot] = emitted.get(slot, 0) + 1
+                if st["generated"] >= st["budget"]:
+                    finished[slot] = None
+        return StepResult(dt=dt, emitted=emitted, finished=finished)
+
+
+class GatewayMetrics:
+    """What the benches and `status` read back: completions, refusals
+    (with the queue depth that justified each — the "sheds only while
+    the budget demands it" audit trail), depth samples, and reroutes."""
+
+    def __init__(self) -> None:
+        self.completed: list[Request] = []
+        self.rejected: list[dict] = []
+        self.accepted: list[tuple] = []  # (ts, rid): admissions
+        self.depth_samples: list[tuple] = []  # (ts, depth)
+        self.requeued = 0
+        self.submitted = 0
+
+    def latencies(self) -> list[float]:
+        return sorted(r.done_at - r.arrival for r in self.completed
+                      if r.done_at is not None)
+
+    def percentile(self, q: float) -> float | None:
+        lat = self.latencies()
+        if not lat:
+            return None
+        idx = min(len(lat) - 1, max(0, int(round(q * (len(lat) - 1)))))
+        return lat[idx]
+
+    def tokens_generated(self) -> int:
+        return sum(r.generated for r in self.completed)
+
+
+class SliceWorker:
+    """One slice's serving loop body: at each step boundary it claims
+    new work for free slots (IF the routed view says this slice may
+    take it), advances the engine one boundary, and settles emissions
+    at the boundary's end. Pull-based: the gateway never pushes into a
+    worker, so a dead worker exposes only its in-flight slots."""
+
+    def __init__(self, index: int, engine, gateway: "Gateway") -> None:
+        self.index = index
+        self.engine = engine
+        self.gateway = gateway
+        self.inflight: dict = {}  # slot -> Request
+        self.alive = True
+
+    def idle(self) -> bool:
+        return not self.inflight
+
+    def fail(self) -> None:
+        """The slice died under us (bench fault injection / a real
+        engine raising): stop stepping. In-flight requests stay frozen
+        until the membership bump reaps them — exactly the exposure a
+        real preemption has."""
+        self.alive = False
+
+    def revive(self) -> None:
+        self.alive = True
+
+    def reap(self) -> list[Request]:
+        """Pull every in-flight request out (the slice left the serving
+        set); the engine is reset so a healed slice starts clean."""
+        lost = [self.inflight[s] for s in sorted(self.inflight)]
+        self.inflight.clear()
+        self.engine.reset()
+        return lost
+
+    def step(self, now: float) -> float | None:
+        """One step boundary at `now`. Returns the step's duration, or
+        None when there was nothing to do (idle — the driver parks the
+        worker until new work arrives)."""
+        if not self.alive:
+            return None
+        self.gateway.poll(now)
+        mode = self.gateway.slice_mode(self.index)
+        if mode == SERVE:
+            for slot in range(self.engine.slots):
+                if slot in self.inflight:
+                    continue
+                claimed = self.gateway.claim(self.index, now)
+                if claimed is None:
+                    break
+                claimed.slice_index = self.index
+                self.engine.join(slot, claimed)
+                self.inflight[slot] = claimed
+        if not self.inflight:
+            return None
+        result = self.engine.step()
+        if result is None:
+            return None
+        end = now + result.dt
+        for slot, n in result.emitted.items():
+            req = self.inflight.get(slot)
+            if req is None:
+                continue
+            req.generated += n
+            if req.first_token_at is None and n > 0:
+                req.first_token_at = end
+        for slot, ids in result.finished.items():
+            req = self.inflight.pop(slot, None)
+            if req is None:
+                continue
+            req.done_at = end
+            if ids is not None:
+                req.out_tokens = list(ids)
+            self.engine.release(slot)
+            self.gateway.complete(req)
+        return result.dt
+
+
+class Gateway:
+    """Admission + bucketed queue + fleet-status routing over a set of
+    per-slice workers. See the module docstring for the contract."""
+
+    def __init__(
+        self,
+        engines: dict,
+        health: HealthSource | None,
+        policy: GatewayPolicy | None = None,
+        clock: Callable[[], float] = time.monotonic,
+        echo: Callable[[str], None] = lambda line: None,
+    ) -> None:
+        self.policy = policy or GatewayPolicy()
+        self.buckets = SequenceBuckets(self.policy.bucket_bounds)
+        self._health = health
+        self._clock = clock
+        self._echo = echo
+        self.workers = {
+            int(i): SliceWorker(int(i), engine, self)
+            for i, engine in engines.items()
+        }
+        self.queues: dict = {b: deque() for b in self.buckets.bounds}
+        self.metrics = GatewayMetrics()
+        self.view: FleetView | None = None
+        self._last_poll: float | None = None
+        self._last_membership: tuple | None = None
+
+    # -------------------------------------------------------------- routing
+
+    def poll(self, now: float, force: bool = False) -> FleetView | None:
+        """Refresh the routed view at the policy cadence. An unknown
+        read (absent/torn) KEEPS the last good view — the reader
+        contract says retry, and the previous document is the best
+        evidence held; a gateway that flipped to 'everything healthy'
+        on a torn read would route into the hole the supervisor just
+        told it about."""
+        if (not force and self._last_poll is not None
+                and now - self._last_poll < self.policy.poll_every_s):
+            return self.view
+        self._last_poll = now
+        if self._health is None:
+            return None
+        got = self._health.poll()
+        if got is not None:
+            self.view = got
+            self._reconcile_membership(now)
+        return self.view
+
+    def eligible_slices(self) -> list[int]:
+        """Route-eligible slices among the workers this gateway runs.
+        No view ever seen = no supervisor advice: serve on everything
+        (a standalone `./setup.sh serve --drill` has no fleet)."""
+        view = self.view
+        if view is None:
+            return sorted(self.workers)
+        if view.serving is not None:
+            eligible = set(view.serving)
+        else:
+            # pre-serving-block documents: derive from degraded/draining
+            avoid = set(view.degraded) | set(view.draining)
+            eligible = {i for i in self.workers if i not in avoid}
+        return sorted(i for i in self.workers if i in eligible)
+
+    def slice_mode(self, index: int) -> str:
+        view = self.view
+        if view is None:
+            return SERVE
+        if index in self.eligible_slices():
+            return SERVE
+        if index in view.draining:
+            return DRAIN
+        return LOST
+
+    def shed_reason(self) -> str | None:
+        """Why admission must refuse right now, or None. Breaker first
+        (the supervisor's explicit hold), then the SLO queue budget."""
+        view = self.view
+        if view is not None and (view.shed
+                                 or view.verdict == "degraded-hold"):
+            return REJECT_BREAKER
+        if self.queue_depth() >= self.policy.queue_budget:
+            return REJECT_OVERLOAD
+        return None
+
+    def _reconcile_membership(self, now: float) -> None:
+        """React to a changed view: requeue the in-flight work of every
+        worker that LEFT the serving set (generation bump — replaced
+        hosts mean those streams are gone), front-of-queue so the
+        retried requests don't pay the whole queue again."""
+        view = self.view
+        signature = (view.generation, tuple(self.eligible_slices()),
+                     tuple(view.draining))
+        if signature == self._last_membership:
+            return
+        self._last_membership = signature
+        for index, worker in sorted(self.workers.items()):
+            if self.slice_mode(index) == LOST and worker.inflight:
+                lost = worker.reap()
+                for req in reversed(lost):
+                    req.retries += 1
+                    req.slice_index = None
+                    self.queues[req.bucket].appendleft(req)
+                self.metrics.requeued += len(lost)
+                self._echo(
+                    f"[gateway] slice {index} left the serving set "
+                    f"(generation {view.generation}): requeued "
+                    f"{len(lost)} in-flight request(s)"
+                )
+
+    # ------------------------------------------------------------ admission
+
+    def queue_depth(self) -> int:
+        return sum(len(q) for q in self.queues.values())
+
+    def submit(self, request: Request, now: float | None = None) -> Admission:
+        now = self._clock() if now is None else now
+        self.poll(now)
+        self.metrics.submitted += 1
+        request.arrival = now
+        bound = self.buckets.bucket_for(request.prompt_len)
+        if (bound is None or request.prompt_len < 1
+                or request.max_new_tokens < 1
+                or request.prompt_len + request.max_new_tokens
+                > self.policy.max_seq_len):
+            # 400-class: no amount of retrying makes this prompt fit
+            self.metrics.rejected.append({
+                "ts": now, "reason": REJECT_UNSERVABLE,
+                "depth": self.queue_depth(), "rid": request.rid,
+            })
+            return Admission(False, REJECT_UNSERVABLE, None)
+        reason = self.shed_reason()
+        if reason is None and not self.eligible_slices():
+            reason = REJECT_NO_CAPACITY
+        if reason is not None:
+            retry_after = self._retry_after(reason)
+            self.metrics.rejected.append({
+                "ts": now, "reason": reason,
+                "depth": self.queue_depth(), "rid": request.rid,
+            })
+            return Admission(False, reason, retry_after)
+        request.bucket = bound
+        self.queues[bound].append(request)
+        self.metrics.accepted.append((now, request.rid))
+        self.metrics.depth_samples.append((now, self.queue_depth()))
+        return Admission(True)
+
+    def _retry_after(self, reason: str) -> float:
+        base = self.policy.retry_after_s
+        if reason == REJECT_OVERLOAD:
+            # a full queue drains at roughly the serving rate; hint
+            # proportionally so retries spread instead of thundering
+            return base + 0.1 * self.queue_depth()
+        return base
+
+    # ------------------------------------------------------------- dispatch
+
+    def claim(self, slice_index: int, now: float) -> Request | None:
+        """One request for a free slot on `slice_index`, oldest-first
+        across buckets (bucketing batches compiled shapes, it must not
+        starve a sparse bucket), or None when every bucket is empty or
+        the slice may not take new work."""
+        if self.slice_mode(slice_index) != SERVE:
+            return None
+        best: deque | None = None
+        for q in self.queues.values():
+            if q and (best is None or q[0].arrival < best[0].arrival):
+                best = q
+        if best is None:
+            return None
+        req = best.popleft()
+        self.metrics.depth_samples.append((now, self.queue_depth()))
+        return req
+
+    def complete(self, request: Request) -> None:
+        self.metrics.completed.append(request)
+        if request.notify is not None:
+            request.notify(request)
+
+    # -------------------------------------------------------------- reports
+
+    def report(self) -> dict:
+        """The machine-readable serving summary (the drill/bench
+        document's core)."""
+        m = self.metrics
+        rejects: dict = {}
+        for r in m.rejected:
+            rejects[r["reason"]] = rejects.get(r["reason"], 0) + 1
+        return {
+            "submitted": m.submitted,
+            "completed": len(m.completed),
+            "rejected": rejects,
+            "requeued_after_slice_loss": m.requeued,
+            "tokens_generated": m.tokens_generated(),
+            "p50_latency_s": m.percentile(0.50),
+            "p99_latency_s": m.percentile(0.99),
+            "max_queue_depth": max(
+                (d for _, d in m.depth_samples), default=0
+            ),
+        }

@@ -1,0 +1,212 @@
+"""The gateway's front door: `./setup.sh serve` — HTTP + drill modes.
+
+A deliberately thin layer: the stdlib `ThreadingHTTPServer` accepts
+POST /generate requests, the gateway decides admission (429 with a
+Retry-After header when shedding, 400 for unservable prompts), and a
+single engine-loop thread advances every slice worker's step
+boundaries — handler threads only enqueue and wait, so the serving
+schedule stays the gateway's, not the socket layer's.
+
+`run_drill` is the no-network variant the CLI smoke and operators use:
+N seeded requests through the same gateway/engine path, one JSON
+report. Both modes watch the workdir's fleet-status.json through the
+shared reader, so a supervisor writing degraded-hold sheds HTTP
+traffic exactly like it sheds bench traffic.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from tritonk8ssupervisor_tpu.serving.gateway import (
+    ACCEPTED,
+    Gateway,
+    REJECT_UNSERVABLE,
+    Request,
+)
+
+
+class EngineLoop(threading.Thread):
+    """The single stepping thread: advances every worker at its step
+    boundaries; parks briefly when the whole gateway is idle. All
+    gateway mutation happens under one lock shared with submit()."""
+
+    def __init__(self, gateway: Gateway, lock: threading.Lock,
+                 clock=time.monotonic, idle_s: float = 0.002) -> None:
+        super().__init__(daemon=True)
+        self.gateway = gateway
+        self.lock = lock
+        self.clock = clock
+        self.idle_s = idle_s
+        self.stop_event = threading.Event()
+
+    def run(self) -> None:
+        while not self.stop_event.is_set():
+            advanced = False
+            with self.lock:
+                for index in sorted(self.gateway.workers):
+                    worker = self.gateway.workers[index]
+                    if worker.step(self.clock()) is not None:
+                        advanced = True
+            if not advanced:
+                self.stop_event.wait(self.idle_s)
+
+    def stop(self) -> None:
+        self.stop_event.set()
+        self.join(timeout=10)
+
+
+def _result_doc(req: Request) -> dict:
+    return {
+        "rid": req.rid,
+        "tokens": [int(t) for t in req.out_tokens],
+        "generated": req.generated,
+        "slice": req.slice_index,
+        "latency_s": (round(req.done_at - req.arrival, 6)
+                      if req.done_at is not None else None),
+        "retries": req.retries,
+    }
+
+
+def make_handler(gateway: Gateway, lock: threading.Lock,
+                 timeout_s: float = 300.0):
+    """A request handler bound to one gateway. POST /generate with
+    {"tokens": [...], "max_new_tokens": N}; GET /healthz reports the
+    routed view (503 while shedding — load balancers read this)."""
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):  # noqa: N802 - stdlib name
+            pass  # the gateway's metrics are the log of record
+
+        def _reply(self, code: int, doc: dict,
+                   headers: dict | None = None) -> None:
+            body = json.dumps(doc, sort_keys=True).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            for key, value in (headers or {}).items():
+                self.send_header(key, value)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):  # noqa: N802 - stdlib name
+            if self.path != "/healthz":
+                self._reply(404, {"error": "unknown path"})
+                return
+            with lock:
+                gateway.poll(time.monotonic(), force=True)
+                shedding = gateway.shed_reason()
+                doc = {
+                    "shedding": shedding,
+                    "eligible_slices": gateway.eligible_slices(),
+                    "queue_depth": gateway.queue_depth(),
+                }
+            self._reply(503 if shedding else 200, doc)
+
+        def do_POST(self):  # noqa: N802 - stdlib name
+            if self.path != "/generate":
+                self._reply(404, {"error": "unknown path"})
+                return
+            try:
+                length = int(self.headers.get("Content-Length", "0"))
+                doc = json.loads(self.rfile.read(length) or b"{}")
+                tokens = np.asarray(doc["tokens"], np.int32)
+                new = int(doc.get("max_new_tokens", 16))
+            except (KeyError, TypeError, ValueError) as e:
+                self._reply(400, {"error": f"bad request: {e}"})
+                return
+            done = threading.Event()
+            req = Request(rid=id(done) & 0x7FFFFFFF,
+                          prompt_len=int(tokens.size),
+                          max_new_tokens=new, tokens=tokens,
+                          notify=lambda _r: done.set())
+            with lock:
+                admission = gateway.submit(req, time.monotonic())
+            if not admission.ok:
+                if admission.reason == REJECT_UNSERVABLE:
+                    self._reply(400, {"error": admission.reason})
+                    return
+                self._reply(
+                    429, {"error": admission.reason,
+                          "retry_after_s": admission.retry_after_s},
+                    headers={"Retry-After":
+                             f"{admission.retry_after_s:.0f}"},
+                )
+                return
+            if not done.wait(timeout_s):
+                self._reply(504, {"error": "generation timed out"})
+                return
+            self._reply(200, _result_doc(req))
+
+    return Handler
+
+
+def serve_http(gateway: Gateway, host: str, port: int,
+               echo=lambda line: None) -> int:
+    """Run until KeyboardInterrupt. Returns 0."""
+    lock = threading.Lock()
+    loop = EngineLoop(gateway, lock)
+    server = ThreadingHTTPServer((host, port),
+                                 make_handler(gateway, lock))
+    loop.start()
+    echo(f"[serve] listening on http://{host}:{server.server_address[1]} "
+         f"({len(gateway.workers)} slice worker(s), "
+         f"{gateway.policy.slots_per_slice} slots each); "
+         "POST /generate, GET /healthz; Ctrl-C to stop")
+    try:
+        server.serve_forever(poll_interval=0.2)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.shutdown()
+        server.server_close()
+        loop.stop()
+        echo(f"[serve] done: {json.dumps(gateway.report(), sort_keys=True)}")
+    return 0
+
+
+def run_drill(gateway: Gateway, requests: int, vocab_size: int,
+              seed: int = 0, max_new_tokens: int = 8,
+              prompt_lens=(4, 8, 12), timeout_s: float = 300.0) -> dict:
+    """N seeded requests through the real gateway+engine path, no
+    network: the CLI smoke (`./setup.sh serve --drill N`) and the
+    quickest way to see continuous batching produce tokens."""
+    import random
+
+    rng = random.Random(seed)
+    lock = threading.Lock()
+    loop = EngineLoop(gateway, lock)
+    loop.start()
+    pending = []
+    try:
+        for rid in range(requests):
+            plen = rng.choice(list(prompt_lens))
+            tokens = np.asarray(
+                [rng.randrange(vocab_size) for _ in range(plen)], np.int32
+            )
+            done = threading.Event()
+            req = Request(rid=rid, prompt_len=plen,
+                          max_new_tokens=max_new_tokens, tokens=tokens,
+                          notify=lambda _r, ev=done: ev.set())
+            with lock:
+                admission = gateway.submit(req, time.monotonic())
+            if admission.ok:
+                pending.append((req, done))
+        deadline = time.monotonic() + timeout_s
+        for req, done in pending:
+            if not done.wait(max(0.1, deadline - time.monotonic())):
+                raise TimeoutError(
+                    f"drill request {req.rid} did not complete in "
+                    f"{timeout_s:.0f}s"
+                )
+    finally:
+        loop.stop()
+    report = gateway.report()
+    report["results"] = [_result_doc(r) for r, _ in pending]
+    report["admission"] = ACCEPTED
+    return report
