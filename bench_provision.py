@@ -1521,6 +1521,8 @@ def run_serve_scenario(
     queue_budget: int = 64,
     seed: int = 11,
     workdir: Path | None = None,
+    deadline_s: float | None = None,
+    with_reqlog: bool = False,
 ) -> dict:
     """One open-loop traffic drive against the gateway on a virtual
     clock. `slots=1` + whole-bucket prefill IS the request-at-a-time
@@ -1537,6 +1539,7 @@ def run_serve_scenario(
     from tritonk8ssupervisor_tpu.provision import events as events_mod
     from tritonk8ssupervisor_tpu.provision.fleetview import FileHealthSource
     from tritonk8ssupervisor_tpu.serving import gateway as gw_mod
+    from tritonk8ssupervisor_tpu.serving import reqlog as reqlog_mod
     from tritonk8ssupervisor_tpu.serving import traffic as traffic_mod
 
     own_tmp = workdir is None
@@ -1553,6 +1556,7 @@ def run_serve_scenario(
             queue_budget=queue_budget,
             bucket_bounds=(64, 128, 256),
             poll_every_s=1.0,
+            default_deadline_s=deadline_s,
         )
         clock = SimClock()
         engines = {
@@ -1561,13 +1565,23 @@ def run_serve_scenario(
                                     cost=cost)
             for i in range(num_slices)
         }
+        # fsync=False: the virtual-clock drive never crashes the OS,
+        # only in-memory objects — the fsync path is pinned in the
+        # reqlog unit tests and exercised by `./setup.sh serve`
+        reqlog = (reqlog_mod.RequestLog(root / "serve-requests.jsonl",
+                                        clock=clock.time,
+                                        echo=lambda line: None,
+                                        fsync=False)
+                  if with_reqlog else None)
         gateway = gw_mod.Gateway(
             engines, FileHealthSource(status_path), policy=policy,
-            clock=clock.time,
+            clock=clock.time, reqlog=reqlog,
         )
         model = traffic_mod.TrafficModel(
             base_rps=base_rps, diurnal_amplitude=diurnal_amplitude,
             diurnal_period_s=600.0, bursts=tuple(bursts), seed=seed,
+            deadline_s=deadline_s,
+            key_prefix=(f"s{seed}" if with_reqlog else None),
         )
         arrivals = traffic_mod.generate_arrivals(model, duration_s)
 
@@ -1656,6 +1670,9 @@ def run_serve_scenario(
             "sheds": len(sheds),
             "sheds_outside_demand_window": len(sheds_outside_window),
             "overload_sheds_below_budget": len(overload_without_depth),
+            "expired": report["expired"],
+            "deadline_s": deadline_s,
+            "journaled": with_reqlog,
         }
         if outage is not None:
             t0, t_heal = window
@@ -1712,9 +1729,16 @@ def run_serve_benchmark(num_slices: int = 4) -> dict:
       SLO budget demands, and drains back to quiescent;
     - a breaker-open hold: every request inside the window refused
       429-style with retry-after, zero admissions leak through.
+
+    Since the request-plane resilience PR, every drive runs WITH the
+    request journal attached and a 300 s default deadline — the
+    PR-9 numbers must hold with the durability machinery on (the
+    deadline is sized so it never binds under healthy drainage;
+    `expired` must stay 0 in the continuous drive).
     """
     common = dict(num_slices=num_slices, duration_s=1200.0,
-                  base_rps=7.0, queue_budget=64, seed=11)
+                  base_rps=7.0, queue_budget=64, seed=11,
+                  deadline_s=300.0, with_reqlog=True)
     rat = run_serve_scenario(slots=1, prefill_chunk=256, **common)
     cont = run_serve_scenario(
         slots=8, prefill_chunk=64,
@@ -1732,13 +1756,14 @@ def run_serve_benchmark(num_slices: int = 4) -> dict:
         slots=8, prefill_chunk=64, base_rps=9.0,
         diurnal_amplitude=0.15,
         duration_s=1200.0, num_slices=num_slices, queue_budget=64,
-        seed=11,
+        seed=11, deadline_s=300.0, with_reqlog=True,
         outage={"slice": 2, "at": 690.0, "detect_s": 30.0,
                 "heal_s": 120.0},
     )
     breaker = run_serve_scenario(
         slots=8, prefill_chunk=64, base_rps=2.0, duration_s=360.0,
         num_slices=num_slices, queue_budget=64, seed=11,
+        deadline_s=300.0, with_reqlog=True,
         shed_window=(120.0, 240.0),
     )
     speedup = (round(cont["tokens_per_sec"] / rat["tokens_per_sec"], 3)
@@ -1750,6 +1775,10 @@ def run_serve_benchmark(num_slices: int = 4) -> dict:
         and cont["p99_latency_s"] <= rat["p99_latency_s"]
         and cont["quiescent"]
         and cont["overload_sheds_below_budget"] == 0
+        # with journaling + deadlines enabled the 300s budget must not
+        # bind under healthy drainage — an expiry here means the
+        # deadline machinery cost throughput it had no right to
+        and cont["expired"] == 0
         # outage: bounded tail, no stranded work, sheds only while the
         # lost capacity makes the budget demand it, goodput holds
         and outage["quiescent"]
@@ -1784,6 +1813,80 @@ def run_serve_benchmark(num_slices: int = 4) -> dict:
     }
 
 
+def run_serve_chaos_benchmark(campaigns: int = 25) -> dict:
+    """The request-plane resilience acceptance datapoint, one
+    BENCH-style JSON document:
+
+    - N seeded supervisor+gateway campaigns (testing/chaos.py
+      `run_serve_campaign`): a REAL Supervisor reconciling a scripted
+      world and a REAL Gateway serving seeded open-loop traffic with
+      deadlines + idempotency keys as co-actors on one SimClock, every
+      campaign's request journal and event ledger folded through the
+      ServeInvariantChecker — request conservation, no double-service,
+      deadline honesty, honest Retry-After, bounded view staleness,
+      cross-ledger consistency. Zero violations is the bar.
+    - the gateway SIGKILL drill (`run_gateway_kill_drill`): a crash
+      mid-dispatch must lose ZERO accepted requests — incomplete work
+      re-admitted front-of-queue from the journal, duplicates of
+      completed keys answered from the recorded result — with
+      restart-to-first-token MTTR as the headline metric.
+    """
+    from tritonk8ssupervisor_tpu.testing import chaos
+
+    results: list = []
+    violations: list = []
+    with tempfile.TemporaryDirectory(prefix="tk8s-servechaos-") as tmp:
+        for seed in range(1, campaigns + 1):
+            scenario = chaos.generate_serve_scenario(seed)
+            out = chaos.run_serve_campaign(
+                scenario, Path(tmp) / f"seed-{seed}"
+            )
+            results.append(out)
+            violations += [f"seed {seed}: {v}"
+                           for v in out["violations"]]
+        kill = chaos.run_gateway_kill_drill(Path(tmp) / "kill-drill")
+    violations += [f"kill-drill: {v}" for v in kill["violations"]]
+    converged = sum(1 for r in results if r["converged"])
+    primitives: dict = {}
+    for r in results:
+        for kind in r["events"]:
+            primitives[kind] = primitives.get(kind, 0) + 1
+    passes = bool(
+        not violations
+        and converged == len(results)
+        and kill["requests_lost"] == 0
+        and kill["requests_redone"] > 0
+        and kill["duplicates_replayed_from_journal"]
+        == kill["duplicates_resubmitted"]
+        and kill["restart_to_first_token_s"] is not None
+    )
+    return {
+        "benchmark": "serve_chaos",
+        "metric": "gateway_restart_to_first_token",
+        "unit": ("s (SIGKILL mid-dispatch -> journal recover -> first "
+                 "token; plus N seeded supervisor+gateway campaigns "
+                 "with zero request-plane invariant violations)"),
+        "value": kill["restart_to_first_token_s"],
+        "campaigns": {
+            "campaigns": len(results),
+            "converged": converged,
+            "violation_count": len(violations),
+            "violations": violations[:50],
+            "primitives": dict(sorted(primitives.items())),
+            "accepted": sum(r["accepted"] for r in results),
+            "completed": sum(r["completed"] for r in results),
+            "expired": sum(r["expired"] for r in results),
+            "sheds": sum(r["sheds"] for r in results),
+            "requeues": sum(r["requeues"] for r in results),
+            "gateway_kills": sum(r["gateway_kills"] for r in results),
+            "redone_after_kill": sum(r["redone_after_kill"]
+                                     for r in results),
+        },
+        "kill_drill": kill,
+        "passes": passes,
+    }
+
+
 # ------------------------------------------------------ the regression gate
 
 
@@ -1794,6 +1897,8 @@ FLEETSCALE_BASELINE = (Path(__file__).resolve().parent
                        / "BENCH_fleetscale.json")
 CHAOS_BASELINE = Path(__file__).resolve().parent / "BENCH_chaos.json"
 SERVE_BASELINE = Path(__file__).resolve().parent / "BENCH_serve.json"
+SERVECHAOS_BASELINE = (Path(__file__).resolve().parent
+                       / "BENCH_servechaos.json")
 
 
 def run_check(
@@ -1804,6 +1909,7 @@ def run_check(
     fleetscale_baseline: Path = FLEETSCALE_BASELINE,
     chaos_baseline: Path = CHAOS_BASELINE,
     serve_baseline: Path = SERVE_BASELINE,
+    servechaos_baseline: Path = SERVECHAOS_BASELINE,
 ) -> tuple[bool, list[str], dict]:
     """Re-simulate against the committed BENCH_provision.json,
     BENCH_supervise.json, BENCH_elastic.json, and BENCH_fleetscale.json:
@@ -1974,6 +2080,36 @@ def run_check(
                 "sheds only while the breaker/SLO budget demands; "
                 "breaker hold admits nothing)"
             )
+
+    servechaos_baseline = Path(servechaos_baseline)
+    if not servechaos_baseline.exists():
+        problems.append(f"baseline {servechaos_baseline} missing "
+                        "(serve-chaos)")
+    else:
+        committed_sc = json.loads(servechaos_baseline.read_text())
+        current_sc = run_serve_chaos_benchmark(
+            int(committed_sc.get("campaigns", {}).get("campaigns", 25))
+        )
+        current["serve_chaos"] = current_sc
+        for violation in current_sc["campaigns"]["violations"]:
+            problems.append(
+                f"serve-chaos invariant violated: {violation}"
+            )
+        if current_sc["kill_drill"]["requests_lost"] > 0:
+            problems.append(
+                "gateway kill drill LOST "
+                f"{current_sc['kill_drill']['requests_lost']} accepted "
+                "request(s) across the restart (journal recover broken)"
+            )
+        compare("gateway restart-to-first-token",
+                committed_sc.get("value"), current_sc["value"])
+        if not current_sc["passes"]:
+            problems.append(
+                "serve-chaos drill no longer passes (every campaign "
+                "converged with zero request-plane violations; kill "
+                "drill redoes incomplete work, loses nothing, answers "
+                "duplicates from the journal)"
+            )
     return not problems, problems, current
 
 
@@ -2022,6 +2158,15 @@ def main(argv: list[str] | None = None) -> int:
                         "vs continuous-batching, plus a mid-run slice "
                         "outage (route-around, requeue, SLO shedding) "
                         "and a breaker-open hold (BENCH_serve.json)")
+    parser.add_argument("--serve-chaos", action="store_true",
+                        help="run the request-plane resilience drills: "
+                        "N seeded supervisor+gateway campaigns (real "
+                        "Supervisor + real Gateway co-simulated on one "
+                        "SimClock, request journal + event ledger "
+                        "checked for conservation / exactly-once / "
+                        "deadline honesty / bounded staleness) plus "
+                        "the gateway SIGKILL crash-resume drill "
+                        "(BENCH_servechaos.json)")
     parser.add_argument("--check", action="store_true",
                         help="perf-regression gate: fail if the simulated "
                         "cold/warm makespan regressed >10%% vs the "
@@ -2057,6 +2202,8 @@ def main(argv: list[str] | None = None) -> int:
         result = run_chaos_benchmark(campaigns=max(1, args.campaigns))
     elif args.serve:
         result = run_serve_benchmark(args.slices)
+    elif args.serve_chaos:
+        result = run_serve_chaos_benchmark(campaigns=max(1, args.campaigns))
     elif args.warm:
         result = {
             "benchmark": "provision_warm",
@@ -2158,6 +2305,28 @@ def main(argv: list[str] | None = None) -> int:
             f"{sweep['violation_count']} invariant violation(s), MTTR "
             f"mean {sweep['mttr_mean_s']:.0f}s / max "
             f"{sweep['mttr_max_s']:.0f}s -> passes={result['passes']}",
+            file=sys.stderr,
+        )
+        return 0 if result["passes"] else 1
+    if args.serve_chaos:
+        sweep = result["campaigns"]
+        kill = result["kill_drill"]
+        print(
+            f"\nserve chaos (simulated): {sweep['campaigns']} seeded "
+            f"supervisor+gateway campaigns: {sweep['converged']} "
+            f"converged, {sweep['violation_count']} request-plane "
+            f"violation(s) ({sweep['accepted']} accepted -> "
+            f"{sweep['completed']} completed + {sweep['expired']} "
+            f"expired, {sweep['requeues']} requeues, "
+            f"{sweep['gateway_kills']} gateway kill(s)); kill drill: "
+            f"{kill['inflight_at_kill']} in-flight at SIGKILL, "
+            f"{kill['requests_redone']} redone, "
+            f"{kill['requests_lost']} lost, "
+            f"{kill['duplicates_replayed_from_journal']}/"
+            f"{kill['duplicates_resubmitted']} duplicates answered "
+            f"from the journal, restart-to-first-token "
+            f"{kill['restart_to_first_token_s']}s -> "
+            f"passes={result['passes']}",
             file=sys.stderr,
         )
         return 0 if result["passes"] else 1

@@ -1,0 +1,732 @@
+"""Request-plane resilience: deadlines, the crash-safe request journal
+(serving/reqlog.py), gateway crash-resume, the EngineLoop crash path,
+and the serve-chaos campaigns (testing/chaos.py) that assert request
+conservation / exactly-once / deadline honesty across supervisor +
+gateway on one virtual clock.
+
+Layers under test:
+
+- `RequestLog`: the fsync'd torn-line-truncating JSONL discipline
+  inherited from provision/events.py, the per-key fold, and compact()
+  round-tripping (fold(compacted + later) == fold(original + later));
+- the gateway's deadline machinery: admission feasibility against the
+  observed service rate, skip-and-expire at claim, slot reclaim at
+  step boundaries (completion wins an exact tie; unfinished expires),
+  requeue expiry, and the where-the-time-went audit;
+- exactly-once: duplicate idempotency keys racing their own completion
+  refused, COMPLETED keys answered from the journal, recover()
+  re-admitting incomplete work front-of-queue after a crash;
+- `ServeInvariantChecker`: each forbidden history is caught;
+- the tier-1 serve-chaos smoke (real Supervisor + real Gateway
+  co-simulated), the gateway SIGKILL drill, and the --check gate.
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from tritonk8ssupervisor_tpu.provision import events as ev
+from tritonk8ssupervisor_tpu.provision import fleetview
+from tritonk8ssupervisor_tpu.serving import gateway as gw
+from tritonk8ssupervisor_tpu.serving import reqlog as rl
+from tritonk8ssupervisor_tpu.testing import chaos
+
+
+class FakeClock:
+    def __init__(self, start=0.0):
+        self.now = float(start)
+
+    def __call__(self):
+        return self.now
+
+
+def make_gateway(tmp_path=None, num_slices=1, slots=2, health=None,
+                 clock=None, echo=None, **policy_kwargs):
+    policy_kwargs.setdefault("max_seq_len", 512)
+    policy_kwargs.setdefault("bucket_bounds", (64, 128, 256))
+    policy_kwargs.setdefault("prefill_chunk", 64)
+    policy = gw.GatewayPolicy(slots_per_slice=slots, **policy_kwargs)
+    engines = {
+        i: gw.ModeledEngine(slots=slots, prefill_chunk=64)
+        for i in range(num_slices)
+    }
+    clock = clock or FakeClock()
+    reqlog = None
+    if tmp_path is not None:
+        reqlog = rl.RequestLog(tmp_path / "serve-requests.jsonl",
+                               clock=clock, echo=lambda line: None)
+    return gw.Gateway(engines, health, policy=policy, clock=clock,
+                      echo=echo or (lambda line: None), reqlog=reqlog)
+
+
+def req(rid, prompt=8, new=2, deadline=None, key=None):
+    return gw.Request(rid=rid, prompt_len=prompt, max_new_tokens=new,
+                      deadline_s=deadline, key=key)
+
+
+# ------------------------------------------------------- journal basics
+
+
+def test_reqlog_torn_final_line_truncated_on_restart(tmp_path):
+    """The one write a SIGKILL interrupted is truncated away on
+    replay — the events.py discipline, inherited not copied."""
+    log = rl.RequestLog(tmp_path / "r.jsonl", echo=lambda line: None)
+    log.append(rl.ACCEPTED, key="a", rid=1, prompt_len=8,
+               max_new_tokens=2)
+    log.append(rl.DISPATCHED, key="a", rid=1, slice=0)
+    with (tmp_path / "r.jsonl").open("a") as f:
+        f.write('{"v": 1, "kind": "comp')  # the torn write
+    fresh = rl.RequestLog(tmp_path / "r.jsonl", echo=lambda line: None)
+    records = fresh.replay()
+    assert [r["kind"] for r in records] == [rl.ACCEPTED, rl.DISPATCHED]
+    # physically truncated: a second replay sees a clean file
+    assert fresh.replay() == records
+    view = rl.fold(records)
+    assert view.keys["a"].state == "dispatched"
+
+
+def test_reqlog_fold_state_machine_and_trail():
+    records = [
+        {"ts": 1.0, "kind": rl.ACCEPTED, "key": "k", "rid": 7,
+         "prompt_len": 8, "max_new_tokens": 4, "deadline_s": 30.0},
+        {"ts": 2.0, "kind": rl.DISPATCHED, "key": "k", "slice": 1},
+        {"ts": 3.0, "kind": rl.REQUEUED, "key": "k",
+         "cause": "slice-loss"},
+        {"ts": 4.0, "kind": rl.DISPATCHED, "key": "k", "slice": 0},
+        {"ts": 5.0, "kind": rl.COMPLETED, "key": "k",
+         "result": {"tokens": [1, 2], "generated": 2}},
+        {"ts": 6.0, "kind": rl.REPLAYED, "key": "k"},
+    ]
+    kv = rl.fold(records).keys["k"]
+    assert kv.state == "completed" and kv.terminal
+    assert kv.dispatches == 2 and kv.requeues == 1 and kv.replays == 1
+    assert kv.result == {"tokens": [1, 2], "generated": 2}
+    assert kv.deadline_at == pytest.approx(31.0)
+    assert [e["kind"] for e in kv.trail] == [
+        rl.ACCEPTED, rl.DISPATCHED, rl.REQUEUED, rl.DISPATCHED,
+        rl.COMPLETED, rl.REPLAYED,
+    ]
+
+
+def test_reqlog_compact_roundtrips_then_folds_later_records(tmp_path):
+    """fold(compacted + later records) == fold(original + later
+    records): compaction forgets history, never state."""
+    log = rl.RequestLog(tmp_path / "r.jsonl", echo=lambda line: None)
+    log.append(rl.ACCEPTED, key="done", rid=1, prompt_len=8,
+               max_new_tokens=2, deadline_s=None)
+    log.append(rl.COMPLETED, key="done", rid=1,
+               result={"tokens": [9], "generated": 1})
+    log.append(rl.ACCEPTED, key="open", rid=2, prompt_len=16,
+               max_new_tokens=4, deadline_s=60.0)
+    log.append(rl.DISPATCHED, key="open", rid=2, slice=0)
+    before = rl.fold(log.replay())
+    dropped = log.compact()
+    assert dropped > 0
+    after = rl.fold(log.replay())
+    for key in ("done", "open"):
+        a, b = before.keys[key], after.keys[key]
+        assert (a.state, a.rid, a.deadline_s, a.result, a.dispatches) \
+            == (b.state, b.rid, b.deadline_s, b.result, b.dispatches)
+    # later records fold on top of the compacted state
+    log.append(rl.COMPLETED, key="open", rid=2,
+               result={"tokens": [], "generated": 4})
+    final = rl.fold(log.replay())
+    assert final.keys["open"].state == "completed"
+    assert final.incomplete() == []
+
+
+def test_recover_after_compact_requeues_and_answers(tmp_path):
+    """The satellite pin: replay-after-compact() — a restarted gateway
+    folding a COMPACTED journal still re-admits incomplete work and
+    answers completed duplicates."""
+    clock = FakeClock()
+    g1 = make_gateway(tmp_path, clock=clock)
+    assert g1.submit(req(1, key="a"), now=0.0).ok
+    assert g1.submit(req(2, key="b"), now=1.0).ok
+    # serve "a" to completion; "b" stays queued
+    claimed = g1.claim(0, now=2.0)
+    assert claimed.key == "a"
+    claimed.generated, claimed.done_at = 2, 3.0
+    claimed.out_tokens = [5, 6]
+    g1.complete(claimed)
+    g1.reqlog.compact()
+    # the crash: a fresh gateway over the compacted journal
+    clock.now = 10.0
+    g2 = make_gateway(tmp_path, clock=clock)
+    recovered = g2.recover(10.0)
+    assert recovered == {"redone": 1, "completed_cached": 1,
+                         "expired_on_recover": 0}
+    got = g2.submit(req(9, key="a"), now=10.0)
+    assert got.ok and got.reason == gw.REPLAYED
+    assert got.result["tokens"] == [5, 6]
+    assert g2.claim(0, now=10.0).key == "b"
+
+
+# ---------------------------------------------------- deadline machinery
+
+
+def test_claim_skips_and_expires_dead_requests(tmp_path):
+    """Skip-and-expire at pull time: a request whose caller gave up is
+    never dispatched; the next live request is served instead, and the
+    expiry audit says where the time went."""
+    fired = []
+    g = make_gateway(tmp_path)
+    dead = req(1, deadline=1.0, key="dead")
+    dead.notify = lambda r: fired.append(r.rid)
+    live = req(2, key="live")
+    assert g.submit(dead, now=0.0).ok
+    assert g.submit(live, now=0.5).ok
+    got = g.claim(0, now=2.0)  # past rid 1's deadline
+    assert got.key == "live"
+    assert fired == [1]
+    assert dead.expired_at == 2.0 and dead.expired_where == "queue"
+    audit = g.metrics.expired[0]
+    assert audit["where"] == "queue"
+    assert audit["age_s"] == pytest.approx(2.0)
+    assert audit["served_s"] == 0.0
+    kinds = [r["kind"] for r in g.reqlog.replay()
+             if r.get("key") == "dead"]
+    assert kinds == [rl.ACCEPTED, rl.EXPIRED]
+
+
+def test_slot_expiry_and_exact_boundary_semantics(tmp_path):
+    """The step-boundary tie rules: a request FINISHING exactly at its
+    deadline is served (completion wins); one still unfinished at a
+    boundary on its deadline has the slot reclaimed; one finishing
+    strictly past it is a 504, never a late 200."""
+    # probe the modeled engine's boundary times for prompt=8, new=3:
+    # prefill boundary emits token 1, then 2 decode boundaries
+    probe = gw.ModeledEngine(slots=1, prefill_chunk=64)
+    probe.join(0, req(0, new=3))
+    dts = []
+    while True:
+        result = probe.step()
+        if result is None:
+            break
+        dts.append(result.dt)
+        if 0 in result.finished:
+            break
+    done_at = sum(dts)  # the completion boundary's end
+
+    # completion exactly AT the deadline: served
+    g = make_gateway(num_slices=1, slots=1)
+    tie = req(1, new=3, deadline=done_at)
+    assert g.submit(tie, now=0.0).ok
+    t = 0.0
+    while tie.done_at is None and tie.expired_at is None:
+        dt = g.workers[0].step(t)
+        assert dt is not None
+        t += dt
+    assert tie.done_at == pytest.approx(done_at)
+    assert tie.expired_at is None
+
+    # unfinished at a boundary ON the deadline: slot reclaimed
+    g2 = make_gateway(num_slices=1, slots=1)
+    early = req(2, new=3, deadline=dts[0])  # expires at 1st boundary
+    assert g2.submit(early, now=0.0).ok
+    assert g2.workers[0].step(0.0) is not None
+    assert early.expired_at == pytest.approx(dts[0])
+    assert early.expired_where == "slot"
+    assert g2.workers[0].idle()  # the slot is free again
+
+    # finishing strictly PAST the deadline: expired, not completed
+    g3 = make_gateway(num_slices=1, slots=1)
+    late = req(3, new=3, deadline=done_at - 1e-6)
+    assert g3.submit(late, now=0.0).ok
+    t = 0.0
+    while late.done_at is None and late.expired_at is None:
+        dt = g3.workers[0].step(t)
+        assert dt is not None
+        t += dt
+    assert late.done_at is None
+    assert late.expired_where == "slot"
+    assert g3.metrics.completed == []
+
+
+def test_requeue_expiry_when_deadline_lapsed_while_stranded(tmp_path):
+    """A request stranded in a dead worker whose deadline lapses before
+    the requeue lands settles terminal-expired (where=requeue) instead
+    of re-entering the queue as a zombie."""
+    g = make_gateway(tmp_path, num_slices=1, slots=1)
+    stranded = req(1, deadline=5.0, key="stranded")
+    assert g.submit(stranded, now=0.0).ok
+    assert g.workers[0].step(0.0) is not None  # dispatched into slot 0
+    assert g.workers[0].inflight
+    g.fail_worker(0, now=20.0, error="engine died")  # past the deadline
+    assert stranded.expired_where == "requeue"
+    assert g.queue_depth() == 0
+    view = rl.fold(g.reqlog.replay())
+    assert view.keys["stranded"].state == "expired"
+
+
+def test_admission_refuses_unmeetable_deadline_with_honest_hint():
+    """Deadline feasibility: once the observed completion rate says the
+    queue ahead outlasts the budget, admission refuses 429-style with
+    a Retry-After sized to the excess wait."""
+    g = make_gateway(num_slices=1, slots=1, queue_budget=500)
+    # build service-rate evidence: serve 10 quick requests
+    t = 0.0
+    for rid in range(10):
+        assert g.submit(req(rid), now=t).ok
+        while g.metrics.completed[-1:] == [] or \
+                g.metrics.completed[-1].rid != rid:
+            dt = g.workers[0].step(t)
+            assert dt is not None
+            t += dt
+    rate = g.service_rate()
+    assert rate is not None and rate > 0
+    # now stack a deep queue and offer a deadline it cannot clear
+    for rid in range(100, 140):
+        assert g.submit(req(rid), now=t).ok
+    wait = g.estimated_queue_wait()
+    assert wait is not None and wait > 0.5
+    hopeless = req(999, deadline=wait / 10.0)
+    got = g.submit(hopeless, now=t)
+    assert got.ok is False
+    assert got.reason == gw.REJECT_DEADLINE
+    assert got.retry_after_s >= 1.0
+    # a deadline the queue CAN clear is admitted
+    assert g.submit(req(1000, deadline=10 * wait + 60.0), now=t).ok
+
+
+# ------------------------------------------------------- exactly-once
+
+
+def test_duplicate_key_racing_its_own_completion(tmp_path):
+    """The satellite pin: a duplicate submission while the key is in
+    flight is refused 429-style (never served twice); after completion
+    the duplicate is answered from the journal without regenerating."""
+    g = make_gateway(tmp_path, num_slices=1, slots=1)
+    first = req(1, key="k")
+    assert g.submit(first, now=0.0).ok
+    racing = g.submit(req(2, key="k"), now=0.1)
+    assert racing.ok is False
+    assert racing.reason == gw.REJECT_DUPLICATE
+    assert racing.retry_after_s > 0
+    t = 0.2
+    while first.done_at is None:
+        dt = g.workers[0].step(t)
+        assert dt is not None
+        t += dt
+    after = g.submit(req(3, key="k"), now=t)
+    assert after.ok and after.reason == gw.REPLAYED
+    assert after.result["generated"] == first.generated
+    records = g.reqlog.replay()
+    kinds = [r["kind"] for r in records if r.get("key") == "k"]
+    assert kinds.count(rl.COMPLETED) == 1
+    assert kinds.count(rl.ACCEPTED) == 1
+    assert rl.REPLAYED in kinds
+    # the raw history passes the exactly-once checker
+    checker = chaos.ServeInvariantChecker(g.policy)
+    assert checker.check(records) == []
+
+
+def test_recover_readmits_incomplete_front_of_queue(tmp_path):
+    """Crash-resume: accepted and dispatched-but-unfinished keys are
+    re-admitted at the FRONT of the queue in acceptance order — the
+    generation-bump requeue semantics, across a process death."""
+    clock = FakeClock()
+    g1 = make_gateway(tmp_path, clock=clock)
+    for rid, key in ((1, "a"), (2, "b"), (3, "c")):
+        clock.now = float(rid)
+        assert g1.submit(req(rid, key=key), now=clock.now).ok
+    assert g1.claim(0, now=4.0).key == "a"  # dispatched, never finishes
+    # the crash; a later request arrives at the restarted gateway first
+    clock.now = 10.0
+    g2 = make_gateway(tmp_path, clock=clock)
+    assert g2.recover(10.0)["redone"] == 3
+    assert g2.submit(req(9, key="late"), now=10.0).ok
+    order = [g2.claim(0, now=11.0).key for _ in range(4)]
+    assert order == ["a", "b", "c", "late"]
+    # finish every claim by hand: across the WHOLE journal (both
+    # gateway lifetimes) each acceptance must still conserve
+    for key, rid in (("a", 1), ("b", 2), ("c", 3), ("late", 9)):
+        done = req(rid, key=key)
+        done.arrival, done.generated, done.done_at = 10.0, 2, 12.0
+        g2.complete(done)
+    checker = chaos.ServeInvariantChecker(g2.policy)
+    assert checker.check(g2.reqlog.replay()) == []
+
+
+def test_recover_expires_deadlines_lapsed_during_outage(tmp_path):
+    clock = FakeClock()
+    g1 = make_gateway(tmp_path, clock=clock)
+    assert g1.submit(req(1, deadline=5.0, key="doomed"), now=0.0).ok
+    assert g1.submit(req(2, deadline=500.0, key="alive"), now=0.0).ok
+    clock.now = 100.0  # the gateway was down for 100s
+    g2 = make_gateway(tmp_path, clock=clock)
+    out = g2.recover(100.0)
+    assert out == {"redone": 1, "completed_cached": 0,
+                   "expired_on_recover": 1}
+    view = rl.fold(g2.reqlog.replay())
+    assert view.keys["doomed"].state == "expired"
+    assert view.keys["doomed"].expired["where"] == "recover"
+    assert g2.claim(0, now=100.0).key == "alive"
+
+
+# --------------------------------------------------- cold start + crash
+
+
+def test_no_fleet_view_cold_start_sheds_and_logs_once(tmp_path):
+    """The Router cold-start satellite: a configured health source with
+    NO view ever read sheds the distinct no-fleet-view reason (429),
+    logs once per poll interval, and lifts on the first real view."""
+    lines = []
+    status = tmp_path / "fleet-status.json"
+    g = make_gateway(health=fleetview.FileHealthSource(status),
+                     echo=lines.append)
+    first = g.submit(req(1), now=0.0)
+    assert first.ok is False
+    assert first.reason == gw.REJECT_NO_FLEET_VIEW
+    assert first.retry_after_s is not None and first.retry_after_s > 0
+    g.submit(req(2), now=0.5)  # inside the poll interval
+    assert len([ln for ln in lines if "no fleet view" in ln]) == 1
+    g.submit(req(3), now=2.5)  # a later interval: logged again
+    assert len([ln for ln in lines if "no fleet view" in ln]) == 2
+    assert g.report()["serving"]["no_fleet_view_sheds"] == 3
+    assert g.report()["serving"]["view"] == "none"
+    # the supervisor publishes: admission opens without a restart
+    ev.write_fleet_status(status, {
+        "verdict": "healthy", "slices_total": 1,
+        "membership": {"generation": 1, "heal_in_progress": False,
+                       "draining": []},
+        "degraded": [],
+        "serving": {"eligible": [0], "avoid": {}, "shed": False},
+    })
+    assert g.submit(req(4), now=5.0).ok is True
+
+
+def test_no_view_shed_skipped_for_standalone_gateways():
+    """health=None (drills) and allow_no_view keep the PR-9 behavior:
+    no supervisor, no advice, serve on everything."""
+    assert make_gateway(health=None).submit(req(1), now=0.0).ok
+    g = make_gateway(
+        health=fleetview.FileHealthSource("/nonexistent/status.json"),
+        allow_no_view=True,
+    )
+    assert g.submit(req(2), now=0.0).ok
+
+
+class _BoomEngine:
+    """An engine that dies mid-step — the EngineLoop crash seam."""
+
+    def __init__(self):
+        self.slots = 1
+        self._joined = {}
+
+    def busy_slots(self):
+        return len(self._joined)
+
+    def join(self, slot, request):
+        self._joined[slot] = request
+
+    def release(self, slot):
+        self._joined.pop(slot, None)
+
+    def reset(self):
+        self._joined.clear()
+
+    def step(self):
+        raise RuntimeError("XLA device lost")
+
+
+def test_engine_loop_crash_requeues_and_surfaces_503(tmp_path):
+    """The EngineLoop satellite: an engine raising mid-step is caught,
+    its in-flight slots are requeued through the journal, the healthy
+    worker finishes the work, and /healthz turns 503."""
+    from http.server import ThreadingHTTPServer
+    import http.client
+
+    from tritonk8ssupervisor_tpu.serving import server as server_mod
+
+    clock = time.monotonic
+    reqlog = rl.RequestLog(tmp_path / "r.jsonl", echo=lambda line: None)
+    policy = gw.GatewayPolicy(max_seq_len=512,
+                              bucket_bounds=(64, 128, 256),
+                              slots_per_slice=2)
+    engines = {0: _BoomEngine(),
+               1: gw.ModeledEngine(slots=2, prefill_chunk=64)}
+    gateway = gw.Gateway(engines, None, policy=policy, clock=clock,
+                         reqlog=reqlog)
+    lock = threading.Lock()
+    loop = server_mod.EngineLoop(gateway, lock)
+    server = ThreadingHTTPServer(
+        ("127.0.0.1", 0),
+        server_mod.make_handler(gateway, lock, loop=loop),
+    )
+    port = server.server_address[1]
+    server_thread = threading.Thread(target=server.serve_forever,
+                                     kwargs={"poll_interval": 0.05},
+                                     daemon=True)
+    done = [threading.Event(), threading.Event()]
+    requests = [
+        gw.Request(rid=i, prompt_len=8, max_new_tokens=2,
+                   key=f"boom-{i}",
+                   notify=lambda _r, e=done[i]: e.set())
+        for i in range(2)
+    ]
+    loop.start()
+    server_thread.start()
+    try:
+        with lock:
+            for request in requests:
+                assert gateway.submit(request, clock()).ok
+        for event in done:
+            assert event.wait(30.0), "a waiter was stranded"
+        assert loop.crashed is not None
+        # every request settled COMPLETED on the surviving worker
+        assert all(r.done_at is not None for r in requests)
+        assert all(r.slice_index == 1 for r in requests)
+        # the crash requeue went through the journal
+        causes = [r.get("cause") for r in reqlog.replay()
+                  if r["kind"] == rl.REQUEUED]
+        assert "engine-failure" in causes
+        assert gateway.metrics.engine_failures[0]["slice"] == 0
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        conn.request("GET", "/healthz")
+        resp = conn.getresponse()
+        body = json.loads(resp.read())
+        conn.close()
+        assert resp.status == 503
+        assert "XLA device lost" in body["engine_crashed"]
+        assert body["serving"]["engine_failures"] == 1
+    finally:
+        server.shutdown()
+        server.server_close()
+        loop.stop()
+    checker = chaos.ServeInvariantChecker(policy)
+    assert checker.check(reqlog.replay()) == []
+
+
+def test_run_drill_deadline_expiry_case(tmp_path):
+    """The server satellite: run_drill's deadline-expiry case settles
+    as a clean 504-class terminal with the journal trail, instead of a
+    TimeoutError into the caller."""
+    from tritonk8ssupervisor_tpu.serving import server as server_mod
+
+    g = make_gateway(tmp_path, num_slices=1, slots=2)
+    report = server_mod.run_drill(g, 2, vocab_size=64, expire_one=True)
+    assert report["completed"] == 2
+    assert len(report["results"]) == 2
+    assert report["expired"] == 1
+    assert len(report["expiries"]) == 1
+    expiry = report["expiries"][0]
+    assert expiry["error"] == "deadline-expired"
+    assert expiry["where"] == "queue"
+    assert [e["kind"] for e in expiry["trail"]][:1] == [rl.ACCEPTED]
+
+
+# ------------------------------------------------ checker unit coverage
+
+
+def policy_for_checker(**kw):
+    kw.setdefault("queue_budget", 8)
+    return gw.GatewayPolicy(**kw)
+
+
+def test_checker_flags_lost_and_unaccepted_requests():
+    checker = chaos.ServeInvariantChecker(policy_for_checker())
+    lost = [{"ts": 1.0, "kind": rl.ACCEPTED, "key": "k", "rid": 1}]
+    assert any("request-conservation" in v and "0 terminal" in v
+               for v in checker.check_conservation(lost))
+    phantom = [{"ts": 1.0, "kind": rl.COMPLETED, "key": "ghost"}]
+    assert any("without ever being accepted" in v
+               for v in checker.check_conservation(phantom))
+    clean = lost + [{"ts": 2.0, "kind": rl.EXPIRED, "key": "k",
+                     "where": "queue"}]
+    assert checker.check_conservation(clean) == []
+
+
+def test_checker_flags_double_service_and_zombie_dispatch():
+    checker = chaos.ServeInvariantChecker(policy_for_checker())
+    twice = [
+        {"ts": 1.0, "kind": rl.ACCEPTED, "key": "k"},
+        {"ts": 2.0, "kind": rl.COMPLETED, "key": "k"},
+        {"ts": 3.0, "kind": rl.COMPLETED, "key": "k"},
+    ]
+    assert any("double-service" in v and "COMPLETED twice" in v
+               for v in checker.check_no_double_service(twice))
+    zombie = [
+        {"ts": 1.0, "kind": rl.ACCEPTED, "key": "k"},
+        {"ts": 2.0, "kind": rl.EXPIRED, "key": "k", "where": "queue"},
+        {"ts": 3.0, "kind": rl.DISPATCHED, "key": "k", "slice": 0},
+    ]
+    assert any("AFTER its terminal state" in v
+               for v in checker.check_no_double_service(zombie))
+    # a fresh acceptance re-opens the key legally
+    retried = zombie[:2] + [
+        {"ts": 3.0, "kind": rl.ACCEPTED, "key": "k"},
+        {"ts": 4.0, "kind": rl.DISPATCHED, "key": "k", "slice": 0},
+        {"ts": 5.0, "kind": rl.COMPLETED, "key": "k"},
+    ]
+    assert checker.check_no_double_service(retried) == []
+
+
+def test_checker_flags_deadline_dishonesty():
+    checker = chaos.ServeInvariantChecker(policy_for_checker())
+    base = {"ts": 0.0, "kind": rl.ACCEPTED, "key": "k",
+            "deadline_s": 10.0}
+    late_dispatch = [base, {"ts": 10.0, "kind": rl.DISPATCHED,
+                            "key": "k", "slice": 0}]
+    assert any("dispatched" in v and "on/after its deadline" in v
+               for v in checker.check_deadline_honesty(late_dispatch))
+    late_serve = [base, {"ts": 11.0, "kind": rl.COMPLETED, "key": "k"}]
+    assert any("must be a 504" in v
+               for v in checker.check_deadline_honesty(late_serve))
+    early_expiry = [base, {"ts": 4.0, "kind": rl.EXPIRED, "key": "k",
+                           "where": "queue"}]
+    assert any("BEFORE its deadline" in v
+               for v in checker.check_deadline_honesty(early_expiry))
+    honest = [base,
+              {"ts": 3.0, "kind": rl.DISPATCHED, "key": "k", "slice": 0},
+              {"ts": 9.0, "kind": rl.COMPLETED, "key": "k"}]
+    assert checker.check_deadline_honesty(honest) == []
+
+
+def test_checker_flags_dishonest_retry_after():
+    checker = chaos.ServeInvariantChecker(policy_for_checker())
+    bad = [
+        {"ts": 1.0, "kind": rl.SHED, "reason": "breaker-open",
+         "retry_after_s": None},
+        {"ts": 2.0, "kind": rl.SHED, "reason": "overload",
+         "retry_after_s": 5.0, "depth": 2},  # budget is 8: not binding
+        {"ts": 3.0, "kind": rl.SHED, "reason": "unservable",
+         "retry_after_s": 4.0},  # retrying cannot help: no hint allowed
+    ]
+    violations = checker.check_retry_after_honesty(bad)
+    assert len(violations) == 3
+    good = [
+        {"ts": 1.0, "kind": rl.SHED, "reason": "overload",
+         "retry_after_s": 5.8, "depth": 8},
+        {"ts": 2.0, "kind": rl.SHED, "reason": "unservable",
+         "retry_after_s": None},
+    ]
+    assert checker.check_retry_after_honesty(good) == []
+
+
+def test_checker_flags_stale_view_and_cross_ledger_drift():
+    checker = chaos.ServeInvariantChecker(policy_for_checker(),
+                                          interval_s=30.0)
+    stale = [{"ts": 1.0, "kind": rl.DISPATCHED, "key": "k",
+              "view_age_s": 9999.0}]
+    assert any("view-staleness" in v
+               for v in checker.check_view_staleness(stale))
+    ledger = [{"ts": 0.0, "kind": ev.TICK,
+               "states": {"0": "healthy"}}]
+    phantom_gen = [{"ts": 1.0, "kind": rl.DISPATCHED, "key": "k",
+                    "generation": 7}]
+    assert any("never got past" in v for v in
+               checker.check_cross_ledger(phantom_gen, ledger))
+    phantom_shed = [{"ts": 1.0, "kind": rl.SHED,
+                     "reason": "breaker-open", "retry_after_s": 5.0}]
+    assert any("no breaker opening" in v for v in
+               checker.check_cross_ledger(phantom_shed, ledger))
+    opened = [{"ts": 0.5, "kind": ev.BREAKER_OPEN}] + ledger
+    assert checker.check_cross_ledger(phantom_shed, opened) == []
+
+
+# ------------------------------------------------- campaign smokes (t1)
+
+
+def test_serve_scenarios_deterministic_and_cover_primitives():
+    a = chaos.generate_serve_scenario(42)
+    assert a == chaos.generate_serve_scenario(42)
+    assert a != chaos.generate_serve_scenario(43)
+    kinds = set()
+    for seed in range(40):
+        for event in chaos.generate_serve_scenario(seed).events:
+            kinds.add(event["kind"])
+    assert {"slice-outage", "quota-storm", "flapping-ssh",
+            "torn-status", "gateway-kill"} <= kinds
+
+
+def test_serve_campaign_smoke_few_seeds_zero_violations(tmp_path):
+    """The tier-1 serve-chaos smoke: REAL Supervisor + REAL Gateway on
+    one SimClock, seeded traffic with deadlines and idempotency keys —
+    every accepted request reaches exactly one terminal state, zero
+    request-plane invariant violations."""
+    for seed in (1, 2, 3):  # covers outage, torn status, gateway kill
+        scenario = chaos.generate_serve_scenario(seed)
+        out = chaos.run_serve_campaign(scenario,
+                                       tmp_path / f"seed-{seed}")
+        assert out["violations"] == [], (seed, out)
+        assert out["converged"] is True
+        assert out["accepted"] == out["completed"] + out["expired"]
+
+
+def test_serve_campaign_gateway_kill_resumes_from_journal(tmp_path):
+    """Seed 3 composes a slice outage with a gateway SIGKILL: the
+    restarted gateway resumes from the request journal and the
+    campaign still conserves every request."""
+    scenario = chaos.generate_serve_scenario(3)
+    assert "gateway-kill" in [e["kind"] for e in scenario.events]
+    out = chaos.run_serve_campaign(scenario, tmp_path)
+    assert out["gateway_kills"] == 1
+    assert out["redone_after_kill"] >= 1
+    assert out["violations"] == []
+    assert out["converged"] is True
+
+
+def test_gateway_kill_drill_loses_nothing(tmp_path):
+    """THE crash-resume acceptance pin: SIGKILL mid-dispatch loses 0
+    accepted requests — incomplete work redone from the journal,
+    duplicates answered from the recorded results."""
+    out = chaos.run_gateway_kill_drill(tmp_path)
+    assert out["violations"] == []
+    assert out["inflight_at_kill"] > 0  # the kill really was mid-dispatch
+    assert out["requests_lost"] == 0
+    assert out["requests_redone"] >= out["inflight_at_kill"]
+    assert (out["duplicates_replayed_from_journal"]
+            == out["duplicates_resubmitted"] > 0)
+    assert out["restart_to_first_token_s"] is not None
+    assert out["accepted"] == out["completed"] + out["expired"]
+
+
+# ------------------------------------------------- bench + check (perf)
+
+
+@pytest.mark.perf
+def test_serve_chaos_bench_json_document(tmp_path, capsys):
+    import bench_provision
+
+    out = tmp_path / "BENCH_servechaos.json"
+    assert bench_provision.main(
+        ["--serve-chaos", "--campaigns", "2", "--out", str(out)]
+    ) == 0
+    doc = json.loads(out.read_text())
+    assert doc["benchmark"] == "serve_chaos"
+    assert doc["passes"] is True
+    assert doc["campaigns"]["violation_count"] == 0
+    assert doc["kill_drill"]["requests_lost"] == 0
+    assert "serve chaos (simulated)" in capsys.readouterr().err
+
+
+@pytest.mark.perf
+def test_serve_chaos_committed_baseline_still_green():
+    """The committed BENCH_servechaos.json must describe a passing
+    run — the --check gate trusts its campaign count and MTTR."""
+    import bench_provision
+
+    doc = json.loads(bench_provision.SERVECHAOS_BASELINE.read_text())
+    assert doc["passes"] is True
+    assert doc["campaigns"]["campaigns"] >= 25
+    assert doc["campaigns"]["violation_count"] == 0
+    assert doc["kill_drill"]["requests_lost"] == 0
+    assert doc["kill_drill"]["requests_redone"] > 0
+    assert doc["value"] is not None
+
+
+# --------------------------------------------------- full sweep (chaos)
+
+
+@pytest.mark.chaos
+def test_serve_chaos_forty_seed_sweep(tmp_path):
+    failures = []
+    for seed in range(1, 41):
+        scenario = chaos.generate_serve_scenario(seed)
+        out = chaos.run_serve_campaign(scenario, tmp_path / f"s{seed}")
+        if out["violations"] or not out["converged"]:
+            failures.append((seed, out["events"], out["violations"]))
+    assert failures == []

@@ -610,6 +610,82 @@ def test_http_serve_one_request(tmp_path):
         loop.stop()
 
 
+def test_http_deadline_504_carries_journal_trail(tmp_path):
+    """The request-plane front door: a request whose deadline expires
+    gets a proper 504 JSON body with the journal trail summary (never
+    a TimeoutError into the handler thread), a duplicate of a served
+    idempotency key is answered from the journal, and deadline_s /
+    idempotency_key parse off the wire. Modeled engines: the HTTP and
+    journal contract is the subject, not decode."""
+    import http.client
+    from http.server import ThreadingHTTPServer
+
+    from tritonk8ssupervisor_tpu.serving import reqlog as reqlog_mod
+    from tritonk8ssupervisor_tpu.serving import server as server_mod
+
+    policy = gw.GatewayPolicy(max_seq_len=512,
+                              bucket_bounds=(64, 128, 256),
+                              slots_per_slice=2)
+    gateway = gw.Gateway(
+        {0: gw.ModeledEngine(slots=2, prefill_chunk=64)}, None,
+        policy=policy,
+        reqlog=reqlog_mod.RequestLog(tmp_path / "r.jsonl",
+                                     echo=lambda line: None),
+    )
+    lock = threading.Lock()
+    loop = server_mod.EngineLoop(gateway, lock)
+    server = ThreadingHTTPServer(
+        ("127.0.0.1", 0),
+        server_mod.make_handler(gateway, lock, loop=loop),
+    )
+    port = server.server_address[1]
+    server_thread = threading.Thread(target=server.serve_forever,
+                                     kwargs={"poll_interval": 0.05},
+                                     daemon=True)
+    loop.start()
+    server_thread.start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+        # deadline 0: already expired at arrival — clean 504 with trail
+        conn.request("POST", "/generate", body=json.dumps(
+            {"tokens": [1, 2, 3], "max_new_tokens": 4,
+             "deadline_s": 0.0, "idempotency_key": "dead"}
+        ), headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        doc = json.loads(resp.read())
+        assert resp.status == 504
+        assert doc["error"] == "deadline-expired"
+        assert doc["where"] == "queue"
+        assert [e["kind"] for e in doc["trail"]] == [
+            reqlog_mod.ACCEPTED, reqlog_mod.EXPIRED,
+        ]
+        # a served key, then its duplicate answered from the journal
+        body = json.dumps({"tokens": [1, 2, 3], "max_new_tokens": 3,
+                           "idempotency_key": "once"})
+        conn.request("POST", "/generate", body=body,
+                     headers={"Content-Type": "application/json"})
+        first = conn.getresponse()
+        first_doc = json.loads(first.read())
+        assert first.status == 200
+        conn.request("POST", "/generate", body=body,
+                     headers={"Content-Type": "application/json"})
+        dup = conn.getresponse()
+        dup_doc = json.loads(dup.read())
+        assert dup.status == 200
+        assert dup_doc["replayed"] is True
+        assert dup_doc["generated"] == first_doc["generated"]
+        conn.close()
+    finally:
+        server.shutdown()
+        server.server_close()
+        loop.stop()
+    # one COMPLETED for "once" — the duplicate regenerated nothing
+    kinds = [r["kind"] for r in gateway.reqlog.replay()
+             if r.get("key") == "once"]
+    assert kinds.count(reqlog_mod.COMPLETED) == 1
+    assert reqlog_mod.REPLAYED in kinds
+
+
 # ------------------------------------------------------ bench + perf gate
 
 
@@ -663,17 +739,18 @@ def test_serve_benchmark_passes():
 
 @pytest.mark.perf
 def test_check_gate_covers_serve(tmp_path):
-    """--check fails when the committed serve baseline is missing (and
-    therefore when its p99 / tokens-per-chip regress past tolerance).
-    The other optional baselines are pointed at absent files too so
-    this stays a fast provision-sim-only run."""
+    """--check fails when the committed serve / serve-chaos baselines
+    are missing (and therefore when their metrics regress past
+    tolerance). The other optional baselines are pointed at absent
+    files too so this stays a fast provision-sim-only run."""
     import bench_provision as bp
 
     absent = tmp_path / "absent.json"
     ok, problems, _ = bp.run_check(
         supervise_baseline=absent, elastic_baseline=absent,
         fleetscale_baseline=absent, chaos_baseline=absent,
-        serve_baseline=absent,
+        serve_baseline=absent, servechaos_baseline=absent,
     )
     assert not ok
-    assert any("serve" in p for p in problems)
+    assert any("(serve)" in p for p in problems)
+    assert any("(serve-chaos)" in p for p in problems)

@@ -295,6 +295,18 @@ def build_parser() -> argparse.ArgumentParser:
         help="serve --drill: also write the JSON report to FILE",
     )
     parser.add_argument(
+        "--deadline", type=float, default=None, metavar="S",
+        help="serve: default per-request deadline in seconds (requests "
+        "may override with deadline_s; past it the request settles as "
+        "a clean 504-class expiry audited with where the time went)",
+    )
+    parser.add_argument(
+        "--allow-no-fleet-view", action="store_true",
+        help="serve: admit traffic even before fleet-status.json has "
+        "ever been read (default: shed no-fleet-view 429s on cold "
+        "start until the supervisor publishes a view)",
+    )
+    parser.add_argument(
         "--config",
         type=Path,
         default=None,
@@ -864,6 +876,7 @@ def serve_cmd(args, paths: state.RunPaths, prompter: Prompter) -> int:
     serving a trained checkpoint is the same path with restored
     params."""
     import json as json_mod
+    import time as time_mod
 
     import jax
     import jax.numpy as jnp
@@ -872,6 +885,7 @@ def serve_cmd(args, paths: state.RunPaths, prompter: Prompter) -> int:
     from tritonk8ssupervisor_tpu.provision.fleetview import FileHealthSource
     from tritonk8ssupervisor_tpu.serving import engine as engine_mod
     from tritonk8ssupervisor_tpu.serving import gateway as gateway_mod
+    from tritonk8ssupervisor_tpu.serving import reqlog as reqlog_mod
     from tritonk8ssupervisor_tpu.serving import server as server_mod
 
     vocab, max_seq = 256, 256
@@ -887,6 +901,11 @@ def serve_cmd(args, paths: state.RunPaths, prompter: Prompter) -> int:
         prefill_chunk=max(1, args.prefill_chunk),
         queue_budget=max(1, args.queue_budget),
         bucket_bounds=(32, 64, 128, max_seq - 32),
+        default_deadline_s=args.deadline,
+        # a standalone drill has no fleet to take advice from; the HTTP
+        # mode fronting a supervised workdir sheds no-fleet-view 429s
+        # until the supervisor's first publish (docs/failure-modes.md)
+        allow_no_view=bool(args.allow_no_fleet_view or args.drill > 0),
     )
     # one local engine: this process serves as "slice 0" of whatever
     # fleet the status file describes — the per-slice dispatch fan-out
@@ -901,7 +920,13 @@ def serve_cmd(args, paths: state.RunPaths, prompter: Prompter) -> int:
         FileHealthSource(args.status_file or paths.fleet_status),
         policy=policy,
         echo=lambda line: prompter.say(line),
+        reqlog=reqlog_mod.RequestLog(paths.request_log,
+                                     echo=lambda line: prompter.say(line)),
     )
+    # crash-resume: a restarted gateway folds its request journal —
+    # incomplete work re-admitted front-of-queue, completed idempotency
+    # keys answered from the recorded result (exactly-once)
+    gw.recover(time_mod.monotonic())
     if args.drill > 0:
         report = server_mod.run_drill(gw, args.drill, vocab)
         doc = json_mod.dumps(report, indent=2, sort_keys=True)

@@ -94,17 +94,24 @@ class EventLedgerError(RuntimeError):
 class EventLedger:
     """Append-only fsync'd JSONL event log. The supervisor holds the
     workdir's pid lock (state.PidLock) while writing; `replay()` is
-    read-only and lock-free (the status command reads a live ledger)."""
+    read-only and lock-free (the status command reads a live ledger).
+
+    `fsync=False` drops the per-record fsync (flush only) — for the
+    virtual-clock chaos/bench harnesses whose "crashes" are in-process
+    object drops, which OS-buffered writes survive by construction.
+    Anything guarding against a real SIGKILL keeps the default."""
 
     def __init__(
         self,
         path: Path,
         clock=time.time,
         echo=lambda line: print(line, file=sys.stderr, flush=True),
+        fsync: bool = True,
     ) -> None:
         self.path = Path(path)
         self._clock = clock
         self._echo = echo
+        self._fsync = bool(fsync)
         self._mutex = threading.Lock()
 
     def append(self, kind: str, **fields) -> dict:
@@ -116,7 +123,8 @@ class EventLedger:
             with self.path.open("a") as f:
                 f.write(line)
                 f.flush()
-                os.fsync(f.fileno())
+                if self._fsync:
+                    os.fsync(f.fileno())
         return record
 
     def replay(self) -> list[dict]:

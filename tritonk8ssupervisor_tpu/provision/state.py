@@ -212,6 +212,15 @@ class RunPaths:
         return self.root / "job-ack.json"
 
     @property
+    def request_log(self) -> Path:
+        # the serving gateway's durable request journal
+        # (serving/reqlog.py): ACCEPTED/DISPATCHED/COMPLETED/EXPIRED/SHED
+        # per idempotency key, replayed on gateway restart so accepted
+        # work is re-admitted and completed keys answer duplicates from
+        # the recorded result instead of regenerating
+        return self.root / "serve-requests.jsonl"
+
+    @property
     def supervisor_pid(self) -> Path:
         # the running supervisor's pid lockfile — one resident reconcile
         # loop per workdir, and what teardown signals to stop it

@@ -98,6 +98,9 @@ def clean(
     journal_mod.Journal(paths.journal).scrub()
     paths.fleet_status.unlink(missing_ok=True)
     paths.job_ack.unlink(missing_ok=True)
+    # the gateway's request journal holds client-owed work; like the
+    # event ledger it outlives every resumable step above
+    paths.request_log.unlink(missing_ok=True)
     events_mod.EventLedger(paths.events).scrub()
     prompter.say("Clean. Re-run ./setup.sh to provision again.")
     return True

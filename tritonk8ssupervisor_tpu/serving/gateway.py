@@ -36,7 +36,29 @@ batching:
   work converts one incident into queue collapse) or when queue depth
   exceeds the SLO budget (`queue_budget`: past it, every admitted
   request would already miss its latency target — honest refusal beats
-  a doomed promise).
+  a doomed promise). A gateway that has a health source configured but
+  has NEVER read a fleet view (cold start before the supervisor's
+  first publish) sheds with the distinct `no-fleet-view` reason
+  instead of guessing a route — logged once per poll interval, lifted
+  the moment the first status lands.
+- **Deadlines**: every request may carry `deadline_s` (or inherit
+  `GatewayPolicy.default_deadline_s`). Admission refuses a deadline it
+  cannot plausibly meet — estimated queue wait (depth over the
+  observed completion rate) already past the budget — with an honest
+  Retry-After sized to when the queue will have drained enough. The
+  dispatcher skips-and-expires dead requests at claim time instead of
+  burning slot capacity on work whose caller gave up; expiry anywhere
+  (queue, slot, requeue, recover, server timeout) produces ONE clean
+  504-class terminal state audited with where the time went
+  (queued_s/served_s in the metrics and the request journal).
+- **Exactly-once from the client's view**: with a `RequestLog`
+  (serving/reqlog.py) attached, every lifecycle transition is
+  journaled under the request's client-supplied idempotency key. A
+  restarted gateway (`recover()`) re-admits incomplete work
+  front-of-queue — the same semantics as the generation-bump requeue —
+  and answers duplicate submissions of a COMPLETED key from the
+  recorded result instead of regenerating; a duplicate racing its own
+  completion is refused 429-style rather than served twice.
 
 Dispatch is **pull-based**: engines claim work at their own step
 boundaries, so a dead engine simply stops pulling — the only work a
@@ -61,19 +83,27 @@ from tritonk8ssupervisor_tpu.provision.fleetview import (
     FleetView,
     HealthSource,
 )
+from tritonk8ssupervisor_tpu.serving import reqlog as reqlog_mod
 
 # Admission verdicts. `unservable` is 400-class (retrying cannot help);
-# the rest are 429-class with a retry_after hint.
+# `replayed` is 200-class (a COMPLETED idempotency key answered from
+# the journal); the rest are 429-class with a retry_after hint.
 ACCEPTED = "accepted"
+REPLAYED = "replayed"  # duplicate of a completed key: result attached
 REJECT_UNSERVABLE = "unservable"  # prompt cannot fit the model, ever
 REJECT_OVERLOAD = "overload"  # queue past the SLO budget
 REJECT_BREAKER = "breaker-open"  # supervisor holding: shed requested
 REJECT_NO_CAPACITY = "no-slices"  # nothing route-eligible right now
+REJECT_NO_FLEET_VIEW = "no-fleet-view"  # cold start: no routed view yet
+REJECT_DEADLINE = "deadline-unmeetable"  # queue wait already past it
+REJECT_DUPLICATE = "duplicate-in-flight"  # key racing its own completion
 
 # Worker modes derived from the routed view.
 SERVE = "serve"  # eligible: pull new work
 DRAIN = "drain"  # draining: finish in-flight, pull nothing
 LOST = "lost"  # left the serving set: in-flight is requeued
+
+_UNSET = object()  # "caller did not pass retry_after" sentinel
 
 
 @dataclasses.dataclass
@@ -88,25 +118,35 @@ class Request:
     arrival: float = 0.0
     tokens: Any = None  # np.ndarray[int] on the real path
     bucket: int = 0
+    # the request-plane resilience contract (docs/failure-modes.md,
+    # "Request lifecycle & exactly-once semantics")
+    key: str | None = None  # client-supplied idempotency key
+    deadline_s: float | None = None  # relative budget from arrival
     # progress/attribution
     slice_index: int | None = None
+    dispatched_at: float | None = None
     first_token_at: float | None = None
     done_at: float | None = None
+    expired_at: float | None = None
+    expired_where: str | None = None  # queue / slot / requeue / ...
     generated: int = 0
     out_tokens: list = dataclasses.field(default_factory=list)
-    retries: int = 0  # times requeued after a slice loss
-    notify: Callable | None = None  # completion callback (HTTP path)
+    retries: int = 0  # times requeued (slice loss / engine / restart)
+    notify: Callable | None = None  # settle callback (HTTP path)
 
 
 @dataclasses.dataclass(frozen=True)
 class Admission:
     """The gateway's answer to submit(): accepted, or a 400/429-style
     refusal. `retry_after_s` is None exactly when retrying cannot help
-    (unservable)."""
+    (unservable). `result` is set exactly when `reason == REPLAYED` —
+    a duplicate of a COMPLETED idempotency key, answered from the
+    request journal instead of regenerated."""
 
     ok: bool
     reason: str = ACCEPTED
     retry_after_s: float | None = None
+    result: dict | None = None
 
 
 class SequenceBuckets:
@@ -147,6 +187,14 @@ class GatewayPolicy:
     retry_after_s: float = 5.0  # base 429 hint
     poll_every_s: float = 1.0  # fleet-status poll cadence
     bucket_bounds: tuple = (64, 128, 256, 512)
+    # requests without their own deadline_s inherit this (None = no
+    # deadline: the PR-9 behavior, requests wait forever)
+    default_deadline_s: float | None = None
+    # serve with NO fleet view ever read, even though a health source
+    # is configured (standalone drills set this; a gateway fronting a
+    # supervised fleet keeps False and sheds `no-fleet-view` instead of
+    # routing blind on cold start)
+    allow_no_view: bool = False
 
 
 @dataclasses.dataclass
@@ -260,8 +308,11 @@ class GatewayMetrics:
         self.rejected: list[dict] = []
         self.accepted: list[tuple] = []  # (ts, rid): admissions
         self.depth_samples: list[tuple] = []  # (ts, depth)
+        self.expired: list[dict] = []  # terminal deadline audits
+        self.engine_failures: list[dict] = []  # EngineLoop crash audits
         self.requeued = 0
         self.submitted = 0
+        self.replayed = 0  # duplicates answered from the journal
 
     def latencies(self) -> list[float]:
         return sorted(r.done_at - r.arrival for r in self.completed
@@ -348,11 +399,29 @@ class SliceWorker:
             req = self.inflight.pop(slot, None)
             if req is None:
                 continue
+            deadline = self.gateway.deadline_at(req)
+            if deadline is not None and end > deadline:
+                # finished, but past the budget: the caller is gone —
+                # deadline honesty says 504, never a late 200
+                self.engine.release(slot)
+                self.gateway.expire(req, "slot", end)
+                continue
             req.done_at = end
             if ids is not None:
                 req.out_tokens = list(ids)
             self.engine.release(slot)
             self.gateway.complete(req)
+        # deadline sweep AFTER completions settle: a request finishing
+        # exactly AT its deadline is served (completion wins the tie);
+        # one still UNFINISHED at a boundary on/past its deadline has
+        # its slot reclaimed for work that can still make it
+        for slot in sorted(self.inflight):
+            req = self.inflight[slot]
+            deadline = self.gateway.deadline_at(req)
+            if deadline is not None and end >= deadline:
+                self.inflight.pop(slot)
+                self.engine.release(slot)
+                self.gateway.expire(req, "slot", end)
         return result.dt
 
 
@@ -367,12 +436,14 @@ class Gateway:
         policy: GatewayPolicy | None = None,
         clock: Callable[[], float] = time.monotonic,
         echo: Callable[[str], None] = lambda line: None,
+        reqlog: reqlog_mod.RequestLog | None = None,
     ) -> None:
         self.policy = policy or GatewayPolicy()
         self.buckets = SequenceBuckets(self.policy.bucket_bounds)
         self._health = health
         self._clock = clock
         self._echo = echo
+        self.reqlog = reqlog
         self.workers = {
             int(i): SliceWorker(int(i), engine, self)
             for i, engine in engines.items()
@@ -382,6 +453,15 @@ class Gateway:
         self.view: FleetView | None = None
         self._last_poll: float | None = None
         self._last_membership: tuple | None = None
+        # idempotency-key index: key -> ("inflight", None) |
+        # ("completed", result) | ("expired", None). Seeded by recover()
+        # from the journal, kept live by submit/complete/expire.
+        self._key_state: dict = {}
+        self._trails: dict = {}  # key -> bounded lifecycle trail
+        # recent completion timestamps: the observed service rate the
+        # deadline-feasibility check models queue wait with
+        self._completion_times: deque = deque(maxlen=64)
+        self._noview_logged_at: float | None = None
 
     # -------------------------------------------------------------- routing
 
@@ -430,9 +510,15 @@ class Gateway:
         return LOST
 
     def shed_reason(self) -> str | None:
-        """Why admission must refuse right now, or None. Breaker first
-        (the supervisor's explicit hold), then the SLO queue budget."""
+        """Why admission must refuse right now, or None. Cold start
+        first (a health source is configured but NO view has ever been
+        read — routing blind would defeat the supervisor's advice),
+        then the breaker (the supervisor's explicit hold), then the SLO
+        queue budget."""
         view = self.view
+        if (view is None and self._health is not None
+                and not self.policy.allow_no_view):
+            return REJECT_NO_FLEET_VIEW
         if view is not None and (view.shed
                                  or view.verdict == "degraded-hold"):
             return REJECT_BREAKER
@@ -454,53 +540,169 @@ class Gateway:
         for index, worker in sorted(self.workers.items()):
             if self.slice_mode(index) == LOST and worker.inflight:
                 lost = worker.reap()
-                for req in reversed(lost):
-                    req.retries += 1
-                    req.slice_index = None
-                    self.queues[req.bucket].appendleft(req)
-                self.metrics.requeued += len(lost)
+                requeued = self._requeue_lost(lost, now, "slice-loss")
                 self._echo(
                     f"[gateway] slice {index} left the serving set "
                     f"(generation {view.generation}): requeued "
-                    f"{len(lost)} in-flight request(s)"
+                    f"{requeued} in-flight request(s)"
                 )
+
+    def _requeue_lost(self, lost: list, now: float, cause: str) -> int:
+        """Push reaped in-flight requests back to the FRONT of their
+        buckets (they already paid the queue once), expiring the ones
+        whose deadline lapsed while they were stranded — a dead request
+        must not take a slot from one that can still make it."""
+        requeued = 0
+        for req in reversed(lost):
+            deadline = self.deadline_at(req)
+            if deadline is not None and now >= deadline:
+                self.expire(req, "requeue", now)
+                continue
+            req.retries += 1
+            req.slice_index = None
+            req.dispatched_at = None
+            self.queues[req.bucket].appendleft(req)
+            self._journal(reqlog_mod.REQUEUED, key=req.key, rid=req.rid,
+                          cause=cause, retries=req.retries)
+            requeued += 1
+        self.metrics.requeued += requeued
+        return requeued
+
+    def fail_worker(self, index: int, now: float | None = None,
+                    error: str = "") -> int:
+        """An engine crashed mid-step (EngineLoop caught it): stop the
+        worker, mark its in-flight slots failed-requeueable through the
+        journal, and hand the work to the surviving workers. Returns
+        the number requeued."""
+        now = self._clock() if now is None else now
+        worker = self.workers[int(index)]
+        worker.fail()
+        lost = worker.reap()
+        requeued = self._requeue_lost(lost, now, "engine-failure")
+        self.metrics.engine_failures.append(
+            {"ts": now, "slice": int(index), "error": str(error)[:200]}
+        )
+        self._echo(
+            f"[gateway] slice {index} engine failed ({error}): "
+            f"requeued {requeued} in-flight request(s)"
+        )
+        return requeued
 
     # ------------------------------------------------------------ admission
 
     def queue_depth(self) -> int:
         return sum(len(q) for q in self.queues.values())
 
+    def deadline_at(self, request: Request) -> float | None:
+        """The absolute expiry instant, or None for deadline-free
+        requests. Anchored at arrival: requeues and restarts never
+        reset a client's budget."""
+        if request.deadline_s is None:
+            return None
+        return request.arrival + float(request.deadline_s)
+
+    def service_rate(self) -> float | None:
+        """Observed request completions/sec over the recent window, or
+        None before there is enough evidence to model with."""
+        times = self._completion_times
+        if len(times) < 8:
+            return None
+        span = times[-1] - times[0]
+        if span <= 0:
+            return None
+        return (len(times) - 1) / span
+
+    def estimated_queue_wait(self) -> float | None:
+        """Modeled wait for a request admitted NOW: everything queued
+        ahead of it draining at the observed completion rate."""
+        rate = self.service_rate()
+        if rate is None:
+            return None
+        return self.queue_depth() / rate
+
     def submit(self, request: Request, now: float | None = None) -> Admission:
         now = self._clock() if now is None else now
         self.poll(now)
         self.metrics.submitted += 1
         request.arrival = now
+        if request.deadline_s is None:
+            request.deadline_s = self.policy.default_deadline_s
+        if request.key is not None:
+            known = self._key_state.get(request.key)
+            if known is not None:
+                phase, result = known
+                if phase == "completed":
+                    # exactly-once from the client's view: the recorded
+                    # result answers the duplicate, nothing regenerates
+                    self.metrics.replayed += 1
+                    self._journal(reqlog_mod.REPLAYED, key=request.key,
+                                  rid=request.rid)
+                    return Admission(True, REPLAYED, None, result=result)
+                if phase == "inflight":
+                    # a duplicate racing its own completion: refusing
+                    # beats serving the same key twice
+                    return self._refuse(request, REJECT_DUPLICATE, now)
+                # phase == "expired": the 504 was delivered; a retry
+                # with the same key opens a fresh acceptance epoch
         bound = self.buckets.bucket_for(request.prompt_len)
         if (bound is None or request.prompt_len < 1
                 or request.max_new_tokens < 1
                 or request.prompt_len + request.max_new_tokens
                 > self.policy.max_seq_len):
             # 400-class: no amount of retrying makes this prompt fit
-            self.metrics.rejected.append({
-                "ts": now, "reason": REJECT_UNSERVABLE,
-                "depth": self.queue_depth(), "rid": request.rid,
-            })
-            return Admission(False, REJECT_UNSERVABLE, None)
+            return self._refuse(request, REJECT_UNSERVABLE, now,
+                                retry_after=None)
         reason = self.shed_reason()
         if reason is None and not self.eligible_slices():
             reason = REJECT_NO_CAPACITY
         if reason is not None:
-            retry_after = self._retry_after(reason)
-            self.metrics.rejected.append({
-                "ts": now, "reason": reason,
-                "depth": self.queue_depth(), "rid": request.rid,
-            })
-            return Admission(False, reason, retry_after)
+            return self._refuse(request, reason, now)
+        if request.deadline_s is not None:
+            wait = self.estimated_queue_wait()
+            if wait is not None and wait > float(request.deadline_s):
+                # the queue ahead already outlasts the budget: an
+                # honest refusal now, with a Retry-After sized to when
+                # the backlog will have drained enough to make it
+                return self._refuse(
+                    request, REJECT_DEADLINE, now,
+                    retry_after=max(1.0,
+                                    wait - float(request.deadline_s)),
+                )
         request.bucket = bound
         self.queues[bound].append(request)
+        if request.key is not None:
+            self._key_state[request.key] = ("inflight", None)
+        self._journal(reqlog_mod.ACCEPTED, key=request.key,
+                      rid=request.rid, prompt_len=request.prompt_len,
+                      max_new_tokens=request.max_new_tokens,
+                      deadline_s=request.deadline_s)
         self.metrics.accepted.append((now, request.rid))
         self.metrics.depth_samples.append((now, self.queue_depth()))
         return Admission(True)
+
+    def _refuse(self, request: Request, reason: str, now: float,
+                retry_after=_UNSET) -> Admission:
+        if retry_after is _UNSET:
+            retry_after = self._retry_after(reason)
+        depth = self.queue_depth()
+        self.metrics.rejected.append({
+            "ts": now, "reason": reason, "depth": depth,
+            "rid": request.rid,
+        })
+        self._journal(reqlog_mod.SHED, key=request.key, rid=request.rid,
+                      reason=reason, depth=depth,
+                      retry_after_s=retry_after)
+        if reason == REJECT_NO_FLEET_VIEW:
+            if (self._noview_logged_at is None
+                    or now - self._noview_logged_at
+                    >= self.policy.poll_every_s):
+                self._noview_logged_at = now
+                self._echo(
+                    "[gateway] no fleet view yet (fleet-status.json "
+                    "never read): shedding no-fleet-view 429s until the "
+                    "supervisor publishes one"
+                )
+        return Admission(False, reason, retry_after)
 
     def _retry_after(self, reason: str) -> float:
         base = self.policy.retry_after_s
@@ -516,23 +718,225 @@ class Gateway:
         """One request for a free slot on `slice_index`, oldest-first
         across buckets (bucketing batches compiled shapes, it must not
         starve a sparse bucket), or None when every bucket is empty or
-        the slice may not take new work."""
+        the slice may not take new work. Requests whose deadline has
+        already passed are skipped-and-expired here instead of burning
+        slot capacity on callers that gave up."""
         if self.slice_mode(slice_index) != SERVE:
             return None
-        best: deque | None = None
+        while True:
+            best: deque | None = None
+            for q in self.queues.values():
+                if q and (best is None or q[0].arrival < best[0].arrival):
+                    best = q
+            if best is None:
+                return None
+            req = best.popleft()
+            deadline = self.deadline_at(req)
+            if deadline is not None and now >= deadline:
+                self.expire(req, "queue", now)
+                continue
+            req.dispatched_at = now
+            view = self.view
+            self._journal(
+                reqlog_mod.DISPATCHED, key=req.key, rid=req.rid,
+                slice=int(slice_index),
+                queued_s=round(now - req.arrival, 6),
+                generation=(view.generation if view is not None
+                            else None),
+                view_age_s=(round(max(0.0, now - view.updated), 3)
+                            if view is not None
+                            and view.updated is not None else None),
+            )
+            self.metrics.depth_samples.append((now, self.queue_depth()))
+            return req
+
+    def expire(self, request: Request, where: str, now: float) -> None:
+        """One request's 504-class terminal state, with the audit of
+        where the time went — the ONLY way a request dies. `where` is
+        queue (skipped at claim), slot (reclaimed at a boundary),
+        requeue (deadline lapsed while stranded), recover (lapsed
+        across a gateway restart), or timeout (the HTTP handler gave
+        up on a deadline-free request)."""
+        request.expired_at = now
+        request.expired_where = where
+        served = (round(now - request.dispatched_at, 6)
+                  if request.dispatched_at is not None else 0.0)
+        audit = {
+            "ts": now, "rid": request.rid, "key": request.key,
+            "where": where, "deadline_s": request.deadline_s,
+            "age_s": round(now - request.arrival, 6),
+            "queued_s": round((request.dispatched_at
+                               if request.dispatched_at is not None
+                               else now) - request.arrival, 6),
+            "served_s": served, "retries": request.retries,
+        }
+        self.metrics.expired.append(audit)
+        if request.key is not None:
+            self._key_state[request.key] = ("expired", None)
+        self._journal(reqlog_mod.EXPIRED, key=request.key,
+                      rid=request.rid, where=where,
+                      deadline_s=request.deadline_s,
+                      age_s=audit["age_s"], queued_s=audit["queued_s"],
+                      served_s=audit["served_s"])
+        if request.notify is not None:
+            request.notify(request)
+
+    def expire_queued(self, now: float | None = None) -> int:
+        """Eagerly sweep queued requests whose deadline has passed —
+        what claim() does lazily, for idle fleets where no claim will
+        come (e.g. every worker dead while the supervisor heals)."""
+        now = self._clock() if now is None else now
+        swept = 0
+        for bound, q in self.queues.items():
+            keep: deque = deque()
+            while q:
+                req = q.popleft()
+                deadline = self.deadline_at(req)
+                if deadline is not None and now >= deadline:
+                    self.expire(req, "queue", now)
+                    swept += 1
+                else:
+                    keep.append(req)
+            self.queues[bound] = keep
+        return swept
+
+    def cancel(self, request: Request, now: float | None = None,
+               where: str = "timeout") -> bool:
+        """The HTTP handler stopped waiting: pull the request out of
+        wherever it is (queue or slot) and settle it terminal-expired.
+        False when it already settled (completion raced the cancel and
+        won — the result stands)."""
+        now = self._clock() if now is None else now
+        if request.done_at is not None or request.expired_at is not None:
+            return False
+        dequeued = False
         for q in self.queues.values():
-            if q and (best is None or q[0].arrival < best[0].arrival):
-                best = q
-        if best is None:
-            return None
-        req = best.popleft()
-        self.metrics.depth_samples.append((now, self.queue_depth()))
-        return req
+            for i, queued in enumerate(q):  # identity, not __eq__:
+                if queued is request:       # tokens may be an ndarray
+                    del q[i]
+                    dequeued = True
+                    break
+            if dequeued:
+                break
+        if not dequeued:
+            for worker in self.workers.values():
+                slots = [s for s, r in worker.inflight.items()
+                         if r is request]
+                for slot in slots:
+                    worker.inflight.pop(slot)
+                    worker.engine.release(slot)
+        self.expire(request, where, now)
+        return True
 
     def complete(self, request: Request) -> None:
         self.metrics.completed.append(request)
+        self._completion_times.append(
+            request.done_at if request.done_at is not None
+            else self._clock()
+        )
+        if request.key is not None:
+            result = {
+                "rid": request.rid,
+                "tokens": [int(t) for t in request.out_tokens],
+                "generated": request.generated,
+                "slice": request.slice_index,
+                "latency_s": (round(request.done_at - request.arrival, 6)
+                              if request.done_at is not None else None),
+                "retries": request.retries,
+            }
+            self._key_state[request.key] = ("completed", result)
+            self._journal(reqlog_mod.COMPLETED, key=request.key,
+                          rid=request.rid, slice=request.slice_index,
+                          result=result, latency_s=result["latency_s"])
         if request.notify is not None:
             request.notify(request)
+
+    # ------------------------------------------------------------- journal
+
+    def _journal(self, kind: str, **fields) -> None:
+        if self.reqlog is None:
+            return
+        record = self.reqlog.append(kind, **fields)
+        key = fields.get("key")
+        if key:
+            entry = {"ts": record["ts"], "kind": kind}
+            for name in ("slice", "where", "reason", "cause",
+                         "generation", "view_age_s", "depth",
+                         "retry_after_s"):
+                if fields.get(name) is not None:
+                    entry[name] = fields[name]
+            trail = self._trails.setdefault(key, [])
+            trail.append(entry)
+            if len(trail) > 24:
+                del trail[0]
+
+    def trail(self, key: str | None) -> list:
+        """The journaled lifecycle of one idempotency key (bounded) —
+        the 504 body's 'where the time went' summary."""
+        if key is None:
+            return []
+        return list(self._trails.get(key, []))
+
+    def recover(self, now: float | None = None) -> dict:
+        """Fold the request journal after a gateway restart: COMPLETED
+        keys become answerable duplicates, incomplete keys (accepted or
+        dispatched when the process died) are re-admitted at the FRONT
+        of the queue — same semantics as the generation-bump requeue —
+        and keys whose deadline lapsed during the outage settle
+        terminal-expired instead of being served to nobody."""
+        if self.reqlog is None:
+            return {"redone": 0, "completed_cached": 0,
+                    "expired_on_recover": 0}
+        now = self._clock() if now is None else now
+        view = reqlog_mod.fold(self.reqlog.replay())
+        redone = expired = cached = 0
+        for kv in view.keys.values():
+            if kv.state == "completed":
+                self._key_state[kv.key] = ("completed", kv.result)
+                self._trails[kv.key] = list(kv.trail)
+                cached += 1
+            elif kv.state == "expired":
+                self._key_state[kv.key] = ("expired", None)
+        # journal timestamps live on the journal's clock; translate a
+        # key's age onto ours so deadlines keep their anchor even when
+        # the gateway clock is monotonic and the journal's is wall
+        journal_now = self.reqlog._clock()
+        for kv in reversed(view.incomplete()):  # appendleft: oldest in front
+            bound = self.buckets.bucket_for(kv.prompt_len)
+            if bound is None:
+                continue  # journal from an older bucket config
+            age = max(0.0, journal_now - (kv.accepted_ts
+                                          if kv.accepted_ts is not None
+                                          else journal_now))
+            req = Request(
+                rid=kv.rid if kv.rid is not None else 0,
+                prompt_len=kv.prompt_len,
+                max_new_tokens=kv.max_new_tokens,
+                arrival=now - age, key=kv.key,
+                deadline_s=kv.deadline_s,
+                retries=kv.requeues + 1,
+            )
+            req.bucket = bound
+            self._trails[kv.key] = list(kv.trail)
+            self._key_state[kv.key] = ("inflight", None)
+            deadline = self.deadline_at(req)
+            if deadline is not None and now >= deadline:
+                self.expire(req, "recover", now)
+                expired += 1
+                continue
+            self.queues[bound].appendleft(req)
+            self._journal(reqlog_mod.REQUEUED, key=kv.key, rid=kv.rid,
+                          cause="gateway-restart", retries=req.retries)
+            redone += 1
+        self.metrics.requeued += redone
+        if redone or expired or cached:
+            self._echo(
+                f"[gateway] journal recovered: {redone} request(s) "
+                f"re-admitted front-of-queue, {expired} expired during "
+                f"the outage, {cached} completed key(s) answerable"
+            )
+        return {"redone": redone, "completed_cached": cached,
+                "expired_on_recover": expired}
 
     # -------------------------------------------------------------- reports
 
@@ -543,6 +947,11 @@ class Gateway:
         rejects: dict = {}
         for r in m.rejected:
             rejects[r["reason"]] = rejects.get(r["reason"], 0) + 1
+        expired_where: dict = {}
+        for e in m.expired:
+            expired_where[e["where"]] = (
+                expired_where.get(e["where"], 0) + 1
+            )
         return {
             "submitted": m.submitted,
             "completed": len(m.completed),
@@ -554,4 +963,15 @@ class Gateway:
             "max_queue_depth": max(
                 (d for _, d in m.depth_samples), default=0
             ),
+            "expired": len(m.expired),
+            "expired_where": expired_where,
+            "replayed_from_journal": m.replayed,
+            # the routing-advice audit (the no_fleet_view cold-start
+            # counter lives here and in rejected["no-fleet-view"])
+            "serving": {
+                "view": "ok" if self.view is not None else "none",
+                "no_fleet_view_sheds": rejects.get(
+                    REJECT_NO_FLEET_VIEW, 0),
+                "engine_failures": len(m.engine_failures),
+            },
         }

@@ -34,7 +34,14 @@ from tritonk8ssupervisor_tpu.serving.gateway import (
 class EngineLoop(threading.Thread):
     """The single stepping thread: advances every worker at its step
     boundaries; parks briefly when the whole gateway is idle. All
-    gateway mutation happens under one lock shared with submit()."""
+    gateway mutation happens under one lock shared with submit().
+
+    An engine raising mid-step must not strand its waiters until their
+    timeout: the crash is caught HERE, the worker's in-flight slots are
+    marked failed-requeueable through the request journal
+    (`Gateway.fail_worker` — surviving workers pick the work up), and
+    the error surfaces on `self.crashed` so `/healthz` reports 503
+    instead of pretending the engine is fine."""
 
     def __init__(self, gateway: Gateway, lock: threading.Lock,
                  clock=time.monotonic, idle_s: float = 0.002) -> None:
@@ -44,6 +51,7 @@ class EngineLoop(threading.Thread):
         self.clock = clock
         self.idle_s = idle_s
         self.stop_event = threading.Event()
+        self.crashed: BaseException | None = None  # last engine crash
 
     def run(self) -> None:
         while not self.stop_event.is_set():
@@ -51,8 +59,15 @@ class EngineLoop(threading.Thread):
             with self.lock:
                 for index in sorted(self.gateway.workers):
                     worker = self.gateway.workers[index]
-                    if worker.step(self.clock()) is not None:
-                        advanced = True
+                    if not worker.alive:
+                        continue
+                    try:
+                        if worker.step(self.clock()) is not None:
+                            advanced = True
+                    except Exception as e:  # noqa: BLE001 - contained
+                        self.crashed = e
+                        self.gateway.fail_worker(index, self.clock(),
+                                                 error=repr(e))
             if not advanced:
                 self.stop_event.wait(self.idle_s)
 
@@ -73,11 +88,26 @@ def _result_doc(req: Request) -> dict:
     }
 
 
+def _expiry_doc(gateway: Gateway, req: Request) -> dict:
+    """The 504 body: terminal verdict plus the journal trail summary —
+    where the time went, not a bare timeout string."""
+    return {
+        "error": "deadline-expired",
+        "rid": req.rid,
+        "where": req.expired_where,
+        "deadline_s": req.deadline_s,
+        "retries": req.retries,
+        "trail": gateway.trail(req.key),
+    }
+
+
 def make_handler(gateway: Gateway, lock: threading.Lock,
-                 timeout_s: float = 300.0):
+                 timeout_s: float = 300.0, loop: EngineLoop | None = None):
     """A request handler bound to one gateway. POST /generate with
-    {"tokens": [...], "max_new_tokens": N}; GET /healthz reports the
-    routed view (503 while shedding — load balancers read this)."""
+    {"tokens": [...], "max_new_tokens": N} and optionally
+    {"deadline_s": S, "idempotency_key": K}; GET /healthz reports the
+    routed view (503 while shedding or after an engine crash — load
+    balancers read this)."""
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, fmt, *args):  # noqa: N802 - stdlib name
@@ -101,12 +131,16 @@ def make_handler(gateway: Gateway, lock: threading.Lock,
             with lock:
                 gateway.poll(time.monotonic(), force=True)
                 shedding = gateway.shed_reason()
+                crashed = loop.crashed if loop is not None else None
                 doc = {
                     "shedding": shedding,
                     "eligible_slices": gateway.eligible_slices(),
                     "queue_depth": gateway.queue_depth(),
+                    "engine_crashed": (repr(crashed)
+                                       if crashed is not None else None),
+                    "serving": gateway.report()["serving"],
                 }
-            self._reply(503 if shedding else 200, doc)
+            self._reply(503 if shedding or crashed else 200, doc)
 
         def do_POST(self):  # noqa: N802 - stdlib name
             if self.path != "/generate":
@@ -117,6 +151,10 @@ def make_handler(gateway: Gateway, lock: threading.Lock,
                 doc = json.loads(self.rfile.read(length) or b"{}")
                 tokens = np.asarray(doc["tokens"], np.int32)
                 new = int(doc.get("max_new_tokens", 16))
+                deadline = doc.get("deadline_s")
+                deadline = None if deadline is None else float(deadline)
+                key = doc.get("idempotency_key")
+                key = None if key is None else str(key)
             except (KeyError, TypeError, ValueError) as e:
                 self._reply(400, {"error": f"bad request: {e}"})
                 return
@@ -124,9 +162,14 @@ def make_handler(gateway: Gateway, lock: threading.Lock,
             req = Request(rid=id(done) & 0x7FFFFFFF,
                           prompt_len=int(tokens.size),
                           max_new_tokens=new, tokens=tokens,
+                          deadline_s=deadline, key=key,
                           notify=lambda _r: done.set())
             with lock:
                 admission = gateway.submit(req, time.monotonic())
+            if admission.ok and admission.result is not None:
+                # a COMPLETED idempotency key answered from the journal
+                self._reply(200, {**admission.result, "replayed": True})
+                return
             if not admission.ok:
                 if admission.reason == REJECT_UNSERVABLE:
                     self._reply(400, {"error": admission.reason})
@@ -138,10 +181,24 @@ def make_handler(gateway: Gateway, lock: threading.Lock,
                              f"{admission.retry_after_s:.0f}"},
                 )
                 return
-            if not done.wait(timeout_s):
-                self._reply(504, {"error": "generation timed out"})
+            # the handler waits for the gateway's settle (completion OR
+            # deadline expiry), with its own timeout as the last-resort
+            # guard for deadline-free requests
+            wait_s = timeout_s if req.deadline_s is None else min(
+                timeout_s, float(req.deadline_s) + 5.0
+            )
+            if not done.wait(wait_s):
+                with lock:
+                    cancelled = gateway.cancel(req, time.monotonic())
+                if cancelled:
+                    # a clean terminal state + the journal trail, not a
+                    # TimeoutError into the handler thread
+                    self._reply(504, _expiry_doc(gateway, req))
+                    return
+            if req.done_at is not None:
+                self._reply(200, _result_doc(req))
                 return
-            self._reply(200, _result_doc(req))
+            self._reply(504, _expiry_doc(gateway, req))
 
     return Handler
 
@@ -152,7 +209,7 @@ def serve_http(gateway: Gateway, host: str, port: int,
     lock = threading.Lock()
     loop = EngineLoop(gateway, lock)
     server = ThreadingHTTPServer((host, port),
-                                 make_handler(gateway, lock))
+                                 make_handler(gateway, lock, loop=loop))
     loop.start()
     echo(f"[serve] listening on http://{host}:{server.server_address[1]} "
          f"({len(gateway.workers)} slice worker(s), "
@@ -172,10 +229,18 @@ def serve_http(gateway: Gateway, host: str, port: int,
 
 def run_drill(gateway: Gateway, requests: int, vocab_size: int,
               seed: int = 0, max_new_tokens: int = 8,
-              prompt_lens=(4, 8, 12), timeout_s: float = 300.0) -> dict:
+              prompt_lens=(4, 8, 12), timeout_s: float = 300.0,
+              deadline_s: float | None = None,
+              expire_one: bool = False) -> dict:
     """N seeded requests through the real gateway+engine path, no
     network: the CLI smoke (`./setup.sh serve --drill N`) and the
-    quickest way to see continuous batching produce tokens."""
+    quickest way to see continuous batching produce tokens.
+
+    `deadline_s` gives every drill request a deadline; `expire_one`
+    appends one extra request with a zero deadline — already expired
+    at arrival, so the dispatcher MUST skip-and-expire it (the
+    deadline-expiry case: a clean 504-class terminal, never a
+    TimeoutError into the caller)."""
     import random
 
     rng = random.Random(seed)
@@ -183,8 +248,11 @@ def run_drill(gateway: Gateway, requests: int, vocab_size: int,
     loop = EngineLoop(gateway, lock)
     loop.start()
     pending = []
+    replayed = 0
+    nonce = time.monotonic_ns()  # fresh keys per drill invocation
     try:
-        for rid in range(requests):
+        total = requests + (1 if expire_one else 0)
+        for rid in range(total):
             plen = rng.choice(list(prompt_lens))
             tokens = np.asarray(
                 [rng.randrange(vocab_size) for _ in range(plen)], np.int32
@@ -192,21 +260,31 @@ def run_drill(gateway: Gateway, requests: int, vocab_size: int,
             done = threading.Event()
             req = Request(rid=rid, prompt_len=plen,
                           max_new_tokens=max_new_tokens, tokens=tokens,
+                          deadline_s=(0.0 if expire_one
+                                      and rid == total - 1
+                                      else deadline_s),
+                          key=f"drill-{seed}-{nonce}-{rid}",
                           notify=lambda _r, ev=done: ev.set())
             with lock:
                 admission = gateway.submit(req, time.monotonic())
-            if admission.ok:
+            if admission.ok and admission.result is not None:
+                replayed += 1  # answered from the journal: no waiter
+            elif admission.ok:
                 pending.append((req, done))
         deadline = time.monotonic() + timeout_s
         for req, done in pending:
             if not done.wait(max(0.1, deadline - time.monotonic())):
                 raise TimeoutError(
-                    f"drill request {req.rid} did not complete in "
+                    f"drill request {req.rid} did not settle in "
                     f"{timeout_s:.0f}s"
                 )
     finally:
         loop.stop()
     report = gateway.report()
-    report["results"] = [_result_doc(r) for r, _ in pending]
+    report["results"] = [_result_doc(r) for r, _ in pending
+                         if r.done_at is not None]
+    report["expiries"] = [_expiry_doc(gateway, r) for r, _ in pending
+                          if r.expired_at is not None]
+    report["replayed"] = replayed
     report["admission"] = ACCEPTED
     return report

@@ -45,6 +45,10 @@ class TrafficModel:
     new_tokens_choices: tuple = (16, 32, 64, 96)
     new_tokens_weights: tuple | None = None
     seed: int = 0
+    # request-plane resilience knobs: every arrival carries a deadline
+    # and an idempotency key (serving/reqlog.py) when these are set
+    deadline_s: float | None = None
+    key_prefix: str | None = None
 
     def rate(self, t: float) -> float:
         rate = self.base_rps * (
@@ -85,8 +89,12 @@ def generate_arrivals(model: TrafficModel, duration_s: float,
                              weights=model.prompt_weights)[0]
         new = rng.choices(model.new_tokens_choices,
                           weights=model.new_tokens_weights)[0]
-        out.append(Request(rid=rid, prompt_len=int(prompt),
-                           max_new_tokens=int(new), arrival=t))
+        out.append(Request(
+            rid=rid, prompt_len=int(prompt), max_new_tokens=int(new),
+            arrival=t, deadline_s=model.deadline_s,
+            key=(f"{model.key_prefix}-{rid}"
+                 if model.key_prefix is not None else None),
+        ))
         rid += 1
     return out
 

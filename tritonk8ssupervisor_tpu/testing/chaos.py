@@ -31,6 +31,17 @@ makes composition cheap and the safety claims machine-checkable:
 blast-radius drill and commits the result as BENCH_chaos.json; the
 `--check` gate fails on any invariant violation or a >10% campaign-MTTR
 regression. The 100-seed sweep lives behind the `chaos` pytest marker.
+
+Since the request-plane resilience PR, chaos also spans the TRAFFIC
+plane: `generate_serve_scenario`/`run_serve_campaign` co-simulate a
+REAL Supervisor and a REAL serving Gateway (deadlines, idempotency
+keys, the serving/reqlog.py request journal) on one SimClock — the
+PR-8 fault vocabulary plus a gateway SIGKILL mid-dispatch — and the
+`ServeInvariantChecker` folds BOTH ledgers to assert request
+conservation, exactly-once service, deadline honesty, honest
+Retry-After, bounded routing staleness, and cross-ledger consistency.
+`run_gateway_kill_drill` is the deterministic crash-resume acceptance
+drill (`bench_provision.py --serve-chaos`, BENCH_servechaos.json).
 """
 
 from __future__ import annotations
@@ -47,6 +58,7 @@ from tritonk8ssupervisor_tpu.provision import heal as heal_mod
 from tritonk8ssupervisor_tpu.provision import supervisor as sup_mod
 from tritonk8ssupervisor_tpu.provision.runner import CommandError
 from tritonk8ssupervisor_tpu.provision.state import ClusterHosts, RunPaths
+from tritonk8ssupervisor_tpu.serving import reqlog as reqlog_mod
 from tritonk8ssupervisor_tpu.testing.faults import (
     FaultPlan,
     FaultRule,
@@ -148,6 +160,13 @@ class ChaosFleet:
             if now >= at:
                 self.down.add(i)
                 self.down_at.remove((at, i))
+
+    def down_now(self) -> set:
+        """The currently-down slice set at this virtual instant — what
+        the serve-chaos driver syncs its engine liveness against."""
+        with self._lock:
+            self._sync_locked()
+            return set(self.down)
 
     def _quota_throttled(self, now: float) -> bool:
         return any(start <= now < until
@@ -664,3 +683,749 @@ class InvariantChecker:
                     if gated[domain] == r.get("id"):
                         gated[domain] = None  # canary failed: gate re-arms
         return violations
+
+
+# ----------------------------------------------- request-plane (serving)
+
+
+@dataclasses.dataclass
+class ServeScenario:
+    """One seeded composition of traffic + faults spanning BOTH planes:
+    the supervisor's world (preemptions, quota storms, flapping SSH,
+    torn status copies) and the gateway's own process (SIGKILL
+    mid-dispatch, modeled as dropping the in-memory Gateway and
+    resuming a fresh one from the request journal)."""
+
+    seed: int
+    num_slices: int
+    failure_domains: int
+    duration_s: float
+    base_rps: float
+    deadline_s: float
+    events: list
+    drain_grace_s: float = 1800.0
+
+    @property
+    def fault_times(self) -> list:
+        return sorted(e.get("at", 0.0) for e in self.events)
+
+
+SERVE_PRIMITIVES = ("slice-outage", "preemption-storm", "quota-storm",
+                    "flapping-ssh", "torn-status", "gateway-kill")
+
+
+def generate_serve_scenario(
+    seed: int,
+    num_slices: int = 4,
+    failure_domains: int = 2,
+    interval: float = 30.0,
+) -> ServeScenario:
+    """Deterministic serve scenario from `seed`: open-loop traffic with
+    per-request deadlines and idempotency keys, one anchor fault (a
+    slice outage the supervisor must heal while the gateway routes
+    around it), and up to two extra primitives — including the gateway
+    SIGKILL that PR-8's campaigns could never throw. Every scenario is
+    heal-able, so 'every accepted request reaches exactly one terminal
+    state' is always the expected verdict."""
+    rng = random.Random(int(seed))
+    events: list = []
+    anchor_at = 60.0 + interval * rng.randrange(0, 4)
+    count = 1 + (1 if num_slices >= 4 and rng.random() < 0.3 else 0)
+    events.append({
+        "kind": "slice-outage",
+        "slices": sorted(rng.sample(range(num_slices), count)),
+        "at": anchor_at,
+    })
+    used = {"gateway-kill": False, "torn-status": False,
+            "flapping-ssh": False}
+    for _ in range(rng.randrange(0, 3)):
+        kind = rng.choice(SERVE_PRIMITIVES[2:])
+        at = anchor_at + interval * rng.randrange(0, 5)
+        if kind == "quota-storm":
+            events.append({"kind": kind, "at": at,
+                           "duration": 60.0 + 60.0 * rng.randrange(0, 3)})
+        elif kind == "flapping-ssh" and not used["flapping-ssh"]:
+            used["flapping-ssh"] = True
+            events.append({
+                "kind": kind, "slice": rng.randrange(num_slices),
+                "at": at, "duration": 4 * interval,
+                "period": 2 * interval,
+            })
+        elif kind == "torn-status" and not used["torn-status"]:
+            used["torn-status"] = True
+            events.append({"kind": kind, "at": at})
+        elif kind == "gateway-kill" and not used["gateway-kill"]:
+            used["gateway-kill"] = True
+            events.append({"kind": kind, "at": at + 7.0})
+    return ServeScenario(
+        seed=int(seed), num_slices=num_slices,
+        failure_domains=failure_domains,
+        duration_s=240.0 + 60.0 * rng.randrange(0, 3),
+        base_rps=1.0 + 0.5 * rng.randrange(0, 3),
+        deadline_s=90.0 + 30.0 * rng.randrange(0, 3),
+        events=events,
+    )
+
+
+def run_serve_campaign(
+    scenario: ServeScenario,
+    workdir: Path,
+    policy: "sup_mod.SupervisePolicy | None" = None,
+    gw_policy=None,
+    heal_seconds: float = 120.0,
+) -> dict:
+    """Drive one seeded request-plane campaign: a REAL Supervisor and a
+    REAL Gateway as co-actors on ONE SimClock (the elastic drill's
+    shape). The supervisor reconciles the scripted world and publishes
+    fleet-status.json; the gateway serves the seeded open-loop arrival
+    stream through that file, journaling every request transition.
+    Scheduled gateway kills drop the in-memory gateway and resume a
+    fresh one from the journal. Afterwards the ServeInvariantChecker
+    folds BOTH ledgers; the campaign verdict carries its violations."""
+    from tritonk8ssupervisor_tpu.provision.fleetview import FileHealthSource
+    from tritonk8ssupervisor_tpu.serving import gateway as gw_mod
+    from tritonk8ssupervisor_tpu.serving import reqlog as reqlog_mod
+    from tritonk8ssupervisor_tpu.serving import traffic as traffic_mod
+
+    policy = policy or default_policy()
+    interval = policy.interval
+    clock = SimClock(stall_timeout=60.0)
+    config = sim_config(scenario.num_slices, scenario.failure_domains)
+    world = ChaosFleet(Path(workdir), clock, config,
+                       heal_seconds=heal_seconds)
+    torn_at: list = []
+    kill_at: list = []
+    for event in scenario.events:
+        kind = event["kind"]
+        if kind == "slice-outage":
+            for i in event["slices"]:
+                world.preempt(i, at=event["at"])
+        elif kind == "preemption-storm":
+            for i in event["slices"]:
+                world.preempt(i, at=event["at"])
+        elif kind == "quota-storm":
+            world.quota_storm(event["at"], event["at"] + event["duration"])
+        elif kind == "flapping-ssh":
+            world.flap_ssh(event["slice"], event["at"],
+                           event["at"] + event["duration"],
+                           event["period"])
+        elif kind == "torn-status":
+            torn_at.append(float(event["at"]))
+        elif kind == "gateway-kill":
+            kill_at.append(float(event["at"]))
+    torn_at.sort()
+    kill_at.sort()
+
+    ledger = events_mod.EventLedger(world.paths.events, clock=clock.time,
+                                    echo=lambda line: None, fsync=False)
+    # fsync=False is honest here: the campaign's "SIGKILL" drops
+    # in-memory objects, which OS-buffered writes survive by
+    # construction; the REAL fsync path is pinned by the reqlog unit
+    # tests and the `./setup.sh serve` wiring
+    reqlog = reqlog_mod.RequestLog(world.paths.request_log,
+                                   clock=clock.time,
+                                   echo=lambda line: None, fsync=False)
+    gw_policy = gw_policy or gw_mod.GatewayPolicy(
+        max_seq_len=512, slots_per_slice=4, prefill_chunk=64,
+        queue_budget=32, bucket_bounds=(64, 128, 256),
+        poll_every_s=2.0, default_deadline_s=scenario.deadline_s,
+    )
+    cost = gw_mod.DecodeCostModel()
+
+    stop = threading.Event()
+    clock.launch()
+
+    def sup_body() -> None:
+        clock.begin()
+        try:
+            supervisor = sup_mod.Supervisor(
+                config, world.paths, _Quiet(),
+                run=world.run, run_quiet=world.run_quiet, policy=policy,
+                ledger=ledger, clock=clock.time, sleep=clock.sleep,
+                rng=lambda: 0.0, readiness_timeout=60.0, hooks=clock,
+            )
+            supervisor.restore()
+            while not stop.is_set():
+                supervisor.tick()
+                if stop.is_set():
+                    break
+                clock.sleep(interval)
+        finally:
+            clock.release()
+
+    thread = threading.Thread(target=sup_body, daemon=True)
+
+    def make_gateway() -> "gw_mod.Gateway":
+        engines = {
+            i: gw_mod.ModeledEngine(slots=gw_policy.slots_per_slice,
+                                    prefill_chunk=gw_policy.prefill_chunk,
+                                    cost=cost)
+            for i in range(scenario.num_slices)
+        }
+        return gw_mod.Gateway(
+            engines, FileHealthSource(world.paths.fleet_status),
+            policy=gw_policy, clock=clock.time, reqlog=reqlog,
+        )
+
+    model = traffic_mod.TrafficModel(
+        base_rps=scenario.base_rps, diurnal_amplitude=0.2,
+        diurnal_period_s=600.0, seed=scenario.seed,
+        deadline_s=scenario.deadline_s,
+        key_prefix=f"c{scenario.seed}",
+    )
+    arrivals = traffic_mod.generate_arrivals(model, scenario.duration_s)
+    hard_stop = scenario.duration_s + scenario.drain_grace_s
+
+    thread.start()
+    gateway = make_gateway()
+    gateway.recover(0.0)
+    kills = 0
+    redone = 0
+    i_arr = 0
+    next_step: dict = {i: None for i in gateway.workers}
+    quiet = False
+    clock.launch()
+    clock.begin()
+    try:
+        while True:
+            now = clock.time()
+            while torn_at and torn_at[0] <= now:
+                torn_at.pop(0)
+                _tear_file(world.paths.fleet_status)
+            if kill_at and kill_at[0] <= now:
+                # SIGKILL mid-dispatch: every queued and in-flight
+                # request in MEMORY is gone; the journal is not
+                kill_at.pop(0)
+                kills += 1
+                gateway = make_gateway()
+                recovered = gateway.recover(now)
+                redone += recovered["redone"]
+                next_step = {i: None for i in gateway.workers}
+            gateway.poll(now)
+            gateway.expire_queued(now)
+            # engine liveness follows the world: a preempted slice's
+            # engine dies with it, a healed slice's engine comes back
+            down = world.down_now()
+            for i, worker in gateway.workers.items():
+                if i in down and worker.alive:
+                    worker.fail()
+                    next_step[i] = None
+                elif i not in down and not worker.alive:
+                    worker.revive()
+                    next_step[i] = now
+            while i_arr < len(arrivals) and arrivals[i_arr].arrival <= now:
+                gateway.submit(arrivals[i_arr], now)
+                i_arr += 1
+            for i in sorted(gateway.workers):
+                if next_step[i] is not None and next_step[i] <= now:
+                    dt = gateway.workers[i].step(now)
+                    next_step[i] = None if dt is None else now + dt
+            for i, worker in gateway.workers.items():
+                if (next_step[i] is None and worker.alive
+                        and (worker.inflight or (
+                            gateway.queue_depth()
+                            and gateway.slice_mode(i) == gw_mod.SERVE))):
+                    next_step[i] = now
+            quiet = (i_arr >= len(arrivals) and not kill_at
+                     and gateway.queue_depth() == 0
+                     and all(w.idle()
+                             for w in gateway.workers.values()))
+            if quiet or now >= hard_stop:
+                break
+            candidates = [t for t in next_step.values() if t is not None]
+            if i_arr < len(arrivals):
+                candidates.append(arrivals[i_arr].arrival)
+            if kill_at:
+                candidates.append(kill_at[0])
+            if torn_at:
+                candidates.append(torn_at[0])
+            # watchdog boundary: even a fully-idle gateway keeps
+            # polling, so a post-heal generation bump still requeues
+            # stranded work and deadline sweeps keep their timing
+            candidates.append(now + 2.0 * gw_policy.poll_every_s)
+            t_next = min(candidates)
+            if t_next > now:
+                clock.sleep(t_next - now)
+    finally:
+        stop.set()
+        clock.release()
+    thread.join(timeout=120)
+
+    req_records = reqlog.replay()
+    led_records = ledger.replay()
+    # the worst HONEST view age: a tick that waits out up to two heal
+    # waves cannot publish mid-wait, plus flap-confirm ticks either
+    # side — the gateway keeps routing on its last good view throughout
+    checker = ServeInvariantChecker(
+        gw_policy, interval_s=interval,
+        staleness_bound_s=2.0 * heal_seconds + 4.0 * interval
+        + gw_policy.poll_every_s,
+    )
+    violations = checker.check(req_records, led_records)
+    if not quiet:
+        violations.append(
+            f"convergence: request plane not quiescent by "
+            f"t={hard_stop:.0f}s (seed {scenario.seed})"
+        )
+    view = reqlog_mod.fold(req_records)
+    accepted = sum(1 for kv in view.keys.values() if kv.accepts > 0)
+    return {
+        "seed": scenario.seed,
+        "events": [e["kind"] for e in scenario.events],
+        "offered": len(arrivals),
+        "accepted": accepted,
+        "completed": sum(kv.completions for kv in view.keys.values()),
+        "expired": sum(kv.expiries for kv in view.keys.values()),
+        "requeues": sum(kv.requeues for kv in view.keys.values()),
+        "sheds": view.sheds,
+        "shed_reasons": dict(sorted(view.shed_reasons.items())),
+        "gateway_kills": kills,
+        "redone_after_kill": redone,
+        "violations": violations,
+        "converged": quiet,
+        "end_s": clock.time(),
+    }
+
+
+class ServeInvariantChecker:
+    """Fold a campaign's request journal (serving/reqlog.py) — and the
+    supervisor's event ledger next to it — and assert the request
+    plane's safety contract. Like the provisioning InvariantChecker,
+    the checks work on the RAW record stream: the journal is the
+    gateway's flight recorder, and a fold that summarised away an
+    illegal transition must not be able to hide it.
+
+    - **request conservation**: every ACCEPTED acceptance ends in
+      exactly one terminal record (COMPLETED or EXPIRED) — work is
+      never silently lost, not across requeues, not across gateway
+      SIGKILLs; and nothing reaches a terminal state it was never
+      accepted for.
+    - **no double-service**: no idempotency key carries two COMPLETED
+      records, and no key is dispatched or requeued after its terminal
+      record without a fresh acceptance — exactly-once from the
+      client's view.
+    - **deadline honesty**: no dispatch at/after the deadline, no
+      completion past it (a late result is a 504, not a stale 200), no
+      expiry BEFORE it (shedding early is lying too), and every SHED
+      carries an honest Retry-After (positive for retryable reasons,
+      absent for unservable, with overload sheds naming a queue depth
+      that actually bound).
+    - **bounded staleness**: every dispatch records the age of the
+      routed fleet view; none may exceed the bound (worst honest gap =
+      one heal-length tick + a few intervals of keep-last-good).
+    - **cross-ledger**: the generations the gateway routed on must
+      exist in the supervisor's ledger, and a breaker-open shed is only
+      legal once the ledger actually shows a breaker opening.
+    """
+
+    _EPS = 1e-9
+    _UNTIMED_EXPIRY = ("timeout", "shutdown")  # not deadline-driven
+
+    def __init__(self, gw_policy, interval_s: float = 30.0,
+                 staleness_bound_s: float | None = None) -> None:
+        self.policy = gw_policy
+        self.interval_s = float(interval_s)
+        self.staleness_bound_s = (
+            float(staleness_bound_s) if staleness_bound_s is not None
+            else 6.0 * self.interval_s + float(gw_policy.poll_every_s)
+        )
+
+    def check(self, req_records: list, ledger_records: list = ()) -> list:
+        violations: list = []
+        violations += self.check_conservation(req_records)
+        violations += self.check_no_double_service(req_records)
+        violations += self.check_deadline_honesty(req_records)
+        violations += self.check_retry_after_honesty(req_records)
+        violations += self.check_view_staleness(req_records)
+        if ledger_records:
+            violations += self.check_cross_ledger(req_records,
+                                                  ledger_records)
+        return violations
+
+    # -- 1: request conservation -----------------------------------------
+
+    def check_conservation(self, records: list) -> list:
+        violations: list = []
+        accepts: dict = {}
+        terminals: dict = {}
+        for r in records:
+            key = r.get("key")
+            if not key:
+                continue
+            kind = r.get("kind")
+            if kind == reqlog_mod.ACCEPTED:
+                accepts[key] = accepts.get(key, 0) + 1
+            elif kind in (reqlog_mod.COMPLETED, reqlog_mod.EXPIRED):
+                terminals[key] = terminals.get(key, 0) + 1
+        for key in sorted(accepts):
+            if terminals.get(key, 0) != accepts[key]:
+                violations.append(
+                    f"request-conservation: key {key} accepted "
+                    f"{accepts[key]}x but reached "
+                    f"{terminals.get(key, 0)} terminal state(s)"
+                )
+        for key in sorted(set(terminals) - set(accepts)):
+            violations.append(
+                f"request-conservation: key {key} reached a terminal "
+                "state without ever being accepted"
+            )
+        return violations
+
+    # -- 2: no double-service --------------------------------------------
+
+    def check_no_double_service(self, records: list) -> list:
+        violations: list = []
+        completed: dict = {}
+        phase: dict = {}  # key -> open | terminal
+        for idx, r in enumerate(records):
+            key = r.get("key")
+            if not key:
+                continue
+            kind = r.get("kind")
+            if kind == reqlog_mod.COMPLETED:
+                completed[key] = completed.get(key, 0) + 1
+                if completed[key] > 1:
+                    violations.append(
+                        f"double-service: key {key} COMPLETED twice "
+                        f"(second at record {idx})"
+                    )
+                phase[key] = "terminal"
+            elif kind == reqlog_mod.EXPIRED:
+                phase[key] = "terminal"
+            elif kind == reqlog_mod.ACCEPTED:
+                phase[key] = "open"
+            elif kind in (reqlog_mod.DISPATCHED, reqlog_mod.REQUEUED):
+                if phase.get(key) == "terminal":
+                    violations.append(
+                        f"double-service: key {key} {kind} at record "
+                        f"{idx} AFTER its terminal state (no fresh "
+                        "acceptance in between)"
+                    )
+        return violations
+
+    # -- 3: deadline honesty ---------------------------------------------
+
+    def check_deadline_honesty(self, records: list) -> list:
+        violations: list = []
+        deadline_at: dict = {}  # key -> absolute deadline or None
+        for idx, r in enumerate(records):
+            key = r.get("key")
+            if not key:
+                continue
+            kind = r.get("kind")
+            ts = r.get("ts", 0.0)
+            if kind == reqlog_mod.ACCEPTED:
+                deadline_at[key] = (
+                    ts + float(r["deadline_s"])
+                    if r.get("deadline_s") is not None else None
+                )
+            elif kind == reqlog_mod.DISPATCHED:
+                bound = deadline_at.get(key)
+                if bound is not None and ts >= bound - self._EPS:
+                    violations.append(
+                        f"deadline-honesty: key {key} dispatched at "
+                        f"t={ts:.3f} on/after its deadline "
+                        f"t={bound:.3f} (record {idx})"
+                    )
+            elif kind == reqlog_mod.COMPLETED:
+                bound = deadline_at.get(key)
+                if bound is not None and ts > bound + 1e-6:
+                    violations.append(
+                        f"deadline-honesty: key {key} served at "
+                        f"t={ts:.3f}, past its deadline t={bound:.3f} "
+                        f"(record {idx}) — a late result must be a 504"
+                    )
+            elif kind == reqlog_mod.EXPIRED:
+                if r.get("where") in self._UNTIMED_EXPIRY:
+                    continue
+                bound = deadline_at.get(key)
+                if bound is not None and ts < bound - 1e-6:
+                    violations.append(
+                        f"deadline-honesty: key {key} expired at "
+                        f"t={ts:.3f}, BEFORE its deadline "
+                        f"t={bound:.3f} (record {idx})"
+                    )
+        return violations
+
+    # -- 4: honest Retry-After -------------------------------------------
+
+    def check_retry_after_honesty(self, records: list) -> list:
+        violations: list = []
+        for idx, r in enumerate(records):
+            if r.get("kind") != reqlog_mod.SHED:
+                continue
+            reason = r.get("reason", "")
+            retry_after = r.get("retry_after_s")
+            if reason == "unservable":
+                if retry_after is not None:
+                    violations.append(
+                        f"retry-after: unservable shed at record {idx} "
+                        "carries a retry hint (retrying cannot help)"
+                    )
+                continue
+            if retry_after is None or retry_after <= 0:
+                violations.append(
+                    f"retry-after: {reason} shed at record {idx} has "
+                    f"no positive Retry-After ({retry_after!r})"
+                )
+            if reason == "overload":
+                depth = r.get("depth")
+                if depth is None or depth < self.policy.queue_budget:
+                    violations.append(
+                        f"retry-after: overload shed at record {idx} "
+                        f"without a binding queue (depth {depth!r} < "
+                        f"budget {self.policy.queue_budget})"
+                    )
+        return violations
+
+    # -- 5: bounded view staleness ---------------------------------------
+
+    def check_view_staleness(self, records: list) -> list:
+        violations: list = []
+        for idx, r in enumerate(records):
+            if r.get("kind") != reqlog_mod.DISPATCHED:
+                continue
+            age = r.get("view_age_s")
+            if age is not None and age > self.staleness_bound_s:
+                violations.append(
+                    f"view-staleness: dispatch at record {idx} routed "
+                    f"on a {age:.0f}s-old fleet view (bound "
+                    f"{self.staleness_bound_s:.0f}s)"
+                )
+        return violations
+
+    # -- 6: cross-ledger consistency -------------------------------------
+
+    def check_cross_ledger(self, req_records: list,
+                           ledger_records: list) -> list:
+        violations: list = []
+        final_gen = events_mod.fold(
+            list(ledger_records)).membership_generation
+        for idx, r in enumerate(req_records):
+            if r.get("kind") != reqlog_mod.DISPATCHED:
+                continue
+            gen = r.get("generation")
+            if gen is not None and gen > final_gen:
+                violations.append(
+                    f"cross-ledger: dispatch at record {idx} routed on "
+                    f"membership generation {gen}, but the supervisor's "
+                    f"ledger never got past {final_gen}"
+                )
+        breaker_opens = [
+            r.get("ts", 0.0) for r in ledger_records
+            if r.get("kind") in (events_mod.BREAKER_OPEN,
+                                 events_mod.DOMAIN_BREAKER_OPEN)
+        ]
+        for idx, r in enumerate(req_records):
+            if (r.get("kind") == reqlog_mod.SHED
+                    and r.get("reason") == "breaker-open"):
+                ts = r.get("ts", 0.0)
+                if not any(open_ts <= ts for open_ts in breaker_opens):
+                    violations.append(
+                        f"cross-ledger: breaker-open shed at record "
+                        f"{idx} (t={ts:.0f}) but the supervisor's "
+                        "ledger shows no breaker opening before it"
+                    )
+        return violations
+
+
+def _static_status_doc(now: float, num_slices: int,
+                       generation: int = 1) -> dict:
+    """A healthy fleet-status document with the serving/membership
+    blocks the gateway routes on — the kill drill's scripted
+    supervisor side (the campaigns use the REAL supervisor)."""
+    return {
+        "v": 1,
+        "updated": now,
+        "verdict": "healthy",
+        "slices_total": num_slices,
+        "membership": {"generation": generation,
+                       "heal_in_progress": False, "draining": []},
+        "degraded": [],
+        "serving": {"eligible": list(range(num_slices)), "avoid": {},
+                    "shed": False},
+    }
+
+
+def run_gateway_kill_drill(
+    workdir: Path,
+    num_slices: int = 2,
+    kill_at: float = 100.0,
+    duration_s: float = 240.0,
+    base_rps: float = 2.0,
+    deadline_s: float = 120.0,
+    resubmit: int = 3,
+    seed: int = 17,
+) -> dict:
+    """THE gateway crash-resume acceptance drill, fully deterministic
+    (one actor, scripted healthy fleet): open-loop traffic with
+    idempotency keys and deadlines; at `kill_at` the in-memory gateway
+    is dropped mid-dispatch (queued + in-flight state gone) and a fresh
+    one resumes from the request journal. Measured: requests redone
+    (re-admitted front-of-queue) vs LOST (accepted but never terminal —
+    must be 0), duplicates of pre-kill completions answered from the
+    journal without regenerating, and restart-to-first-token MTTR."""
+    from tritonk8ssupervisor_tpu.provision.fleetview import FileHealthSource
+    from tritonk8ssupervisor_tpu.serving import gateway as gw_mod
+    from tritonk8ssupervisor_tpu.serving import reqlog as reqlog_mod
+    from tritonk8ssupervisor_tpu.serving import traffic as traffic_mod
+
+    root = Path(workdir)
+    root.mkdir(parents=True, exist_ok=True)
+    clock = SimClock()
+    status_path = root / "fleet-status.json"
+    events_mod.write_fleet_status(
+        status_path, _static_status_doc(0.0, num_slices)
+    )
+    reqlog = reqlog_mod.RequestLog(root / "serve-requests.jsonl",
+                                   clock=clock.time,
+                                   echo=lambda line: None, fsync=False)
+    policy = gw_mod.GatewayPolicy(
+        max_seq_len=512, slots_per_slice=4, prefill_chunk=64,
+        queue_budget=64, bucket_bounds=(64, 128, 256),
+        poll_every_s=2.0, default_deadline_s=deadline_s,
+    )
+    cost = gw_mod.DecodeCostModel()
+
+    def make_gateway() -> "gw_mod.Gateway":
+        engines = {
+            i: gw_mod.ModeledEngine(slots=policy.slots_per_slice,
+                                    prefill_chunk=policy.prefill_chunk,
+                                    cost=cost)
+            for i in range(num_slices)
+        }
+        return gw_mod.Gateway(
+            engines, FileHealthSource(status_path), policy=policy,
+            clock=clock.time, reqlog=reqlog,
+        )
+
+    model = traffic_mod.TrafficModel(
+        base_rps=base_rps, diurnal_amplitude=0.0, seed=seed,
+        deadline_s=deadline_s, key_prefix="kill",
+    )
+    arrivals = traffic_mod.generate_arrivals(model, duration_s)
+    gateway = make_gateway()
+    i_arr = 0
+    next_step: dict = {i: None for i in gateway.workers}
+    # the scripted supervisor side republishes on a tick cadence, like
+    # the real one — otherwise every dispatch routes on an ever-older
+    # view and the staleness invariant (rightly) fires
+    status_every = 30.0
+    next_status_at = status_every
+    killed = False
+    inflight_at_kill = queued_at_kill = 0
+    redone = 0
+    replays_ok = 0
+    resubmitted = 0
+    post_kill_metrics = None
+    hard_stop = duration_s + 600.0
+    clock.launch()
+    clock.begin()
+    try:
+        while True:
+            now = clock.time()
+            while next_status_at <= now:
+                events_mod.write_fleet_status(
+                    status_path,
+                    _static_status_doc(next_status_at, num_slices),
+                )
+                next_status_at += status_every
+            if not killed and now >= kill_at:
+                killed = True
+                inflight_at_kill = sum(
+                    len(w.inflight) for w in gateway.workers.values()
+                )
+                queued_at_kill = gateway.queue_depth()
+                pre_kill_done = [
+                    kv.key for kv in sorted(
+                        reqlog_mod.fold(reqlog.replay()).keys.values(),
+                        key=lambda kv: kv.key)
+                    if kv.state == "completed"
+                ]
+                gateway = make_gateway()  # SIGKILL: memory gone
+                recovered = gateway.recover(now)
+                redone = recovered["redone"]
+                post_kill_metrics = gateway.metrics
+                next_step = {i: None for i in gateway.workers}
+                # duplicate submissions of already-completed keys: the
+                # journal must answer them, nothing may regenerate
+                for n, key in enumerate(pre_kill_done[:resubmit]):
+                    resubmitted += 1
+                    duplicate = gw_mod.Request(
+                        rid=900000 + n, prompt_len=8, max_new_tokens=4,
+                        key=key,
+                    )
+                    admission = gateway.submit(duplicate, now)
+                    if (admission.ok
+                            and admission.reason == gw_mod.REPLAYED
+                            and admission.result is not None):
+                        replays_ok += 1
+            gateway.poll(now)
+            while (i_arr < len(arrivals)
+                   and arrivals[i_arr].arrival <= now):
+                gateway.submit(arrivals[i_arr], now)
+                i_arr += 1
+            for i in sorted(gateway.workers):
+                if next_step[i] is not None and next_step[i] <= now:
+                    dt = gateway.workers[i].step(now)
+                    next_step[i] = None if dt is None else now + dt
+            for i, worker in gateway.workers.items():
+                if (next_step[i] is None and worker.alive
+                        and (worker.inflight or (
+                            gateway.queue_depth()
+                            and gateway.slice_mode(i)
+                            == gw_mod.SERVE))):
+                    next_step[i] = now
+            quiet = (i_arr >= len(arrivals) and killed
+                     and gateway.queue_depth() == 0
+                     and all(w.idle()
+                             for w in gateway.workers.values()))
+            if quiet or now >= hard_stop:
+                break
+            candidates = [t for t in next_step.values()
+                          if t is not None]
+            if i_arr < len(arrivals):
+                candidates.append(arrivals[i_arr].arrival)
+            if not killed:
+                candidates.append(kill_at)
+            candidates.append(next_status_at)
+            t_next = min(candidates) if candidates else hard_stop
+            if t_next > now:
+                clock.sleep(t_next - now)
+    finally:
+        clock.release()
+
+    records = reqlog.replay()
+    view = reqlog_mod.fold(records)
+    lost = [kv.key for kv in view.incomplete()]
+    first_tokens_after_kill = [
+        r.first_token_at for r in post_kill_metrics.completed
+        if r.first_token_at is not None and r.first_token_at >= kill_at
+    ] if post_kill_metrics is not None else []
+    restart_mttr = (round(min(first_tokens_after_kill) - kill_at, 3)
+                    if first_tokens_after_kill else None)
+    checker = ServeInvariantChecker(policy, interval_s=30.0)
+    violations = checker.check(records)
+    if lost:
+        violations.append(
+            f"gateway-kill: {len(lost)} accepted request(s) lost "
+            f"across the restart: {lost[:5]}"
+        )
+    return {
+        "num_slices": num_slices,
+        "kill_at_s": kill_at,
+        "duration_s": duration_s,
+        "offered": len(arrivals),
+        "accepted": sum(1 for kv in view.keys.values()
+                        if kv.accepts > 0),
+        "completed": sum(kv.completions for kv in view.keys.values()),
+        "expired": sum(kv.expiries for kv in view.keys.values()),
+        "inflight_at_kill": inflight_at_kill,
+        "queued_at_kill": queued_at_kill,
+        "requests_redone": redone,
+        "requests_lost": len(lost),
+        "duplicates_resubmitted": resubmitted,
+        "duplicates_replayed_from_journal": replays_ok,
+        "restart_to_first_token_s": restart_mttr,
+        "violations": violations,
+    }
