@@ -157,7 +157,7 @@ def test_recover_after_compact_requeues_and_answers(tmp_path):
     g2 = make_gateway(tmp_path, clock=clock)
     recovered = g2.recover(10.0)
     assert recovered == {"redone": 1, "completed_cached": 1,
-                         "expired_on_recover": 0}
+                         "expired_on_recover": 0, "unrecoverable": 0}
     got = g2.submit(req(9, key="a"), now=10.0)
     assert got.ok and got.reason == gw.REPLAYED
     assert got.result["tokens"] == [5, 6]
@@ -359,11 +359,105 @@ def test_recover_expires_deadlines_lapsed_during_outage(tmp_path):
     g2 = make_gateway(tmp_path, clock=clock)
     out = g2.recover(100.0)
     assert out == {"redone": 1, "completed_cached": 0,
-                   "expired_on_recover": 1}
+                   "expired_on_recover": 1, "unrecoverable": 0}
     view = rl.fold(g2.reqlog.replay())
     assert view.keys["doomed"].state == "expired"
     assert view.keys["doomed"].expired["where"] == "recover"
     assert g2.claim(0, now=100.0).key == "alive"
+
+
+def test_recover_rebuilds_prompt_tokens_from_journal(tmp_path):
+    """The ACCEPTED record carries the prompt tokens, so a restarted
+    gateway re-admits the request with its REAL content — never a
+    fabricated all-zeros prompt."""
+    clock = FakeClock()
+    g1 = make_gateway(tmp_path, clock=clock)
+    original = req(1, key="a")
+    original.tokens = [3, 1, 4, 1, 5, 9, 2, 6]
+    assert g1.submit(original, now=0.0).ok
+    clock.now = 5.0
+    g2 = make_gateway(tmp_path, clock=clock)
+    g2.workers[0].engine.requires_tokens = True  # a real decode engine
+    assert g2.recover(5.0)["redone"] == 1
+    claimed = g2.claim(0, now=5.0)
+    assert claimed.key == "a"
+    assert [int(t) for t in claimed.tokens] == [3, 1, 4, 1, 5, 9, 2, 6]
+
+
+def test_recover_settles_unreconstructable_keys_terminal(tmp_path):
+    """A journal without prompt tokens (older schema) on a gateway
+    whose engines need real content: the key settles terminal
+    (recover-unrecoverable) instead of being served from a fabricated
+    prompt and journaled as the key's real result. The retrying client
+    opens a fresh epoch with its real prompt."""
+    clock = FakeClock()
+    g1 = make_gateway(tmp_path, clock=clock)
+    assert g1.submit(req(1, key="old"), now=0.0).ok  # no tokens journaled
+    clock.now = 5.0
+    g2 = make_gateway(tmp_path, clock=clock)
+    g2.workers[0].engine.requires_tokens = True
+    out = g2.recover(5.0)
+    assert out == {"redone": 0, "completed_cached": 0,
+                   "expired_on_recover": 0, "unrecoverable": 1}
+    view = rl.fold(g2.reqlog.replay())
+    assert view.keys["old"].state == "expired"
+    assert view.keys["old"].expired["where"] == "recover-unrecoverable"
+    assert g2.claim(0, now=5.0) is None
+    # conservation holds across the refusal...
+    checker = chaos.ServeInvariantChecker(g2.policy)
+    assert checker.check(g2.reqlog.replay()) == []
+    # ...and the 504'd key is re-acceptable with its real prompt
+    retry = req(9, key="old")
+    retry.tokens = [1, 2, 3, 4, 5, 6, 7, 8]
+    after = g2.submit(retry, now=6.0)
+    assert after.ok and after.reason == gw.ACCEPTED
+
+
+def test_recover_settles_bucket_mismatch_terminal(tmp_path):
+    """A journaled prompt no current bucket holds (the config shrank
+    across the restart) is still OWED a terminal state: settled
+    recover-unroutable, never silently dropped."""
+    clock = FakeClock()
+    g1 = make_gateway(tmp_path, clock=clock)  # bounds (64, 128, 256)
+    assert g1.submit(req(1, prompt=200, key="wide"), now=0.0).ok
+    clock.now = 5.0
+    g2 = make_gateway(tmp_path, clock=clock, bucket_bounds=(64,))
+    out = g2.recover(5.0)
+    assert out == {"redone": 0, "completed_cached": 0,
+                   "expired_on_recover": 0, "unrecoverable": 1}
+    view = rl.fold(g2.reqlog.replay())
+    assert view.keys["wide"].state == "expired"
+    assert view.keys["wide"].expired["where"] == "recover-unroutable"
+    checker = chaos.ServeInvariantChecker(g2.policy)
+    assert checker.check(g2.reqlog.replay()) == []
+
+
+def test_terminal_key_retention_and_journal_compaction(tmp_path):
+    """The long-running-server bound: settled keys past the retention
+    cap fall out of the in-memory index and trail map (a later
+    duplicate regenerates — retention IS the replay window), and the
+    journal auto-compacts to snapshots of the RETAINED keys only."""
+    clock = FakeClock()
+    g = make_gateway(tmp_path, clock=clock, terminal_key_retention=3,
+                     journal_compact_records=10)
+    for i in range(12):
+        r = req(i, key=f"k{i}")
+        assert g.submit(r, now=float(i)).ok
+        r.generated, r.done_at = 2, float(i) + 0.5
+        g.complete(r)
+    assert len(g._terminal_order) <= 3
+    assert len(g._trails) <= 3
+    assert len(g._key_state) <= 3
+    # the newest key replays from memory; an evicted key regenerates
+    assert g.submit(req(100, key="k11"), now=20.0).reason == gw.REPLAYED
+    assert g.submit(req(101, key="k0"), now=20.0).reason == gw.ACCEPTED
+    # the journal was compacted down to snapshots, not every record
+    # ever appended, and the evicted keys' snapshots were dropped too
+    records = g.reqlog.replay()
+    assert any(r["kind"] == rl.STATE for r in records)
+    assert len(records) < 24  # 12 accepts + 12 completions uncompacted
+    snapshot_keys = {r["key"] for r in records if r["kind"] == rl.STATE}
+    assert "k0" not in snapshot_keys and "k1" not in snapshot_keys
 
 
 # --------------------------------------------------- cold start + crash
@@ -432,6 +526,14 @@ class _BoomEngine:
         raise RuntimeError("XLA device lost")
 
 
+class _WreckedEngine(_BoomEngine):
+    """step() raises AND reset() raises — a genuinely broken engine
+    whose containment (fail_worker -> reap -> reset) fails too."""
+
+    def reset(self):
+        raise RuntimeError("reset failed: device wedged")
+
+
 def test_engine_loop_crash_requeues_and_surfaces_503(tmp_path):
     """The EngineLoop satellite: an engine raising mid-step is caught,
     its in-flight slots are requeued through the journal, the healthy
@@ -495,6 +597,66 @@ def test_engine_loop_crash_requeues_and_surfaces_503(tmp_path):
     finally:
         server.shutdown()
         server.server_close()
+        loop.stop()
+    checker = chaos.ServeInvariantChecker(policy)
+    assert checker.check(reqlog.replay()) == []
+
+
+def test_fail_worker_survives_reset_failure(tmp_path):
+    """reap() on a genuinely wrecked engine (reset() raising too) must
+    not void the containment: the in-flight work is still rescued and
+    requeued, the worker just stays dead."""
+    clock = FakeClock()
+    g = make_gateway(tmp_path, num_slices=2, clock=clock)
+    g.workers[0].engine = _WreckedEngine()
+    assert g.submit(req(1, key="a"), now=0.0).ok
+    claimed = g.claim(0, now=1.0)
+    g.workers[0].engine.join(0, claimed)
+    g.workers[0].inflight[0] = claimed
+    requeued = g.fail_worker(0, now=2.0, error="boom")  # must not raise
+    assert requeued == 1
+    assert g.workers[0].alive is False
+    assert g.claim(1, now=3.0) is claimed  # the work moved on
+
+
+def test_engine_loop_survives_engine_reset_failure(tmp_path):
+    """The stepping thread outlives a DOUBLE failure: an engine raising
+    mid-step whose reset() raises too. The crash surfaces on
+    loop.crashed, the wrecked worker stays dead, and the surviving
+    worker finishes every request — no stranded waiters."""
+    from tritonk8ssupervisor_tpu.serving import server as server_mod
+
+    clock = time.monotonic
+    reqlog = rl.RequestLog(tmp_path / "r.jsonl", echo=lambda line: None)
+    policy = gw.GatewayPolicy(max_seq_len=512,
+                              bucket_bounds=(64, 128, 256),
+                              slots_per_slice=2)
+    engines = {0: _WreckedEngine(),
+               1: gw.ModeledEngine(slots=2, prefill_chunk=64)}
+    gateway = gw.Gateway(engines, None, policy=policy, clock=clock,
+                         reqlog=reqlog)
+    lock = threading.Lock()
+    loop = server_mod.EngineLoop(gateway, lock)
+    done = [threading.Event(), threading.Event()]
+    requests = [
+        gw.Request(rid=i, prompt_len=8, max_new_tokens=2,
+                   key=f"wreck-{i}",
+                   notify=lambda _r, e=done[i]: e.set())
+        for i in range(2)
+    ]
+    loop.start()
+    try:
+        with lock:
+            for request in requests:
+                assert gateway.submit(request, clock()).ok
+        for event in done:
+            assert event.wait(30.0), "a waiter was stranded"
+        assert loop.crashed is not None
+        assert loop.is_alive()  # the second failure did not kill it
+        assert gateway.workers[0].alive is False
+        assert all(r.done_at is not None for r in requests)
+        assert all(r.slice_index == 1 for r in requests)
+    finally:
         loop.stop()
     checker = chaos.ServeInvariantChecker(policy)
     assert checker.check(reqlog.replay()) == []

@@ -46,6 +46,11 @@ class SlotEngine:
     tree (greedy decoding — the serving drill's mode). Implements the
     gateway's engine surface: join/step/release/reset/busy_slots."""
 
+    # a real decode engine serves CONTENT, not sizes: the gateway's
+    # recover() must not re-admit a journaled request whose prompt
+    # tokens it cannot reconstruct (gateway.Gateway.recover)
+    requires_tokens = True
+
     def __init__(self, model, params, slots: int, max_len: int,
                  prefill_chunk: int = 32) -> None:
         import jax
@@ -97,12 +102,13 @@ class SlotEngine:
         not traffic."""
         if slot in self._requests:
             raise ValueError(f"slot {slot} already occupied")
-        tokens = np.asarray(
-            request.tokens
-            if request.tokens is not None
-            else np.zeros((request.prompt_len,), np.int32),
-            np.int32,
-        )
+        if request.tokens is None:
+            # generating from a fabricated prompt would be journaled as
+            # the request's real result — refuse loudly instead
+            raise ValueError(
+                f"request {request.rid} carries no prompt tokens"
+            )
+        tokens = np.asarray(request.tokens, np.int32)
         if tokens.size + request.max_new_tokens > self.max_len:
             raise ValueError(
                 f"prompt {tokens.size} + new {request.max_new_tokens} "

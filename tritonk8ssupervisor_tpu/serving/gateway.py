@@ -190,6 +190,17 @@ class GatewayPolicy:
     # requests without their own deadline_s inherit this (None = no
     # deadline: the PR-9 behavior, requests wait forever)
     default_deadline_s: float | None = None
+    # settled (completed/expired) idempotency keys kept answerable in
+    # memory: past this many, the oldest-settled are evicted from the
+    # key index and trail map — a duplicate arriving later regenerates,
+    # so the retention window must exceed the client retry horizon
+    # (0 = unbounded, the bench/sim default semantics)
+    terminal_key_retention: int = 4096
+    # rewrite the request journal down to per-key snapshots (dropping
+    # evicted terminal keys) once it holds this many records, so a
+    # long-running server's journal stays O(retained keys), not
+    # O(requests ever served) (0 = never auto-compact)
+    journal_compact_records: int = 20000
     # serve with NO fleet view ever read, even though a health source
     # is configured (standalone drills set this; a gateway fronting a
     # supervised fleet keeps False and sheds `no-fleet-view` instead of
@@ -358,10 +369,20 @@ class SliceWorker:
 
     def reap(self) -> list[Request]:
         """Pull every in-flight request out (the slice left the serving
-        set); the engine is reset so a healed slice starts clean."""
+        set); the engine is reset so a healed slice starts clean. A
+        reset that raises too (a genuinely wrecked engine) must not
+        void the reap — the requests are already rescued; the worker
+        just stays dead until revived."""
         lost = [self.inflight[s] for s in sorted(self.inflight)]
         self.inflight.clear()
-        self.engine.reset()
+        try:
+            self.engine.reset()
+        except Exception as e:  # noqa: BLE001 - containment of containment
+            self.alive = False
+            self.gateway._echo(
+                f"[gateway] slice {self.index} engine reset failed "
+                f"({e!r}): worker stays dead"
+            )
         return lost
 
     def step(self, now: float) -> float | None:
@@ -458,6 +479,10 @@ class Gateway:
         # from the journal, kept live by submit/complete/expire.
         self._key_state: dict = {}
         self._trails: dict = {}  # key -> bounded lifecycle trail
+        # settled keys in settlement order (insertion-ordered dict used
+        # as an LRU): the eviction queue terminal_key_retention bounds
+        self._terminal_order: dict = {}
+        self._journal_appends = 0  # records since the last compact
         # recent completion timestamps: the observed service rate the
         # deadline-feasibility check models queue wait with
         self._completion_times: deque = deque(maxlen=64)
@@ -672,10 +697,16 @@ class Gateway:
         self.queues[bound].append(request)
         if request.key is not None:
             self._key_state[request.key] = ("inflight", None)
+            self._terminal_order.pop(request.key, None)  # live again
+        # the ACCEPTED record carries the prompt tokens on the real
+        # path: they ARE the request's content, and recover() must
+        # never re-serve a key it would have to fabricate a prompt for
         self._journal(reqlog_mod.ACCEPTED, key=request.key,
                       rid=request.rid, prompt_len=request.prompt_len,
                       max_new_tokens=request.max_new_tokens,
-                      deadline_s=request.deadline_s)
+                      deadline_s=request.deadline_s,
+                      **({"tokens": [int(t) for t in request.tokens]}
+                         if request.tokens is not None else {}))
         self.metrics.accepted.append((now, request.rid))
         self.metrics.depth_samples.append((now, self.queue_depth()))
         return Admission(True)
@@ -755,8 +786,12 @@ class Gateway:
         where the time went — the ONLY way a request dies. `where` is
         queue (skipped at claim), slot (reclaimed at a boundary),
         requeue (deadline lapsed while stranded), recover (lapsed
-        across a gateway restart), or timeout (the HTTP handler gave
-        up on a deadline-free request)."""
+        across a gateway restart), recover-unroutable (the restarted
+        gateway's bucket config can no longer hold the prompt),
+        recover-unrecoverable (the journal holds no prompt tokens and
+        the engines need real ones — re-serving would fabricate the
+        prompt), or timeout (the HTTP handler gave up on a
+        deadline-free request)."""
         request.expired_at = now
         request.expired_where = where
         served = (round(now - request.dispatched_at, 6)
@@ -772,7 +807,7 @@ class Gateway:
         }
         self.metrics.expired.append(audit)
         if request.key is not None:
-            self._key_state[request.key] = ("expired", None)
+            self._settle_key(request.key, "expired", None)
         self._journal(reqlog_mod.EXPIRED, key=request.key,
                       rid=request.rid, where=where,
                       deadline_s=request.deadline_s,
@@ -844,7 +879,7 @@ class Gateway:
                               if request.done_at is not None else None),
                 "retries": request.retries,
             }
-            self._key_state[request.key] = ("completed", result)
+            self._settle_key(request.key, "completed", result)
             self._journal(reqlog_mod.COMPLETED, key=request.key,
                           rid=request.rid, slice=request.slice_index,
                           result=result, latency_s=result["latency_s"])
@@ -857,6 +892,7 @@ class Gateway:
         if self.reqlog is None:
             return
         record = self.reqlog.append(kind, **fields)
+        self._journal_appends += 1
         key = fields.get("key")
         if key:
             entry = {"ts": record["ts"], "kind": kind}
@@ -869,6 +905,42 @@ class Gateway:
             trail.append(entry)
             if len(trail) > 24:
                 del trail[0]
+        cap = self.policy.journal_compact_records
+        if cap and self._journal_appends >= int(cap):
+            self._compact_reqlog()
+
+    def _settle_key(self, key: str, state: str, result) -> None:
+        """Index a key's terminal state and enforce the retention cap:
+        past `terminal_key_retention` settled keys, the oldest-settled
+        fall out of the index and trail map (a later duplicate of an
+        evicted key regenerates — retention IS the replay window)."""
+        self._key_state[key] = (state, result)
+        self._terminal_order.pop(key, None)  # re-settle refreshes age
+        self._terminal_order[key] = True
+        cap = self.policy.terminal_key_retention
+        if cap and int(cap) > 0:
+            while len(self._terminal_order) > int(cap):
+                oldest = next(iter(self._terminal_order))
+                del self._terminal_order[oldest]
+                self._key_state.pop(oldest, None)
+                self._trails.pop(oldest, None)
+
+    def _compact_reqlog(self) -> int:
+        """Rewrite the journal to per-key snapshots, dropping terminal
+        keys the retention cap already evicted from memory — the
+        serving path's bound on journal growth (the sim campaigns never
+        reach the cap, so their raw record streams stay intact for the
+        invariant checkers)."""
+        if self.reqlog is None:
+            return 0
+        view = reqlog_mod.fold(self.reqlog.replay())
+        evicted = [key for key, kv in view.keys.items()
+                   if kv.terminal and key not in self._key_state]
+        for key in evicted:
+            del view.keys[key]
+        dropped = self.reqlog.compact(view)
+        self._journal_appends = 0
+        return dropped
 
     def trail(self, key: str | None) -> list:
         """The journaled lifecycle of one idempotency key (bounded) —
@@ -883,28 +955,38 @@ class Gateway:
         dispatched when the process died) are re-admitted at the FRONT
         of the queue — same semantics as the generation-bump requeue —
         and keys whose deadline lapsed during the outage settle
-        terminal-expired instead of being served to nobody."""
+        terminal-expired instead of being served to nobody. A key the
+        restarted gateway cannot re-serve faithfully (prompt tokens
+        missing from the journal on a real engine, or a prompt no
+        current bucket holds) also settles terminal — never served
+        from a fabricated prompt, never silently dropped."""
         if self.reqlog is None:
             return {"redone": 0, "completed_cached": 0,
-                    "expired_on_recover": 0}
+                    "expired_on_recover": 0, "unrecoverable": 0}
         now = self._clock() if now is None else now
-        view = reqlog_mod.fold(self.reqlog.replay())
-        redone = expired = cached = 0
+        records = self.reqlog.replay()
+        view = reqlog_mod.fold(records)
+        redone = expired = cached = unrecoverable = 0
         for kv in view.keys.values():
             if kv.state == "completed":
-                self._key_state[kv.key] = ("completed", kv.result)
                 self._trails[kv.key] = list(kv.trail)
+                self._settle_key(kv.key, "completed", kv.result)
                 cached += 1
             elif kv.state == "expired":
-                self._key_state[kv.key] = ("expired", None)
+                self._settle_key(kv.key, "expired", None)
+        # an inherited journal past the compaction cap is folded down
+        # NOW, before the restart's own appends grow it further
+        self._journal_appends = len(records)
+        # the engines decide what a re-admitted request must carry: a
+        # real decode engine (SlotEngine) needs the prompt token ids; a
+        # modeled one serves from the sizes alone
+        needs_tokens = any(getattr(w.engine, "requires_tokens", False)
+                           for w in self.workers.values())
         # journal timestamps live on the journal's clock; translate a
         # key's age onto ours so deadlines keep their anchor even when
         # the gateway clock is monotonic and the journal's is wall
         journal_now = self.reqlog._clock()
         for kv in reversed(view.incomplete()):  # appendleft: oldest in front
-            bound = self.buckets.bucket_for(kv.prompt_len)
-            if bound is None:
-                continue  # journal from an older bucket config
             age = max(0.0, journal_now - (kv.accepted_ts
                                           if kv.accepted_ts is not None
                                           else journal_now))
@@ -913,10 +995,11 @@ class Gateway:
                 prompt_len=kv.prompt_len,
                 max_new_tokens=kv.max_new_tokens,
                 arrival=now - age, key=kv.key,
+                tokens=(list(kv.tokens)
+                        if kv.tokens is not None else None),
                 deadline_s=kv.deadline_s,
                 retries=kv.requeues + 1,
             )
-            req.bucket = bound
             self._trails[kv.key] = list(kv.trail)
             self._key_state[kv.key] = ("inflight", None)
             deadline = self.deadline_at(req)
@@ -924,19 +1007,40 @@ class Gateway:
                 self.expire(req, "recover", now)
                 expired += 1
                 continue
+            bound = self.buckets.bucket_for(kv.prompt_len)
+            if bound is None:
+                # journal from an older bucket config: the key cannot
+                # be routed any more. Still OWED a terminal state —
+                # settle it so conservation holds and a retry with the
+                # same key opens a fresh epoch under the new config.
+                self.expire(req, "recover-unroutable", now)
+                unrecoverable += 1
+                continue
+            if needs_tokens and req.tokens is None:
+                # the ACCEPTED record holds no prompt tokens (an older
+                # journal schema): re-serving would substitute a
+                # fabricated prompt and journal its output as this
+                # key's real result. Settle terminal instead — the
+                # retrying client regenerates with its real prompt.
+                self.expire(req, "recover-unrecoverable", now)
+                unrecoverable += 1
+                continue
+            req.bucket = bound
             self.queues[bound].appendleft(req)
             self._journal(reqlog_mod.REQUEUED, key=kv.key, rid=kv.rid,
                           cause="gateway-restart", retries=req.retries)
             redone += 1
         self.metrics.requeued += redone
-        if redone or expired or cached:
+        if redone or expired or cached or unrecoverable:
             self._echo(
                 f"[gateway] journal recovered: {redone} request(s) "
                 f"re-admitted front-of-queue, {expired} expired during "
-                f"the outage, {cached} completed key(s) answerable"
+                f"the outage, {unrecoverable} settled unrecoverable, "
+                f"{cached} completed key(s) answerable"
             )
         return {"redone": redone, "completed_cached": cached,
-                "expired_on_recover": expired}
+                "expired_on_recover": expired,
+                "unrecoverable": unrecoverable}
 
     # -------------------------------------------------------------- reports
 

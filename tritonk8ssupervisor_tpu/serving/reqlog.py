@@ -12,7 +12,11 @@ plane the same durability discipline the provisioning plane got from
   forward-compat schema skipping are the SAME code, not a copy):
 
       ACCEPTED    admission succeeded: the gateway now OWES a terminal
-                  state for this idempotency key
+                  state for this idempotency key. On the real serve
+                  path the record carries the PROMPT TOKENS — they are
+                  the request's content, and recover() cannot re-serve
+                  what it cannot reconstruct (a fabricated prompt would
+                  be journaled as the key's real result)
       DISPATCHED  a slice worker claimed it (carries the routed view's
                   generation and age — the staleness audit trail)
       REQUEUED    pulled back to the front of the queue (slice loss,
@@ -124,6 +128,7 @@ class KeyView:
     prompt_len: int = 0
     max_new_tokens: int = 0
     deadline_s: float | None = None
+    tokens: list | None = None  # prompt token ids (real path only);
     accepted_ts: float | None = None  # latest ACCEPTED (re-accept legal
     accepts: int = 0                  # only after a terminal EXPIRED)
     dispatches: int = 0
@@ -187,6 +192,7 @@ def state_fields(kv: KeyView) -> dict:
         "prompt_len": kv.prompt_len,
         "max_new_tokens": kv.max_new_tokens,
         "deadline_s": kv.deadline_s,
+        "tokens": kv.tokens,
         "accepted_ts": kv.accepted_ts,
         "accepts": kv.accepts,
         "dispatches": kv.dispatches,
@@ -207,6 +213,7 @@ def _apply_state(view: RequestLogView, record: dict) -> None:
     kv.prompt_len = record.get("prompt_len", 0)
     kv.max_new_tokens = record.get("max_new_tokens", 0)
     kv.deadline_s = record.get("deadline_s")
+    kv.tokens = record.get("tokens")
     kv.accepted_ts = record.get("accepted_ts")
     kv.accepts = record.get("accepts", 0)
     kv.dispatches = record.get("dispatches", 0)
@@ -247,6 +254,7 @@ def apply(view: RequestLogView, record: dict) -> RequestLogView:
         kv.prompt_len = record.get("prompt_len", 0)
         kv.max_new_tokens = record.get("max_new_tokens", 0)
         kv.deadline_s = record.get("deadline_s")
+        kv.tokens = record.get("tokens")
         kv.expired = None  # a re-accept supersedes the expired epoch
     elif kind == DISPATCHED:
         kv.state = "dispatched"
@@ -258,10 +266,12 @@ def apply(view: RequestLogView, record: dict) -> RequestLogView:
         kv.state = "completed"
         kv.completions += 1
         kv.result = record.get("result")
+        kv.tokens = None  # settled: the prompt is no longer owed
     elif kind == EXPIRED:
         kv.state = "expired"
         kv.expiries += 1
         kv.expired = {"where": record.get("where"), "ts": record.get("ts")}
+        kv.tokens = None
     elif kind == REPLAYED:
         kv.replays += 1
     return view

@@ -66,8 +66,16 @@ class EngineLoop(threading.Thread):
                             advanced = True
                     except Exception as e:  # noqa: BLE001 - contained
                         self.crashed = e
-                        self.gateway.fail_worker(index, self.clock(),
-                                                 error=repr(e))
+                        try:
+                            self.gateway.fail_worker(index, self.clock(),
+                                                     error=repr(e))
+                        except Exception:  # noqa: BLE001 - still contained
+                            # even the containment failed (a wrecked
+                            # engine raising from reset() too): the
+                            # worker stays dead, the loop keeps the
+                            # OTHER workers' requests moving, and the
+                            # original crash stays on self.crashed
+                            worker.fail()
             if not advanced:
                 self.stop_event.wait(self.idle_s)
 

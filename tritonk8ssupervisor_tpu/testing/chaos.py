@@ -1019,7 +1019,12 @@ class ServeInvariantChecker:
     """
 
     _EPS = 1e-9
-    _UNTIMED_EXPIRY = ("timeout", "shutdown")  # not deadline-driven
+    # expiries that are NOT deadline-driven (may legally land before
+    # the deadline): handler gave up, process stopped, or the restarted
+    # gateway could not faithfully re-serve the key (bucket config
+    # changed / prompt tokens unreconstructable)
+    _UNTIMED_EXPIRY = ("timeout", "shutdown", "recover-unroutable",
+                       "recover-unrecoverable")
 
     def __init__(self, gw_policy, interval_s: float = 30.0,
                  staleness_bound_s: float | None = None) -> None:
