@@ -1,0 +1,172 @@
+"""Paged-KV host bookkeeping: PagePool refcounts + PrefixStore chain.
+
+The invariants the serving engines build on (serving/kvpool.py):
+
+- a page is in use exactly while someone holds a ref; the last unref
+  frees it (no leaks, no double-frees);
+- the prefix store's match is a CHAINED longest-prefix walk — block j
+  only matches if blocks 0..j-1 matched (K/V content depends on the
+  whole prefix);
+- eviction is LRU and only FREES pages nobody else holds — an entry
+  whose page a live slot still maps drops from the index (no future
+  matches) but the page survives until that slot releases;
+- at least one prompt token is never shareable (match_cap_blocks): the
+  last position's logits seed the first generated token.
+"""
+
+import pytest
+
+from tritonk8ssupervisor_tpu.serving import kvpool
+
+
+# ------------------------------------------------------------- page pool
+
+
+def test_pool_alloc_ref_unref_roundtrip():
+    pool = kvpool.PagePool(4, page_size=8)
+    got = pool.alloc(3)
+    assert len(got) == 3
+    assert pool.pages_in_use == 3 and pool.pages_free == 1
+    pool.ref([got[0]])
+    assert pool.unref([got[0]]) == 0  # still held once
+    assert pool.unref(got) == 3
+    assert pool.pages_in_use == 0 and pool.pages_free == 4
+
+
+def test_pool_alloc_exhaustion_returns_none_not_partial():
+    pool = kvpool.PagePool(2, page_size=8)
+    assert pool.alloc(2) is not None
+    assert pool.alloc(1) is None
+    assert pool.pages_free == 0  # the failed alloc took nothing
+
+
+def test_pool_unref_of_free_page_raises():
+    pool = kvpool.PagePool(2, page_size=8)
+    (page,) = pool.alloc(1)
+    pool.unref([page])
+    with pytest.raises(ValueError, match="free page"):
+        pool.unref([page])
+    with pytest.raises(ValueError, match="free page"):
+        pool.ref([page])
+
+
+def test_pool_unbounded_mode_mints_and_accounts():
+    pool = kvpool.PagePool(None, page_size=8)
+    a = pool.alloc(3)
+    b = pool.alloc(2)
+    assert len(set(a) | set(b)) == 5  # fresh ids, never aliased
+    assert pool.pages_in_use == 5
+    assert pool.pages_free > 1 << 20  # capacity never binds
+    pool.unref(a + b)
+    assert pool.pages_in_use == 0
+
+
+def test_pool_peak_tracks_high_water():
+    pool = kvpool.PagePool(8, page_size=8)
+    got = pool.alloc(6)
+    pool.unref(got[:5])
+    pool.alloc(1)
+    assert pool.peak_in_use == 6
+
+
+# ---------------------------------------------------------- block keying
+
+
+def test_token_block_keys_chain_depends_on_whole_prefix():
+    a = kvpool.token_block_keys([1, 2, 3, 4, 5, 6, 7, 8], 4, 2)
+    b = kvpool.token_block_keys([1, 2, 3, 4, 5, 6, 7, 8], 4, 2)
+    assert a == b  # content-addressed: same tokens, same keys
+    c = kvpool.token_block_keys([9, 2, 3, 4, 5, 6, 7, 8], 4, 2)
+    assert c[0] != a[0]
+    assert c[1] != a[1]  # a changed FIRST block re-keys every later one
+    d = kvpool.token_block_keys([1, 2, 3, 4, 9, 6, 7, 8], 4, 2)
+    assert d[0] == a[0] and d[1] != a[1]
+
+
+def test_full_blocks_and_match_cap():
+    assert kvpool.full_blocks(8, 4) == 2
+    assert kvpool.full_blocks(7, 4) == 1
+    assert kvpool.full_blocks(3, 4) == 0
+    # a fully-page-aligned prompt still keeps its last block private:
+    # the final token's logits must come from a real prefill
+    assert kvpool.match_cap_blocks(8, 4) == 1
+    assert kvpool.match_cap_blocks(9, 4) == 2
+    assert kvpool.match_cap_blocks(1, 4) == 0
+
+
+# ---------------------------------------------------------- prefix store
+
+
+def make_store(num_pages=8, ps=4):
+    pool = kvpool.PagePool(num_pages, page_size=ps)
+    return pool, kvpool.PrefixStore(pool)
+
+
+def test_store_match_is_chained_longest_prefix():
+    pool, store = make_store()
+    pages = pool.alloc(3)
+    store.register(["a", "b", "c"], pages)
+    n, got = store.match(["a", "b", "x"])
+    assert (n, got) == (2, pages[:2])
+    # a miss at block 0 matches nothing even if later keys exist
+    n, got = store.match(["x", "b", "c"])
+    assert (n, got) == (0, [])
+    assert store.hits == 1 and store.misses == 1
+    assert store.hit_tokens == 2 * pool.page_size
+
+
+def test_store_register_refs_and_skips_existing():
+    pool, store = make_store()
+    pages = pool.alloc(2)
+    assert store.register(["a", "b"], pages) == 2
+    assert pool.refcount(pages[0]) == 2  # slot + store
+    other = pool.alloc(2)
+    # first writer wins: re-registering the same chain keeps the
+    # original pages and takes no new refs
+    assert store.register(["a", "b"], other) == 0
+    assert store.match(["a", "b"])[1] == pages
+    assert pool.refcount(other[0]) == 1
+
+
+def test_store_peek_counts_nothing():
+    pool, store = make_store()
+    store.register(["a"], pool.alloc(1))
+    assert store.peek(["a"]) == 1
+    assert store.peek(["z"]) == 0
+    assert store.hits == 0 and store.misses == 0
+
+
+def test_store_eviction_is_lru_and_match_refreshes_age():
+    pool, store = make_store(num_pages=4)
+    store.register(["a"], pool.alloc(1))
+    store.register(["b"], pool.alloc(1))
+    # pages were allocated by "the slot" too; release the slot refs so
+    # the store is the only holder (the evictable state)
+    for key in ("a", "b"):
+        pool.unref([store._entries[key]])
+    store.match(["a"])  # refresh a's age: b is now the LRU entry
+    assert store.evict_for(1) == 1
+    assert store.peek(["b"]) == 0  # b evicted...
+    assert store.peek(["a"]) == 1  # ...a survives
+
+
+def test_store_eviction_of_live_page_drops_entry_but_frees_nothing():
+    pool, store = make_store(num_pages=2)
+    pages = pool.alloc(1)  # refcount 1: "a slot" holds it
+    store.register(["a"], pages)  # refcount 2
+    freed = store.evict_for(1)
+    assert freed == 0  # entry dropped, page still live under the slot
+    assert store.peek(["a"]) == 0
+    assert pool.refcount(pages[0]) == 1
+    assert pool.unref(pages) == 1  # the slot's release frees it
+
+
+def test_store_flush_releases_every_store_ref():
+    pool, store = make_store()
+    pages = pool.alloc(3)
+    store.register(["a", "b", "c"], pages)
+    pool.unref(pages)  # slot gone; store is the only holder
+    assert pool.pages_in_use == 3
+    assert store.flush() == 3
+    assert pool.pages_in_use == 0
+    assert len(store) == 0

@@ -530,6 +530,321 @@ def test_gateway_with_real_engine_end_to_end(tiny_lm):
     assert rb.out_tokens == reference_tokens(model, params, prompt_b, 3)
 
 
+# ----------------------------------------- paged KV + prefix reuse (real)
+
+
+def shared_prompts(prompt_b, vocab=64):
+    """Two prompts opening with the same 8-token prefix: the 9-token
+    prompt_b itself, and an 11-token sibling with a different tail."""
+    prefix = prompt_b[0][:8]
+    sibling = np.concatenate(
+        [prefix, np.asarray([3, 41, 7], np.int32)]
+    ).astype(np.int32)
+    return prompt_b[0], sibling
+
+
+def test_warm_prefix_staggered_join_token_parity(tiny_lm):
+    """THE prefix-reuse correctness pin: request A prefills and
+    registers its prompt's pages; request B, sharing A's 8-token
+    prefix, joins MID-DECODE of a third stream, matches 2 pages, and
+    prefills only its 3-token suffix — while producing EXACTLY the
+    tokens request-at-a-time decode.generate produces. Reuse changes
+    what gets re-prefilled, never what a token is."""
+    from tritonk8ssupervisor_tpu.serving.engine import SlotEngine
+
+    model, params, prompt_a, prompt_b = tiny_lm
+    first, sibling = shared_prompts(prompt_b)
+    ref_first = reference_tokens(model, params, first[None], 4)
+    ref_sib = reference_tokens(model, params, sibling[None], 4)
+    ref_a = reference_tokens(model, params, prompt_a, 8)
+    eng = SlotEngine(model, params, slots=3, max_len=model.max_seq_len,
+                     prefill_chunk=4, page_size=4)
+    eng.join(0, gw.Request(rid=0, prompt_len=9, max_new_tokens=4,
+                           tokens=first))
+    outs: dict = {}
+    for _ in range(30):
+        res = eng.step()
+        if res is None:
+            break
+        for slot, ids in res.finished.items():
+            outs[slot] = ids
+            eng.release(slot)
+    assert outs[0] == ref_first
+    assert eng.prefix.stats()["entries"] == 2  # blocks 0..1 registered
+    # a long decoder occupies the engine; B joins mid-stream and HITS
+    eng.join(1, gw.Request(rid=1, prompt_len=6, max_new_tokens=8,
+                           tokens=prompt_a[0]))
+    for _ in range(3):
+        eng.step()
+    before = eng.prefill_tokens
+    eng.join(2, gw.Request(rid=2, prompt_len=11, max_new_tokens=4,
+                           tokens=sibling))
+    while 2 not in outs or 1 not in outs:
+        res = eng.step()
+        assert res is not None
+        for slot, ids in res.finished.items():
+            outs[slot] = ids
+            eng.release(slot)
+    assert outs[2] == ref_sib
+    assert outs[1] == ref_a
+    # B prefilled ONLY its unshared suffix (11 - 8 = 3 tokens); A's
+    # mid-decode stream contributed no prefill in the window
+    assert eng.prefill_tokens - before == 3
+    stats = eng.prefix.stats()
+    assert stats["hits"] == 1 and stats["hit_tokens"] == 8
+
+
+def test_page_eviction_and_refcount_release_while_sharing(tiny_lm):
+    """The refcount pin: A and B share prefix pages; A completes and
+    releases FIRST — the pages survive under B + the store, B's tokens
+    stay exact. Then capacity pressure evicts the store's entries:
+    pages a live slot still maps are dropped from the index but not
+    freed, and a join that would need them refuses until B releases."""
+    from tritonk8ssupervisor_tpu.serving.engine import SlotEngine
+
+    model, params, _, prompt_b = tiny_lm
+    first, sibling = shared_prompts(prompt_b)
+    ref_sib = reference_tokens(model, params, sibling[None], 6)
+    eng = SlotEngine(model, params, slots=3, max_len=model.max_seq_len,
+                     prefill_chunk=4, page_size=4, num_pages=8)
+    eng.join(0, gw.Request(rid=0, prompt_len=9, max_new_tokens=2,
+                           tokens=first))
+    outs: dict = {}
+    for _ in range(10):
+        res = eng.step()
+        for slot, ids in (res.finished if res else {}).items():
+            outs[slot] = ids
+            eng.release(slot)
+        if 0 in outs:
+            break
+    assert 0 in outs
+    eng.join(1, gw.Request(rid=1, prompt_len=11, max_new_tokens=6,
+                           tokens=sibling))
+    assert eng.prefix.stats()["hits"] == 1
+    # B holds 2 shared + 3 private pages (suffix + 6-token budget);
+    # the store holds another ref on the shared two. A 3-page unique
+    # request takes the remaining free pages exactly
+    unique = np.asarray(range(20, 28), np.int32)  # 8 tokens, 3 pages
+    big = gw.Request(rid=2, prompt_len=8, max_new_tokens=4,
+                     tokens=unique)
+    eng.join(2, big)
+    assert eng.pages.pages_free == 0
+    # only store-ONLY pages are evictable, and B's shared pages are
+    # refcount 2 (store + B): a 4-page request must be refused
+    fat_tokens = np.asarray(range(40, 52), np.int32)  # 12 tokens
+    fat = gw.Request(rid=3, prompt_len=12, max_new_tokens=4,
+                     tokens=fat_tokens)
+    assert eng.prefix.evictable_pages() == 0
+    assert not eng.can_join(fat)
+    # B keeps decoding on the shared pages and finishes EXACTLY
+    while 1 not in outs:
+        res = eng.step()
+        assert res is not None
+        for slot, ids in res.finished.items():
+            outs[slot] = ids
+            eng.release(slot)
+    assert outs[1] == ref_sib
+    # with B gone the store's prefix pages are evictable again — the
+    # fat request fits by evicting the now-idle cache
+    assert eng.prefix.evictable_pages() >= 2
+    assert eng.can_join(fat)
+
+
+@pytest.mark.parametrize("chunk,ps", [(16, 4), (4, 8), (5, 3)])
+def test_prompt_crosses_page_boundaries_mid_chunk(tiny_lm, chunk, ps):
+    """A prefill chunk larger than a page scatters one dispatch across
+    page boundaries (and a chunk smaller than a page fills one page
+    across dispatches) — token-identical either way."""
+    from tritonk8ssupervisor_tpu.serving.engine import SlotEngine
+
+    model, params, _, prompt_b = tiny_lm
+    ref = reference_tokens(model, params, prompt_b, 5)
+    eng = SlotEngine(model, params, slots=2, max_len=model.max_seq_len,
+                     prefill_chunk=chunk, page_size=ps)
+    eng.join(0, gw.Request(rid=0, prompt_len=9, max_new_tokens=5,
+                           tokens=prompt_b[0]))
+    out = None
+    for _ in range(30):
+        res = eng.step()
+        if res and 0 in res.finished:
+            out = res.finished[0]
+            break
+    assert out == ref
+
+
+def test_reset_clears_pool_with_zero_leaked_pages(tiny_lm):
+    """reset() mid-prefill and mid-decode releases every page AND
+    flushes the prefix store (the cache content is gone): zero pages in
+    use, and the engine serves correctly afterwards."""
+    from tritonk8ssupervisor_tpu.serving.engine import SlotEngine
+
+    model, params, prompt_a, prompt_b = tiny_lm
+    eng = SlotEngine(model, params, slots=3, max_len=model.max_seq_len,
+                     prefill_chunk=4, page_size=4)
+    eng.join(0, gw.Request(rid=0, prompt_len=9, max_new_tokens=4,
+                           tokens=prompt_b[0]))
+    for _ in range(4):
+        eng.step()  # slot 0 registered its prefix; mid-decode
+    eng.join(1, gw.Request(rid=1, prompt_len=6, max_new_tokens=4,
+                           tokens=prompt_a[0]))
+    eng.step()  # slot 1 mid-prefill
+    assert eng.pages.pages_in_use > 0
+    eng.reset()
+    assert eng.pages.pages_in_use == 0
+    assert eng.pages.pages_free == eng.num_pages
+    assert len(eng.prefix) == 0
+    assert eng.busy_slots() == 0
+    # the pool is genuinely reusable: full parity after the reset
+    ref = reference_tokens(model, params, prompt_a, 4)
+    eng.join(0, gw.Request(rid=2, prompt_len=6, max_new_tokens=4,
+                           tokens=prompt_a[0]))
+    out = None
+    for _ in range(20):
+        res = eng.step()
+        if res and 0 in res.finished:
+            out = res.finished[0]
+            break
+    assert out == ref
+
+
+def test_paged_int8_token_identity(tiny_lm):
+    """The int8-KV interaction pin: per-(token, head) quantization
+    round-trips through paged blocks — (a) a single-chunk prompt is
+    token-identical to dense decode.generate(cache_int8=True), and
+    (b) the page LAYOUT never changes a token (page_size 4 vs one
+    giant page, chunked prefill, shared store on)."""
+    import jax.numpy as jnp
+
+    from tritonk8ssupervisor_tpu.models import decode as dec
+    from tritonk8ssupervisor_tpu.serving.engine import SlotEngine
+
+    model, params, prompt_a, prompt_b = tiny_lm
+    ref = list(np.asarray(dec.generate(
+        model, params, jnp.asarray(prompt_a), max_new_tokens=6,
+        max_len=model.max_seq_len, cache_int8=True,
+    ))[0])
+
+    def run(engine, tokens, new):
+        engine.join(0, gw.Request(rid=0, prompt_len=int(tokens.size),
+                                  max_new_tokens=new, tokens=tokens))
+        for _ in range(40):
+            res = engine.step()
+            if res and 0 in res.finished:
+                engine.release(0)
+                return res.finished[0]
+        raise AssertionError("never finished")
+
+    # (a) single-chunk prefill == dense int8 generate, bit for bit
+    single = SlotEngine(model, params, slots=2,
+                        max_len=model.max_seq_len, prefill_chunk=16,
+                        page_size=4, cache_int8=True)
+    assert run(single, prompt_a[0], 6) == ref
+    # (b) page layout invariance under CHUNKED prefill
+    small_pages = SlotEngine(model, params, slots=2,
+                             max_len=model.max_seq_len, prefill_chunk=4,
+                             page_size=4, cache_int8=True)
+    one_page = SlotEngine(model, params, slots=2,
+                          max_len=model.max_seq_len, prefill_chunk=4,
+                          page_size=32, cache_int8=True)
+    assert (run(small_pages, prompt_b[0], 5)
+            == run(one_page, prompt_b[0], 5))
+
+
+# ------------------------------------- paged/prefix gateway (modeled)
+
+
+def test_modeled_engine_page_accounting_head_of_line():
+    """Admission to a slot is accounted in PAGES: free slots with no
+    free pages claim nothing, the queue's head keeps its place, and
+    the claim flows the moment a release frees pages."""
+    eng = gw.ModeledEngine(slots=4, prefill_chunk=64, page_size=16,
+                           num_pages=8, prefix_cache=False)
+    gateway = gw.Gateway({0: eng}, None, policy=gw.GatewayPolicy(
+        max_seq_len=512, slots_per_slice=4, prefill_chunk=64,
+        bucket_bounds=(64, 128, 256),
+    ))
+    r1 = gw.Request(rid=1, prompt_len=64, max_new_tokens=32)  # 6 pages
+    r2 = gw.Request(rid=2, prompt_len=64, max_new_tokens=32)
+    assert gateway.submit(r1, now=0.0).ok
+    assert gateway.submit(r2, now=0.0).ok
+    t = 0.0
+    for _ in range(3):
+        dt = gateway.workers[0].step(t)
+        t += dt if dt else 1.0
+        # r2 needs 6 pages, only 2 free: NOT claimed, NOT dropped
+        if r1.done_at is None:
+            assert len(gateway.workers[0].inflight) == 1
+            assert gateway.queue_depth() == 1
+    while len(gateway.metrics.completed) < 2 and t < 500:
+        dt = gateway.workers[0].step(t)
+        t += dt if dt else 1.0
+    assert {r.rid for r in gateway.metrics.completed} == {1, 2}
+    assert eng.pages.pages_in_use == 0  # everything released
+    assert eng.peak_slots_busy == 1  # pages bound concurrency to 1
+
+
+def test_modeled_engine_prefix_hit_skips_prefill_and_reports():
+    """A shared-prefix request joining after the store warmed skips
+    the shared blocks' prefill; the gateway report surfaces the
+    hit/miss/pages counters an operator tunes by."""
+    eng = gw.ModeledEngine(slots=2, prefill_chunk=32, page_size=16,
+                           prefix_cache=True)
+    gateway = gw.Gateway({0: eng}, None, policy=gw.GatewayPolicy(
+        max_seq_len=512, slots_per_slice=2, prefill_chunk=32,
+        bucket_bounds=(64, 128, 256),
+    ))
+    r1 = gw.Request(rid=1, prompt_len=64, max_new_tokens=2,
+                    prefix_len=48, prefix_id="sys")
+    gateway.submit(r1, now=0.0)
+    t = 0.0
+    while r1.done_at is None and t < 100:
+        dt = gateway.workers[0].step(t)
+        t += dt if dt else 1.0
+    prefilled_cold = eng.prefill_tokens
+    assert prefilled_cold == 64  # r1 re-prefilled its whole prompt
+    # warm now: the sibling skips the 48 shared tokens (3 pages) —
+    # its single step claims, joins, AND prefills just the suffix
+    r2 = gw.Request(rid=2, prompt_len=64, max_new_tokens=2,
+                    prefix_len=48, prefix_id="sys")
+    gateway.submit(r2, now=t)
+    gateway.workers[0].step(t)
+    assert eng.prefill_tokens - prefilled_cold == 64 - 48
+    report = gateway.report()["engine"]
+    assert report["prefix"]["hits"] == 1
+    assert report["prefix"]["hit_tokens"] == 48
+    assert report["prefix"]["hit_rate"] == 0.5
+    assert report["pages_in_use"] > 0
+    assert report["per_slice"][0]["page_size"] == 16
+
+
+def test_traffic_shared_prefix_shape_and_legacy_stream():
+    """The shared-system-prompt workload shape: seeded, the share is
+    honored, prefixes never swallow the whole prompt, and a share of
+    ZERO reproduces the legacy stream token for token."""
+    legacy = traffic_mod.generate_arrivals(
+        traffic_mod.TrafficModel(seed=3, base_rps=5.0), 200.0)
+    off = traffic_mod.generate_arrivals(
+        traffic_mod.TrafficModel(seed=3, base_rps=5.0,
+                                 shared_prefix_len=64,
+                                 shared_prefix_share=0.0), 200.0)
+    assert [(r.rid, r.prompt_len, r.arrival) for r in legacy] == \
+        [(r.rid, r.prompt_len, r.arrival) for r in off]
+    assert all(r.prefix_id is None for r in off)
+    model = traffic_mod.TrafficModel(seed=3, base_rps=5.0,
+                                     shared_prefix_len=64,
+                                     shared_prefix_share=0.5)
+    shared = traffic_mod.generate_arrivals(model, 200.0)
+    again = traffic_mod.generate_arrivals(model, 200.0)
+    assert [(r.rid, r.prefix_len) for r in shared] == \
+        [(r.rid, r.prefix_len) for r in again]
+    tagged = [r for r in shared if r.prefix_id is not None]
+    share = len(tagged) / len(shared)
+    assert 0.35 <= share <= 0.65
+    assert all(r.prefix_id == "sys-3" for r in tagged)
+    assert all(0 < r.prefix_len <= min(64, r.prompt_len - 1)
+               for r in tagged)
+
+
 # ------------------------------------------------------------- CLI smoke
 
 
@@ -725,6 +1040,56 @@ def test_serve_perf_smoke_outage_routes_around():
     assert result["sheds_outside_demand_window"] == 0
     assert result["overload_sheds_below_budget"] == 0
     assert result["p99_latency_s"] <= 60.0
+
+
+@pytest.mark.perf
+def test_serve_perf_smoke_prefix_cache_and_paged_slots():
+    """Tier-1 engine-hot-path drill (short): shared-system-prompt
+    traffic served cold (8 fixed slots, no prefix cache) vs warm
+    (prefix cache + 16 paged slots on a memory-equal pool) — the warm
+    drive must beat cold throughput, actually hit the cache, re-prefill
+    ~0 of the shared prefix on hits, and push effective concurrency
+    past the fixed 8."""
+    import bench_provision as bp
+
+    common = dict(num_slices=2, duration_s=300.0, base_rps=6.5,
+                  queue_budget=96, seed=5, page_size=16,
+                  shared_prefix_len=192, shared_prefix_share=0.6,
+                  prompt_lens=(208, 224, 240, 256))
+    cold = bp.run_serve_scenario(slots=8, prefill_chunk=64,
+                                 prefix_cache=False, **common)
+    warm = bp.run_serve_scenario(slots=16, prefill_chunk=64,
+                                 prefix_cache=True, pages_per_slice=256,
+                                 **common)
+    assert warm["tokens_per_sec"] > cold["tokens_per_sec"]
+    assert warm["quiescent"]
+    prefix = warm["engine"]["prefix"]
+    assert prefix["hit_rate"] >= 0.4
+    assert warm["engine"]["shared_prefix_reprefilled_on_hits"] == 0
+    assert warm["engine"]["peak_slots_busy"] > 8
+    assert warm["engine"]["prefill_tokens"] < cold["engine"][
+        "prefill_tokens"]
+
+
+@pytest.mark.perf
+def test_engine_benchmark_token_identical_and_skips_prefix():
+    """Tier-1 pin for the REAL-engine A/B (BENCH_engine.json's
+    producer, tiny config): prefix-warm output is token-identical to
+    cold, the shared prefix re-prefills nothing on hits, and warm
+    prefill work measurably shrinks. (Speedup is asserted on the
+    committed full-size run, not this smoke — tiny models are noise.)"""
+    from tritonk8ssupervisor_tpu.benchmarks import decode as dbench
+
+    result = dbench.run_engine_benchmark(
+        vocab_size=64, num_layers=2, num_heads=2, embed_dim=32,
+        max_len=64, prompt_len=32, shared_prefix_len=24, new_tokens=4,
+        requests=3, slots=2, page_size=8, prefill_chunk=16,
+    )
+    assert result["token_identical"]
+    assert result["shared_prefix_reprefilled_on_hits"] == 0
+    assert result["warm"]["prefix"]["hits"] >= 3
+    assert result["warm"]["prefill_tokens"] < result["cold"][
+        "prefill_tokens"]
 
 
 @pytest.mark.perf

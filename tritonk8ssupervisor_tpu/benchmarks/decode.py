@@ -133,6 +133,155 @@ def run_benchmark(
     }
 
 
+def run_engine_benchmark(
+    vocab_size: int = 512,
+    num_layers: int = 4,
+    num_heads: int = 4,
+    embed_dim: int = 128,
+    max_len: int = 512,
+    prompt_len: int = 256,
+    shared_prefix_len: int = 192,
+    new_tokens: int = 32,
+    requests: int = 8,
+    slots: int = 4,
+    page_size: int = 16,
+    prefill_chunk: int = 64,
+    cache_int8: bool = False,
+) -> dict:
+    """The decode-level engine-hot-path A/B (BENCH_engine.json): the
+    REAL `serving/engine.SlotEngine` (paged KV + prefix store) serving
+    the same shared-system-prompt request stream twice — prefix cache
+    OFF vs ON — on this process's devices. Every request opens with
+    the same `shared_prefix_len`-token system prompt and a unique
+    suffix, the millions-of-users shape; warm must produce EXACTLY the
+    cold tokens while re-prefilling ~0 of the shared prefix.
+
+    The warmup request (per engine) pays compilation AND seeds the
+    warm engine's store, so the timed window measures the steady
+    state: a cold engine re-prefilling the whole prompt per request vs
+    a warm engine prefilling only suffixes. Speedup is measured, not
+    assumed — `tokens_per_sec_per_chip` here speaks the same canonical
+    vocabulary as BENCH_serve.json and the gateway report."""
+    import numpy as np
+
+    from tritonk8ssupervisor_tpu.serving.engine import SlotEngine
+    from tritonk8ssupervisor_tpu.serving.gateway import Request
+
+    model = TransformerLM(
+        vocab_size=vocab_size,
+        num_layers=num_layers,
+        num_heads=num_heads,
+        embed_dim=embed_dim,
+        max_seq_len=max_len,
+    )
+    rng = np.random.default_rng(0)
+    prefix = rng.integers(0, vocab_size, shared_prefix_len)
+    prompts = [
+        np.concatenate([
+            prefix,
+            rng.integers(0, vocab_size, prompt_len - shared_prefix_len),
+        ]).astype(np.int32)
+        for _ in range(requests + 1)  # +1: the warmup request
+    ]
+    params = model.init(
+        jax.random.key(1), jnp.asarray(prompts[0][None, :]), train=False
+    )["params"]
+
+    def drive(engine, stream):
+        """Fill slots, step to completion, keep every slot busy —
+        the SliceWorker loop without a gateway. Returns outputs in
+        request order."""
+        pending = list(enumerate(stream))
+        done: dict = {}
+        inflight: dict = {}
+        while pending or inflight:
+            for slot in range(engine.slots):
+                if slot in inflight or not pending:
+                    continue
+                rid, tokens = pending[0]
+                req = Request(rid=rid, prompt_len=int(tokens.size),
+                              max_new_tokens=new_tokens, tokens=tokens)
+                if not engine.can_join(req):
+                    break
+                pending.pop(0)
+                engine.join(slot, req)
+                inflight[slot] = rid
+            result = engine.step()
+            if result is None:
+                break
+            for slot, ids in result.finished.items():
+                done[inflight.pop(slot)] = ids
+                engine.release(slot)
+        return [done[i] for i in sorted(done)]
+
+    results = {}
+    for mode, prefix_cache in (("cold", False), ("warm", True)):
+        engine = SlotEngine(
+            model, params, slots=slots, max_len=max_len,
+            prefill_chunk=prefill_chunk, page_size=page_size,
+            cache_int8=cache_int8, prefix_cache=prefix_cache,
+        )
+        drive(engine, prompts[:1])  # compile + (warm) seed the store
+        prefill_before = engine.prefill_tokens
+        start = time.monotonic()
+        outs = drive(engine, prompts[1:])
+        elapsed = time.monotonic() - start
+        stats = engine.stats()
+        total = sum(len(o) for o in outs)
+        results[mode] = {
+            "seconds": elapsed,
+            "tokens_generated": total,
+            "tokens_per_sec": total / elapsed,
+            "tokens_per_sec_per_chip": total / elapsed
+            / max(1, len(jax.devices())),
+            "prefill_tokens": stats["prefill_tokens"] - prefill_before,
+            "prefix": stats["prefix"],
+            "outputs": outs,
+        }
+    cold, warm = results["cold"], results["warm"]
+    token_identical = cold["outputs"] == warm["outputs"]
+    for mode in results.values():
+        del mode["outputs"]  # evidence checked, not committed
+    aligned = (shared_prefix_len // page_size) * page_size
+    hits = (warm["prefix"] or {}).get("hits", 0)
+    hit_tokens = (warm["prefix"] or {}).get("hit_tokens", 0)
+    reprefilled = hits * aligned - hit_tokens
+    speedup = (cold["seconds"] / warm["seconds"]
+               if warm["seconds"] else None)
+    passes = bool(
+        token_identical
+        and hits >= requests  # every timed request hit the warm store
+        and reprefilled == 0
+        and speedup is not None and speedup >= 1.05
+    )
+    return {
+        "benchmark": "engine_hot_path",
+        "metric": "prefix_warm_over_cold_speedup",
+        "unit": "x (same shared-system-prompt stream through the REAL "
+                "SlotEngine, paged KV both sides; warm = prefix store "
+                "seeded, token-identical output required)",
+        "platform": jax.default_backend(),
+        "num_chips": len(jax.devices()),
+        "model": {"vocab_size": vocab_size, "num_layers": num_layers,
+                  "num_heads": num_heads, "embed_dim": embed_dim},
+        "max_len": max_len,
+        "prompt_len": prompt_len,
+        "shared_prefix_len": shared_prefix_len,
+        "new_tokens": new_tokens,
+        "requests": requests,
+        "slots": slots,
+        "page_size": page_size,
+        "prefill_chunk": prefill_chunk,
+        "cache_int8": bool(cache_int8),
+        "value": round(speedup, 3) if speedup is not None else None,
+        "token_identical": token_identical,
+        "shared_prefix_reprefilled_on_hits": int(reprefilled),
+        "cold": cold,
+        "warm": warm,
+        "passes": passes,
+    }
+
+
 def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--vocab-size", type=int, default=32768)
@@ -167,6 +316,28 @@ def build_parser() -> argparse.ArgumentParser:
         "cache traffic, the lever for batch >= 8 where the cache read "
         "dominates (weights already amortised across the batch)",
     )
+    parser.add_argument(
+        "--engine",
+        action="store_true",
+        help="run the engine-hot-path A/B instead: the real paged "
+        "SlotEngine serving a shared-system-prompt stream with the "
+        "prefix cache off vs on (token-identical required; "
+        "BENCH_engine.json's producer)",
+    )
+    parser.add_argument(
+        "--engine-requests", type=int, default=8,
+        help="--engine: timed requests per drive (one extra warms up "
+        "compilation and the prefix store)",
+    )
+    parser.add_argument(
+        "--shared-prefix-len", type=int, default=192,
+        help="--engine: shared system-prompt tokens opening every "
+        "request's prompt",
+    )
+    parser.add_argument(
+        "--page-size", type=int, default=16,
+        help="--engine: KV-page size in tokens (serving/engine.py)",
+    )
     parser.add_argument("--json", action="store_true")
     return parser
 
@@ -178,6 +349,26 @@ def main(argv: list[str] | None = None) -> int:
     from tritonk8ssupervisor_tpu.parallel import initialize_from_env
 
     initialize_from_env()
+    if args.engine:
+        result = run_engine_benchmark(
+            requests=args.engine_requests,
+            shared_prefix_len=args.shared_prefix_len,
+            page_size=args.page_size,
+            cache_int8=args.cache_int8,
+        )
+        if args.json:
+            print(json.dumps(result, sort_keys=True))
+        else:
+            print(
+                f"engine hot path on {result['platform']}: prefix-warm "
+                f"{result['value']}x over cold "
+                f"({result['warm']['tokens_per_sec']:.0f} vs "
+                f"{result['cold']['tokens_per_sec']:.0f} tok/s), "
+                f"token-identical={result['token_identical']}, "
+                f"shared-prefix re-prefilled "
+                f"{result['shared_prefix_reprefilled_on_hits']} tokens"
+            )
+        return 0 if result["passes"] else 1
     result = run_benchmark(
         vocab_size=args.vocab_size,
         num_layers=args.num_layers,

@@ -307,6 +307,24 @@ def build_parser() -> argparse.ArgumentParser:
         "start until the supervisor publishes a view)",
     )
     parser.add_argument(
+        "--kv-page-size", type=int, default=16, metavar="TOKENS",
+        help="serve: KV-cache page size in tokens (paged slots: a "
+        "request holds ceil(span/page_size) pages instead of a dense "
+        "max_len row, and shared prompt prefixes are shared pages)",
+    )
+    parser.add_argument(
+        "--kv-pages", type=int, default=0, metavar="N",
+        help="serve: total KV pages in the engine's pool (0 = "
+        "memory-equal to the dense cache: slots * ceil(max_len / "
+        "page_size)) — raise it to cache more shared prefixes",
+    )
+    parser.add_argument(
+        "--no-prefix-cache", action="store_true",
+        help="serve: disable cross-request prefix/KV reuse (every "
+        "request re-prefills its whole prompt — the pre-hot-path "
+        "behavior, kept as an A/B lever)",
+    )
+    parser.add_argument(
         "--config",
         type=Path,
         default=None,
@@ -906,6 +924,9 @@ def serve_cmd(args, paths: state.RunPaths, prompter: Prompter) -> int:
         # mode fronting a supervised workdir sheds no-fleet-view 429s
         # until the supervisor's first publish (docs/failure-modes.md)
         allow_no_view=bool(args.allow_no_fleet_view or args.drill > 0),
+        page_size=max(1, args.kv_page_size),
+        pages_per_slice=(args.kv_pages if args.kv_pages > 0 else None),
+        prefix_cache=not args.no_prefix_cache,
     )
     # one local engine: this process serves as "slice 0" of whatever
     # fleet the status file describes — the per-slice dispatch fan-out
@@ -914,6 +935,9 @@ def serve_cmd(args, paths: state.RunPaths, prompter: Prompter) -> int:
     eng = engine_mod.SlotEngine(
         model, params, slots=policy.slots_per_slice, max_len=max_seq,
         prefill_chunk=policy.prefill_chunk,
+        page_size=policy.page_size,
+        num_pages=policy.pages_per_slice,
+        prefix_cache=policy.prefix_cache,
     )
     gw = gateway_mod.Gateway(
         {0: eng},

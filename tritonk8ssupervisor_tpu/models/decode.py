@@ -68,6 +68,43 @@ def init_kv_cache(model, batch: int, max_len: int,
     }
 
 
+def init_kv_pool(model, num_pages: int, page_size: int,
+                 int8: bool = False) -> dict:
+    """Zeroed paged K/V pool: {Block_i: {k, v: (P, page_size, H, D)}}
+    bf16 — the block-pool replacement for the dense per-slot cache the
+    serving engine used to allocate (serving/engine.SlotEngine maps
+    slots onto pages through per-slot page tables; short requests stop
+    paying max_len rows, and a shared prompt prefix is one set of pages
+    referenced by many slots).
+
+    int8=True mirrors init_kv_cache's quantized layout page-wise:
+    {k, v: int8 (P, page_size, H, D), k_scale, v_scale: (P, page_size,
+    H) f32}. _quant_kv's scales are per-(token, head), so quantizing a
+    chunk and scattering values + scales into pages is bit-identical to
+    quantizing into the dense cache — paging changes WHERE a token's
+    K/V lives, never its value.
+    """
+    head_dim = model.embed_dim // model.num_heads
+    shape = (num_pages, page_size, model.num_heads, head_dim)
+    if int8:
+        return {
+            f"Block_{i}": {
+                "k": jnp.zeros(shape, jnp.int8),
+                "v": jnp.zeros(shape, jnp.int8),
+                "k_scale": jnp.zeros(shape[:3], jnp.float32),
+                "v_scale": jnp.zeros(shape[:3], jnp.float32),
+            }
+            for i in range(model.num_layers)
+        }
+    return {
+        f"Block_{i}": {
+            "k": jnp.zeros(shape, jnp.bfloat16),
+            "v": jnp.zeros(shape, jnp.bfloat16),
+        }
+        for i in range(model.num_layers)
+    }
+
+
 def _quant_kv(x):
     """(B, S, H, D) -> (int8 values, (B, S, H) f32 scales): symmetric
     per-(token, head) quantization. The scale rides OUTSIDE the cache

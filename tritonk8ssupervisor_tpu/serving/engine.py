@@ -1,50 +1,82 @@
-"""Real continuous-batching decode engine over models/decode.py.
+"""Real continuous-batching decode engine over models/decode.py —
+paged KV slots + cross-request prefix reuse.
 
 `models/decode.generate` serves one batch from prefill to the last
 token — every stream starts and finishes together, so a finished
 stream's slot idles until the whole batch drains. `SlotEngine` breaks
-that coupling: the KV cache is allocated once for a fixed number of
-*slots*, and each slot runs its own request — joining, decoding, and
-leaving at step boundaries independently. Two compiled programs serve
-everything:
+that coupling: each of a fixed number of *slots* runs its own request,
+joining, decoding, and leaving at step boundaries independently.
 
-- **`_prefill_chunk`** (one shape): advance ONE slot's prompt by one
-  padded chunk. The chunk writes its K/V into the slot's cache rows at
-  `[start, start+chunk)` and attends causally against that slot's
-  cache — the same masked-static-shape discipline as decode, so a
-  prompt of any length is a loop of identical dispatches. Padding past
-  the prompt's true end is harmless by construction: the garbage K/V
-  lands at positions the decode path overwrites before it ever attends
-  to them (decode at position p writes p, then attends <= p).
-- **`_decode_step`** (one shape): one token for EVERY slot at once,
-  with a per-slot position vector — the cache write and the position
-  mask are per-row (vmapped `dynamic_update_slice`, `arange <= pos`),
-  which is exactly what lets slot 0 be at token 400 while slot 3 is at
-  token 2. Inactive slots compute masked garbage (static shapes) that
-  the next join's prefill overwrites.
+Since the engine-hot-path PR the KV cache is **paged**: K/V lives in a
+pool of fixed-size pages (`models/decode.init_kv_pool`) and each slot
+maps logical token positions onto pages through a per-slot page table.
+Two things fall out of that layout, and they compound:
+
+- **Short requests stop paying `max_len` memory.** A slot holds
+  `ceil(span / page_size)` pages for ITS span (prompt + budget, plus
+  the padded prefill tail), not a dense `max_len` row — so the same
+  pool serves more concurrent slots than the dense cache's
+  slots × max_len would (the gateway sizes `num_pages` memory-equal
+  and raises `slots`; bench_provision.py --serve measures it).
+- **A shared prompt prefix is ONE set of pages.** `join()` asks the
+  `PrefixStore` (serving/kvpool.py) for the longest block-aligned
+  match on the prompt's content-hash chain; matched pages are mapped
+  into the new slot's table copy-free (refcounted) and `_prefill_chunk`
+  starts at the first unshared token — under shared-system-prompt
+  traffic the shared prefix re-prefills ~0 tokens. A completed prefill
+  registers its full-prompt pages back into the store, so the cache
+  warms itself. At least one suffix token ALWAYS re-prefills: the
+  first generated token is the argmax of the logits at the last prompt
+  position, so a fully-shared prompt still runs its final block
+  (kvpool.match_cap_blocks).
+
+Two compiled programs still serve everything — the discipline is the
+same as pre-paging, with gathers/scatters through the page table
+replacing the dense slot row:
+
+- **`_prefill_chunk_paged`** (one shape): advance ONE slot's prompt by
+  one padded chunk. K/V scatters into the slot's pages at the chunk's
+  logical positions (`pool.at[pages, offsets].set`); attention gathers
+  the slot's logical view back through the table and masks causally.
+  Padding past the prompt's true end is harmless by construction: it
+  lands at positions the decode path overwrites before attending to
+  them, or (past the last page) in the pool's trash page.
+- **`_decode_step_paged`** (one shape): one token for EVERY slot at
+  once, per-slot position vectors, per-slot page-table gathers.
+  Inactive rows (empty slots, slots mid-prefill) park their cache
+  write on the trash page — a decode step can never clobber a
+  neighbour's mid-prefill prompt or a SHARED prefix page.
+
+int8 KV (`cache_int8=True`) quantizes per-(token, head) exactly like
+the dense cache (`decode._quant_kv`) with values AND scales scattered
+page-wise, so quantization commutes with paging: the same token's K/V
+is bit-identical no matter which page holds it (pinned by test against
+a one-giant-page layout). As in dense prefill, a chunk's OWN tokens
+attend their fresh full-precision K/V — the int8 error enters where
+later steps re-read the cache, not twice.
 
 Arithmetic is models/decode.py's, by reuse (`_dense`, `_ln`, `_head`,
-`_embed`, same einsum order, same f32 softmax, same bf16 cache) — the
-continuous-batching schedule changes WHEN work happens, never what a
-token's logits are. tests/test_serving.py pins token parity against
-`decode.generate` for staggered joins and chunked prefill.
-
-Scheduling per `step()` matches the gateway's modeled engine: one
-prefill chunk (round-robin over joining slots) rides along one decode
-step — a long prompt never stalls the streams decoding next to it.
+`_embed`, same einsum order, same f32 softmax, same bf16/int8 cache) —
+the continuous-batching schedule and the page layout change WHEN and
+WHERE work happens, never what a token's logits are.
+tests/test_serving.py pins token parity against `decode.generate` for
+staggered joins, chunked prefill, warm-prefix hits, page-boundary
+crossings, and eviction.
 """
 
 from __future__ import annotations
 
 import numpy as np
 
+from tritonk8ssupervisor_tpu.serving import kvpool
 from tritonk8ssupervisor_tpu.serving.gateway import Request, StepResult
 
 
 class SlotEngine:
     """Slot-based continuous batching for a TransformerLM parameter
     tree (greedy decoding — the serving drill's mode). Implements the
-    gateway's engine surface: join/step/release/reset/busy_slots."""
+    gateway's engine surface: join/step/release/reset/busy_slots, plus
+    the paged-KV capacity surface (can_join/stats)."""
 
     # a real decode engine serves CONTENT, not sizes: the gateway's
     # recover() must not re-admit a journaled request whose prompt
@@ -52,7 +84,10 @@ class SlotEngine:
     requires_tokens = True
 
     def __init__(self, model, params, slots: int, max_len: int,
-                 prefill_chunk: int = 32) -> None:
+                 prefill_chunk: int = 32, page_size: int = 32,
+                 num_pages: int | None = None,
+                 cache_int8: bool = False,
+                 prefix_cache: bool = True) -> None:
         import jax
         import jax.numpy as jnp
 
@@ -69,26 +104,92 @@ class SlotEngine:
         self.slots = int(slots)
         self.max_len = int(max_len)
         self.prefill_chunk = max(1, int(prefill_chunk))
-        self.cache = dec.init_kv_cache(model, self.slots, self.max_len)
+        self.page_size = max(1, int(page_size))
+        self.max_pages = -(-self.max_len // self.page_size)
+        # memory-equal default: the page pool holds exactly what the
+        # dense [slots, max_len] cache held — paging then RAISES
+        # effective concurrency instead of spending more HBM
+        self.num_pages = (int(num_pages) if num_pages is not None
+                          else self.slots * self.max_pages)
+        self.cache_int8 = bool(cache_int8)
+        self.trash = self.num_pages  # parking page for masked writes
+        self.pool = dec.init_kv_pool(model, self.num_pages + 1,
+                                     self.page_size, int8=self.cache_int8)
+        self.pages = kvpool.PagePool(self.num_pages, self.page_size)
+        self.prefix = (kvpool.PrefixStore(self.pages)
+                       if prefix_cache else None)
+        # per-slot page tables; one sentinel row past max_pages so the
+        # compiled clamp (min(p // ps, max_pages)) parks out-of-range
+        # padded-prefill writes on the trash page
+        self.tables = np.full((self.slots, self.max_pages + 1),
+                              self.trash, np.int32)
         # host-side per-slot decode state (tiny; shipped per dispatch)
         self.pos = np.zeros((self.slots,), np.int32)
         self.last = np.zeros((self.slots,), np.int32)
         self.active = np.zeros((self.slots,), bool)
-        self._requests: dict = {}  # slot -> {tokens, done, budget, out}
+        self._requests: dict = {}  # slot -> {tokens, done, budget, out, ...}
         self._prefill_rr = 0
-        # model hyperparameters and the chunk length are compile-time
-        # constants of this engine: close over them so exactly two
-        # programs exist (one prefill-chunk shape, one decode shape)
-        chunk = self.prefill_chunk
+        # counters the gateway's report()/healthz surface
+        self.joins = 0
+        self.prefill_tokens = 0  # prompt tokens actually processed
+        self.peak_slots_busy = 0
+        # model hyperparameters, the chunk length, and the page layout
+        # are compile-time constants of this engine: close over them so
+        # exactly two programs exist (one prefill-chunk shape, one
+        # decode shape)
+        chunk, ps, mp = self.prefill_chunk, self.page_size, self.max_pages
+        trash, int8 = self.trash, self.cache_int8
         self._prefill_fn = jax.jit(
-            lambda params, cache, tokens, slot, start, last_row:
-            _prefill_chunk(model, params, cache, tokens, slot, start,
-                           last_row, chunk)
+            lambda params, pool, tokens, table, start, last_row:
+            _prefill_chunk_paged(model, params, pool, tokens, table,
+                                 start, last_row, chunk, ps, mp, int8)
         )
         self._decode_fn = jax.jit(
-            lambda params, cache, last, pos, active:
-            _decode_step(model, params, cache, last, pos, active)
+            lambda params, pool, tables, last, pos, active:
+            _decode_step_paged(model, params, pool, tables, last, pos,
+                               active, ps, mp, trash, int8)
         )
+
+    # ------------------------------------------------------- page plumbing
+
+    def _span_pages(self, prompt_len: int, max_new: int,
+                    shared_blocks: int) -> int:
+        """Total pages a slot needs: the larger of the padded prefill
+        reach and prompt + budget, clamped to the table (writes past
+        max_len park on the trash page)."""
+        start0 = shared_blocks * self.page_size
+        suffix = max(1, prompt_len - start0)
+        prefill_end = start0 + -(-suffix // self.prefill_chunk) \
+            * self.prefill_chunk
+        span = min(max(prefill_end, prompt_len + max_new),
+                   self.max_pages * self.page_size)
+        return min(-(-span // self.page_size), self.max_pages)
+
+    def _alloc(self, need: int) -> list | None:
+        got = self.pages.alloc(need)
+        if got is None and self.prefix is not None:
+            self.prefix.evict_for(need - self.pages.pages_free)
+            got = self.pages.alloc(need)
+        return got
+
+    def can_join(self, request: Request) -> bool:
+        """Whether a join for this request would find pages RIGHT NOW
+        (free + evictable-from-the-store). The gateway's claim loop
+        asks before popping the queue — admission accounting is in
+        pages, not slots."""
+        n = int(request.prompt_len)
+        shared = 0
+        if self.prefix is not None and request.tokens is not None:
+            cap = kvpool.match_cap_blocks(n, self.page_size)
+            keys = kvpool.token_block_keys(request.tokens,
+                                           self.page_size, cap)
+            shared = self.prefix.peek(keys)
+        need = self._span_pages(n, int(request.max_new_tokens),
+                                shared) - shared
+        budget = self.pages.pages_free
+        if self.prefix is not None:
+            budget += self.prefix.evictable_pages()
+        return need <= budget
 
     # ------------------------------------------------------------- surface
 
@@ -96,10 +197,13 @@ class SlotEngine:
         return len(self._requests)
 
     def join(self, slot: int, request: Request) -> None:
-        """Claim `slot` for a request at a step boundary. The prompt
-        must already fit (the gateway's bucketing rejected overlong
-        prompts at admission); a violation here is a programming error,
-        not traffic."""
+        """Claim `slot` for a request at a step boundary, seeding its
+        page table from the prefix store's longest match so prefill
+        only processes the unshared suffix. The prompt must already fit
+        (the gateway's bucketing rejected overlong prompts at
+        admission) and the pool must hold pages (the gateway's claim
+        checked can_join); a violation here is a programming error, not
+        traffic."""
         if slot in self._requests:
             raise ValueError(f"slot {slot} already occupied")
         if request.tokens is None:
@@ -109,28 +213,89 @@ class SlotEngine:
                 f"request {request.rid} carries no prompt tokens"
             )
         tokens = np.asarray(request.tokens, np.int32)
-        if tokens.size + request.max_new_tokens > self.max_len:
+        n = int(tokens.size)
+        if n + request.max_new_tokens > self.max_len:
             raise ValueError(
-                f"prompt {tokens.size} + new {request.max_new_tokens} "
+                f"prompt {n} + new {request.max_new_tokens} "
                 f"exceeds cache {self.max_len}"
             )
+        keys = kvpool.token_block_keys(
+            tokens, self.page_size, kvpool.full_blocks(n, self.page_size)
+        )
+        shared_n, shared_pages = 0, []
+        if self.prefix is not None:
+            cap = kvpool.match_cap_blocks(n, self.page_size)
+            shared_n, shared_pages = self.prefix.match(keys[:cap])
+        total = self._span_pages(n, int(request.max_new_tokens), shared_n)
+        # the slot's refs land BEFORE any eviction could free the
+        # matched pages out from under it
+        self.pages.ref(shared_pages)
+        private = self._alloc(total - shared_n)
+        if private is None:
+            self.pages.unref(shared_pages)
+            raise RuntimeError(
+                f"page pool exhausted: slot {slot} needs "
+                f"{total - shared_n} pages, {self.pages.pages_free} free "
+                f"(gateway admission should have refused the claim)"
+            )
+        row = self.tables[slot]
+        row[:] = self.trash
+        row[:shared_n] = shared_pages
+        row[shared_n:total] = private
         self._requests[slot] = {
             "tokens": tokens,
-            "done": 0,  # prompt tokens already prefilled
+            "done": shared_n * self.page_size,  # prefix pages: prefilled
             "budget": int(request.max_new_tokens),
             "out": [],
+            "keys": keys,
+            "pages": list(shared_pages) + list(private),
+            # nothing to register when every full-prompt block matched
+            "registered": shared_n >= len(keys),
         }
         self.active[slot] = False
         self.pos[slot] = 0
+        self.joins += 1
+        self.peak_slots_busy = max(self.peak_slots_busy,
+                                   len(self._requests))
 
     def release(self, slot: int) -> None:
-        self._requests.pop(slot, None)
+        st = self._requests.pop(slot, None)
+        if st is not None:
+            self.pages.unref(st["pages"])
+            self.tables[slot][:] = self.trash
         self.active[slot] = False
 
     def reset(self) -> None:
-        self._requests.clear()
+        """Drop every request AND flush the prefix store: a reset wipes
+        the cache content the store's pages point at (a healed slice
+        starts clean). Leaves zero pages in use — pinned by test."""
+        for slot in list(self._requests):
+            self.release(slot)
+        if self.prefix is not None:
+            self.prefix.flush()
+        self.tables[:] = self.trash
         self.active[:] = False
         self.pos[:] = 0
+
+    def stats(self) -> dict:
+        """The paged-KV/prefix observability block Gateway.report()
+        and /healthz aggregate."""
+        in_use = self.pages.pages_in_use
+        out = {
+            "page_size": self.page_size,
+            "pages_total": self.num_pages,
+            "pages_in_use": in_use,
+            "pages_free": self.pages.pages_free,
+            "kv_utilization": round(in_use / self.num_pages, 4),
+            "peak_pages_in_use": self.pages.peak_in_use,
+            "peak_slots_busy": self.peak_slots_busy,
+            "joins": self.joins,
+            "prefill_tokens": self.prefill_tokens,
+            "cache_int8": self.cache_int8,
+        }
+        out["prefix"] = (self.prefix.stats() if self.prefix is not None
+                         else None)
+        return out
 
     def step(self) -> StepResult | None:
         """One step boundary: one prefill chunk (round-robin) + one
@@ -152,12 +317,22 @@ class SlotEngine:
             take = min(self.prefill_chunk, remaining)
             chunk = np.zeros((self.prefill_chunk,), np.int32)  # padded
             chunk[:take] = st["tokens"][start:start + take]
-            self.cache, logits = self._prefill_fn(
-                self.params, self.cache, jnp.asarray(chunk),
-                jnp.int32(slot), jnp.int32(start), jnp.int32(take - 1),
+            self.pool, logits = self._prefill_fn(
+                self.params, self.pool, jnp.asarray(chunk),
+                jnp.asarray(self.tables[slot]),
+                jnp.int32(start), jnp.int32(take - 1),
             )
             st["done"] += take
+            self.prefill_tokens += take
             if st["done"] >= st["tokens"].size:
+                if not st["registered"] and self.prefix is not None:
+                    # the full-prompt pages now hold real K/V: make
+                    # them matchable (the store refs what it keeps)
+                    self.prefix.register(
+                        st["keys"],
+                        self.tables[slot][:len(st["keys"])],
+                    )
+                    st["registered"] = True
                 # the final chunk's logits ARE the first generated token
                 first = int(np.argmax(np.asarray(logits)))
                 st["out"].append(first)
@@ -171,9 +346,10 @@ class SlotEngine:
         decoding = sorted(s for s in self._requests if self.active[s])
         if decoding:
             active = self.active.copy()
-            self.cache, next_tokens, new_pos = self._decode_fn(
-                self.params, self.cache, jnp.asarray(self.last),
-                jnp.asarray(self.pos), jnp.asarray(active),
+            self.pool, next_tokens, new_pos = self._decode_fn(
+                self.params, self.pool, jnp.asarray(self.tables),
+                jnp.asarray(self.last), jnp.asarray(self.pos),
+                jnp.asarray(active),
             )
             next_host = np.asarray(next_tokens)
             self.pos = np.array(new_pos)  # writable host copy
@@ -194,15 +370,22 @@ class SlotEngine:
 # --------------------------------------------------- compiled step bodies
 
 
-def _prefill_chunk(model, params, cache, tokens, slot, start, last_row,
-                   chunk):
+def _prefill_chunk_paged(model, params, pool, tokens, table, start,
+                         last_row, chunk, page_size, max_pages, int8):
     """Advance one slot's prompt by one padded chunk of length `chunk`
-    (static): write the chunk's K/V at [start, start+chunk) of the
-    slot's cache rows, attend causally against that slot's cache, and
-    return (cache, logits at the chunk's last REAL row). Arithmetic
-    mirrors models/decode._block_with_cache's decode branch — scores
-    against the (bf16) cache with a static-length mask — generalized to
-    a chunk of queries."""
+    (static): scatter the chunk's K/V into the slot's pages at logical
+    positions [start, start+chunk), gather the slot's logical cache
+    view back through the page table, attend causally, and return
+    (pool, logits at the chunk's last REAL row). Arithmetic mirrors
+    models/decode._block_with_cache — the page indirection changes
+    where K/V lives, never its value.
+
+    The chunk's OWN positions attend fresh full-precision K/V (a
+    dynamic overwrite of the gathered columns): with a bf16 cache this
+    is bit-identical to reading the cache back; with an int8 cache it
+    reproduces dense prefill's "quantization error enters once, on
+    re-read" semantics. Writes past the table's last page (padded tail
+    of a near-max_len prompt) are scatter-dropped / trash-parked."""
     import flax.linen as nn
     import jax
     import jax.numpy as jnp
@@ -211,12 +394,20 @@ def _prefill_chunk(model, params, cache, tokens, slot, start, last_row,
 
     x = dec._embed(params, tokens[None, :], start, model)  # (1, C, E)
     head_dim = model.embed_dim // model.num_heads
-    max_len = next(iter(cache.values()))["k"].shape[1]
+    length = max_pages * page_size  # the logical attend window
     # query i sits at global position start+i; it may attend cache
     # positions <= start+i (its own K/V was just written there)
     q_pos = start + jnp.arange(chunk)  # (C,)
-    valid = jnp.arange(max_len)[None, :] <= q_pos[:, None]  # (C, L)
-    new_cache = dict(cache)
+    valid = jnp.arange(length)[None, :] <= q_pos[:, None]  # (C, L)
+    logical = jnp.arange(length)
+    g_page = table[logical // page_size]  # (L,)
+    g_off = logical % page_size
+    # writes: clamp past-the-end page lookups onto the sentinel row
+    # (trash); scatters with out-of-range offsets drop
+    w_pos = start + jnp.arange(chunk)
+    w_page = table[jnp.minimum(w_pos // page_size, max_pages)]
+    w_off = w_pos % page_size
+    new_pool = dict(pool)
     for i in range(model.num_layers):
         name = f"Block_{i}"
         bp = params[name]
@@ -226,28 +417,53 @@ def _prefill_chunk(model, params, cache, tokens, slot, start, last_row,
         q = q.reshape(1, chunk, model.num_heads, head_dim)
         k = k.reshape(chunk, model.num_heads, head_dim)
         v = v.reshape(chunk, model.num_heads, head_dim)
-        layer = new_cache[name]
-        new_k = jax.lax.dynamic_update_slice(
-            layer["k"], k.astype(jnp.bfloat16)[None], (slot, start, 0, 0)
-        )
-        new_v = jax.lax.dynamic_update_slice(
-            layer["v"], v.astype(jnp.bfloat16)[None], (slot, start, 0, 0)
-        )
-        new_cache[name] = {"k": new_k, "v": new_v}
-        keys = jax.lax.dynamic_index_in_dim(
-            new_k, slot, axis=0, keepdims=True
-        )  # (1, L, H, D)
-        vals = jax.lax.dynamic_index_in_dim(
-            new_v, slot, axis=0, keepdims=True
-        )
-        scores = jnp.einsum(
-            "bqhd,bkhd->bhqk", q, keys.astype(q.dtype)
-        ) / jnp.sqrt(head_dim).astype(q.dtype)
+        layer = new_pool[name]
+        if int8:
+            kq, ks = dec._quant_kv(k[None])
+            vq, vs_ = dec._quant_kv(v[None])
+            new_k = layer["k"].at[w_page, w_off].set(kq[0])
+            new_v = layer["v"].at[w_page, w_off].set(vq[0])
+            k_scale = layer["k_scale"].at[w_page, w_off].set(ks[0])
+            v_scale = layer["v_scale"].at[w_page, w_off].set(vs_[0])
+            new_pool[name] = {"k": new_k, "v": new_v,
+                              "k_scale": k_scale, "v_scale": v_scale}
+            keys = new_k[g_page, g_off]  # (L, H, D) int8
+            vals = new_v[g_page, g_off].astype(model.dtype)
+            ksc = k_scale[g_page, g_off]  # (L, H)
+            vsc = v_scale[g_page, g_off]
+            # own chunk: fresh values, unit scales (dense prefill
+            # attends fresh K/V; the int8 error enters on RE-read)
+            vals = vals.at[w_pos].set(v.astype(model.dtype))
+            vsc = vsc.at[w_pos].set(
+                jnp.ones((chunk, model.num_heads), vsc.dtype))
+            scores = jnp.einsum(
+                "bqhd,bkhd->bhqk", q, keys.astype(q.dtype)[None]
+            ) / jnp.sqrt(head_dim).astype(q.dtype)
+            scores = scores * ksc.T[None, :, None, :].astype(scores.dtype)
+            fresh = jnp.einsum(
+                "bqhd,bkhd->bhqk", q, k[None].astype(q.dtype)
+            ) / jnp.sqrt(head_dim).astype(q.dtype)
+            scores = scores.at[:, :, :, w_pos].set(fresh)
+        else:
+            new_k = layer["k"].at[w_page, w_off].set(k.astype(jnp.bfloat16))
+            new_v = layer["v"].at[w_page, w_off].set(v.astype(jnp.bfloat16))
+            new_pool[name] = {"k": new_k, "v": new_v}
+            keys = new_k[g_page, g_off]  # (L, H, D)
+            vals = new_v[g_page, g_off].astype(model.dtype)
+            vals = vals.at[w_pos].set(v.astype(model.dtype))
+            scores = jnp.einsum(
+                "bqhd,bkhd->bhqk", q, keys.astype(q.dtype)[None]
+            ) / jnp.sqrt(head_dim).astype(q.dtype)
+            fresh = jnp.einsum(
+                "bqhd,bkhd->bhqk", q, k[None].astype(q.dtype)
+            ) / jnp.sqrt(head_dim).astype(q.dtype)
+            scores = scores.at[:, :, :, w_pos].set(fresh)
         scores = jnp.where(valid[None, None], scores, dec.NEG_INF)
         probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+        if int8:
+            probs = probs * vsc.T[None, :, None, :].astype(probs.dtype)
         attn = jnp.einsum(
-            "bhqk,bkhd->bqhd",
-            probs.astype(model.dtype), vals.astype(model.dtype),
+            "bhqk,bkhd->bqhd", probs.astype(model.dtype), vals[None],
         )
         x = x + dec._dense(
             bp["proj"], attn.reshape(1, chunk, model.embed_dim),
@@ -260,42 +476,48 @@ def _prefill_chunk(model, params, cache, tokens, slot, start, last_row,
         x = x + dec._dense(bp["mlp_down"], y, model.embed_dim, model.dtype)
     last = jax.lax.dynamic_slice_in_dim(x, last_row, 1, axis=1)  # (1,1,E)
     logits = dec._head(params, last, model)[0, 0]  # (vocab,)
-    return new_cache, logits
+    return new_pool, logits
 
 
-def _decode_step(model, params, cache, last, pos, active):
+def _decode_step_paged(model, params, pool, tables, last, pos, active,
+                       page_size, max_pages, trash, int8):
     """One greedy decode token for every slot at once, with PER-SLOT
-    positions: slot s embeds its last token at pos[s], writes K/V at
-    pos[s] (vmapped dynamic_update_slice), attends <= pos[s], and
-    advances pos only where active. models/decode._block_with_cache's
-    decode branch with the scalar position generalized to a vector —
-    the whole point of slot-based batching."""
-    import flax.linen as nn
-    import jax
+    positions AND page tables: slot s embeds its last token at pos[s],
+    scatters K/V into page tables[s, pos[s] // page_size], gathers its
+    logical cache view, attends <= pos[s], and advances pos only where
+    active. models/decode._block_with_cache's decode branch with the
+    scalar position generalized to a vector and the dense row replaced
+    by the page indirection — the whole point of paged slot batching.
+
+    Inactive rows (empty slot, or a slot still mid-prefill) must not
+    write anywhere real — a decode step racing a neighbour's prefill
+    would clobber prompt K/V, and a stale position could land on a
+    SHARED prefix page. They park on the pool's trash page, which
+    nothing ever attends."""
+    import flax.linen as nn  # noqa: F401 - gelu below
+    import jax  # noqa: F401 - kept for parity with the prefill body
     import jax.numpy as jnp
 
     from tritonk8ssupervisor_tpu.models import decode as dec
 
     slots = last.shape[0]
     head_dim = model.embed_dim // model.num_heads
-    max_len = next(iter(cache.values()))["k"].shape[1]
+    length = max_pages * page_size
     emb = params["tok_embed"]["embedding"]
     x = jnp.take(emb, last, axis=0)[:, None, :].astype(model.dtype)
     x = x + jnp.take(params["pos_embed"], pos, axis=0)[:, None, :].astype(
         model.dtype
     )
-    valid = jnp.arange(max_len)[None, :] <= pos[:, None]  # (S, L)
-    # Inactive rows (empty slot, or a slot still mid-prefill) must not
-    # write at their stale pos — a decode step racing a neighbour's
-    # prefill would clobber the prompt K/V that prefill just wrote.
-    # Park their write at max_len (clamped to the last position), which
-    # is overwritten-before-attended by construction: position p is
-    # only ever attended by the decode step that first writes it.
-    write_pos = jnp.where(active, pos, max_len)
-    row_update = jax.vmap(
-        lambda c, u, p: jax.lax.dynamic_update_slice(c, u, (p, 0, 0))
-    )
-    new_cache = dict(cache)
+    valid = jnp.arange(length)[None, :] <= pos[:, None]  # (S, L)
+    logical = jnp.arange(length)
+    g_page = tables[:, logical // page_size]  # (S, L)
+    g_off = logical % page_size  # (L,) broadcast against g_page
+    own = jnp.take_along_axis(
+        tables, jnp.minimum(pos // page_size, max_pages)[:, None], axis=1
+    )[:, 0]
+    w_page = jnp.where(active, own, trash)
+    w_off = jnp.where(active, pos % page_size, 0)
+    new_pool = dict(pool)
     for i in range(model.num_layers):
         name = f"Block_{i}"
         bp = params[name]
@@ -303,21 +525,57 @@ def _decode_step(model, params, cache, last, pos, active):
         qkv = dec._dense(bp["qkv"], y, 3 * model.embed_dim, model.dtype)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         q = q.reshape(slots, 1, model.num_heads, head_dim)
-        k = k.reshape(slots, 1, model.num_heads, head_dim)
-        v = v.reshape(slots, 1, model.num_heads, head_dim)
-        layer = new_cache[name]
-        new_k = row_update(layer["k"], k.astype(jnp.bfloat16), write_pos)
-        new_v = row_update(layer["v"], v.astype(jnp.bfloat16), write_pos)
-        new_cache[name] = {"k": new_k, "v": new_v}
-        scores = jnp.einsum(
-            "bqhd,bkhd->bhqk", q, new_k.astype(q.dtype)
-        ) / jnp.sqrt(head_dim).astype(q.dtype)
-        scores = jnp.where(valid[:, None, None, :], scores, dec.NEG_INF)
-        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
-        attn = jnp.einsum(
-            "bhqk,bkhd->bqhd",
-            probs.astype(model.dtype), new_v.astype(model.dtype),
-        )
+        k = k.reshape(slots, model.num_heads, head_dim)
+        v = v.reshape(slots, model.num_heads, head_dim)
+        layer = new_pool[name]
+        if int8:
+            kq, ks = dec._quant_kv(k[:, None])  # (S,1,H,D),(S,1,H)
+            vq, vs_ = dec._quant_kv(v[:, None])
+            new_k = layer["k"].at[w_page, w_off].set(kq[:, 0])
+            new_v = layer["v"].at[w_page, w_off].set(vq[:, 0])
+            k_scale = layer["k_scale"].at[w_page, w_off].set(ks[:, 0])
+            v_scale = layer["v_scale"].at[w_page, w_off].set(vs_[:, 0])
+            new_pool[name] = {"k": new_k, "v": new_v,
+                              "k_scale": k_scale, "v_scale": v_scale}
+            keys = new_k[g_page, g_off]  # (S, L, H, D)
+            vals = new_v[g_page, g_off]
+            ksc = k_scale[g_page, g_off]  # (S, L, H)
+            vsc = v_scale[g_page, g_off]
+            scores = jnp.einsum(
+                "bqhd,bkhd->bhqk", q, keys.astype(q.dtype)
+            ) / jnp.sqrt(head_dim).astype(q.dtype)
+            # per-(token, head) K scale applied on the SCORE (the
+            # contraction output): (S, L, H) -> (S, H, 1, L)
+            scores = scores * ksc.transpose(0, 2, 1)[
+                :, :, None, :].astype(scores.dtype)
+            scores = jnp.where(valid[:, None, None, :], scores,
+                               dec.NEG_INF)
+            probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+            # fold the V scale into probs before the value contraction
+            probs = probs * vsc.transpose(0, 2, 1)[
+                :, :, None, :].astype(probs.dtype)
+            attn = jnp.einsum(
+                "bhqk,bkhd->bqhd",
+                probs.astype(model.dtype), vals.astype(model.dtype),
+            )
+        else:
+            new_k = layer["k"].at[w_page, w_off].set(
+                k.astype(jnp.bfloat16))
+            new_v = layer["v"].at[w_page, w_off].set(
+                v.astype(jnp.bfloat16))
+            new_pool[name] = {"k": new_k, "v": new_v}
+            keys = new_k[g_page, g_off]  # (S, L, H, D)
+            vals = new_v[g_page, g_off]
+            scores = jnp.einsum(
+                "bqhd,bkhd->bhqk", q, keys.astype(q.dtype)
+            ) / jnp.sqrt(head_dim).astype(q.dtype)
+            scores = jnp.where(valid[:, None, None, :], scores,
+                               dec.NEG_INF)
+            probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+            attn = jnp.einsum(
+                "bhqk,bkhd->bqhd",
+                probs.astype(model.dtype), vals.astype(model.dtype),
+            )
         x = x + dec._dense(
             bp["proj"], attn.reshape(slots, 1, model.embed_dim),
             model.embed_dim, model.dtype,
@@ -330,4 +588,4 @@ def _decode_step(model, params, cache, last, pos, active):
     logits = dec._head(params, x, model)[:, 0]  # (S, vocab)
     next_tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     new_pos = pos + active.astype(jnp.int32)
-    return new_cache, next_tokens, new_pos
+    return new_pool, next_tokens, new_pos

@@ -83,6 +83,7 @@ from tritonk8ssupervisor_tpu.provision.fleetview import (
     FleetView,
     HealthSource,
 )
+from tritonk8ssupervisor_tpu.serving import kvpool
 from tritonk8ssupervisor_tpu.serving import reqlog as reqlog_mod
 
 # Admission verdicts. `unservable` is 400-class (retrying cannot help);
@@ -118,6 +119,14 @@ class Request:
     arrival: float = 0.0
     tokens: Any = None  # np.ndarray[int] on the real path
     bucket: int = 0
+    # shared-system-prompt shape (serving/traffic.py): the first
+    # `prefix_len` prompt tokens are the content identified by
+    # `prefix_id`, shared with every other request carrying it. The
+    # REAL engine ignores these (it hashes token content); the modeled
+    # engine's prefix cache keys on them because sim requests carry
+    # sizes, not tokens.
+    prefix_len: int = 0
+    prefix_id: Any = None
     # the request-plane resilience contract (docs/failure-modes.md,
     # "Request lifecycle & exactly-once semantics")
     key: str | None = None  # client-supplied idempotency key
@@ -206,6 +215,15 @@ class GatewayPolicy:
     # supervised fleet keeps False and sheds `no-fleet-view` instead of
     # routing blind on cold start)
     allow_no_view: bool = False
+    # paged-KV sizing (docs/performance.md "Engine hot path"): tokens
+    # per KV page, and the per-slice page budget. None = memory-equal
+    # to the pre-paging dense cache (slots * ceil(max_seq_len /
+    # page_size)) — paging then raises effective concurrency instead
+    # of spending more HBM
+    page_size: int = 16
+    pages_per_slice: int | None = None
+    # cross-request prefix/KV reuse (the shared-system-prompt lever)
+    prefix_cache: bool = True
 
 
 @dataclasses.dataclass
@@ -241,33 +259,141 @@ class ModeledEngine:
     """The virtual-clock twin of serving/engine.SlotEngine: identical
     join/step/release/reset surface and scheduling (one prefill chunk
     rides along each decode step), with the cost model supplying dt
-    instead of real compute. What the open-loop bench drives."""
+    instead of real compute, and the SAME paged-KV/prefix bookkeeping
+    (serving/kvpool.py) driving capacity and prefill skipping. What
+    the open-loop bench drives.
+
+    Sim requests carry sizes, not tokens, so prefix blocks key on the
+    traffic model's `(prefix_id, block_index)` identity instead of a
+    content hash — same chain semantics, same match-cap-at-len-1 rule.
+    `num_pages=None` keeps capacity unbounded (pages are accounted but
+    never bind) — the pre-paging sims' exact behavior."""
 
     def __init__(self, slots: int, prefill_chunk: int,
-                 cost: DecodeCostModel | None = None) -> None:
+                 cost: DecodeCostModel | None = None,
+                 page_size: int = 16,
+                 num_pages: int | None = None,
+                 prefix_cache: bool = True) -> None:
         self.slots = int(slots)
         self.prefill_chunk = max(1, int(prefill_chunk))
         self.cost = cost or DecodeCostModel()
+        self.page_size = max(1, int(page_size))
+        self.num_pages = None if num_pages is None else int(num_pages)
+        self.pages = kvpool.PagePool(self.num_pages, self.page_size)
+        self.prefix = (kvpool.PrefixStore(self.pages)
+                       if prefix_cache else None)
         self._slots: dict = {}  # slot -> {prefill_left, budget, generated}
         self._prefill_rr = 0  # round-robin pointer over prefilling slots
+        self.joins = 0
+        self.prefill_tokens = 0  # prompt tokens actually prefilled
+        self.peak_slots_busy = 0
 
     def busy_slots(self) -> int:
         return len(self._slots)
 
+    def _block_keys(self, request: Request) -> list:
+        """Identity keys for the request's full prompt pages: blocks
+        inside the shared prefix key on (prefix_id, j) — matchable
+        across requests — the rest on (rid, j), unique by
+        construction."""
+        ps = self.page_size
+        shared_len = (int(request.prefix_len or 0)
+                      if request.prefix_id is not None else 0)
+        return [
+            ("p", request.prefix_id, j)
+            if (j + 1) * ps <= shared_len else ("u", request.rid, j)
+            for j in range(kvpool.full_blocks(request.prompt_len, ps))
+        ]
+
+    def _span_pages(self, prompt_len: int, max_new: int,
+                    shared_blocks: int) -> int:
+        start0 = shared_blocks * self.page_size
+        suffix = max(1, prompt_len - start0)
+        prefill_end = start0 + -(-suffix // self.prefill_chunk) \
+            * self.prefill_chunk
+        span = max(prefill_end, prompt_len + max_new)
+        return -(-span // self.page_size)
+
+    def _alloc(self, need: int) -> list | None:
+        got = self.pages.alloc(need)
+        if got is None and self.prefix is not None:
+            self.prefix.evict_for(need - self.pages.pages_free)
+            got = self.pages.alloc(need)
+        return got
+
+    def can_join(self, request: Request) -> bool:
+        shared = (self.prefix.peek(self._block_keys(request)[
+            :kvpool.match_cap_blocks(request.prompt_len, self.page_size)])
+            if self.prefix is not None else 0)
+        need = self._span_pages(int(request.prompt_len),
+                                int(request.max_new_tokens),
+                                shared) - shared
+        budget = self.pages.pages_free
+        if self.prefix is not None:
+            budget += self.prefix.evictable_pages()
+        return need <= budget
+
     def join(self, slot: int, request: Request) -> None:
         if slot in self._slots:
             raise ValueError(f"slot {slot} already occupied")
+        keys = self._block_keys(request)
+        shared_n, shared_pages = 0, []
+        if self.prefix is not None:
+            cap = kvpool.match_cap_blocks(request.prompt_len,
+                                          self.page_size)
+            shared_n, shared_pages = self.prefix.match(keys[:cap])
+        total = self._span_pages(int(request.prompt_len),
+                                 int(request.max_new_tokens), shared_n)
+        self.pages.ref(shared_pages)
+        private = self._alloc(total - shared_n)
+        if private is None:
+            self.pages.unref(shared_pages)
+            raise RuntimeError(
+                f"page pool exhausted: need {total - shared_n} pages, "
+                f"{self.pages.pages_free} free (claim should have "
+                f"checked can_join)"
+            )
         self._slots[slot] = {
-            "prefill_left": int(request.prompt_len),
+            "prefill_left": int(request.prompt_len)
+            - shared_n * self.page_size,
             "budget": int(request.max_new_tokens),
             "generated": 0,
+            "keys": keys,
+            "pages": list(shared_pages) + list(private),
+            "registered": shared_n >= len(keys),
         }
+        self.joins += 1
+        self.peak_slots_busy = max(self.peak_slots_busy, len(self._slots))
 
     def release(self, slot: int) -> None:
-        self._slots.pop(slot, None)
+        st = self._slots.pop(slot, None)
+        if st is not None:
+            self.pages.unref(st["pages"])
 
     def reset(self) -> None:
-        self._slots.clear()
+        for slot in list(self._slots):
+            self.release(slot)
+        if self.prefix is not None:
+            self.prefix.flush()
+
+    def stats(self) -> dict:
+        in_use = self.pages.pages_in_use
+        return {
+            "page_size": self.page_size,
+            "pages_total": self.num_pages,
+            "pages_in_use": in_use,
+            "pages_free": (self.pages.pages_free
+                           if self.num_pages is not None else None),
+            "kv_utilization": (round(in_use / self.num_pages, 4)
+                               if self.num_pages else None),
+            "peak_pages_in_use": self.pages.peak_in_use,
+            "peak_slots_busy": self.peak_slots_busy,
+            "joins": self.joins,
+            "prefill_tokens": self.prefill_tokens,
+            "cache_int8": False,
+            "prefix": (self.prefix.stats() if self.prefix is not None
+                       else None),
+        }
 
     def step(self) -> StepResult | None:
         if not self._slots:
@@ -286,12 +412,19 @@ class ModeledEngine:
             slot = prefilling[self._prefill_rr % len(prefilling)]
             self._prefill_rr += 1
             st = self._slots[slot]
+            self.prefill_tokens += min(self.prefill_chunk,
+                                       st["prefill_left"])
             st["prefill_left"] = max(0, st["prefill_left"]
                                      - self.prefill_chunk)
             # the compiled chunk is the PADDED shape: full chunk cost
             dt += (self.cost.prefill_fixed_s
                    + self.prefill_chunk * self.cost.prefill_per_token_s)
             if st["prefill_left"] == 0:
+                if not st["registered"] and self.prefix is not None:
+                    self.prefix.register(
+                        st["keys"], st["pages"][:len(st["keys"])]
+                    )
+                    st["registered"] = True
                 # the prefill's final logits ARE the first token
                 st["generated"] = 1
                 emitted[slot] = 1
@@ -394,10 +527,15 @@ class SliceWorker:
         self.gateway.poll(now)
         mode = self.gateway.slice_mode(self.index)
         if mode == SERVE:
+            # admission to a slot is accounted in PAGES, not slots: a
+            # paged engine with free slots but no free pages must not
+            # claim work it cannot cache (the queue's head waits —
+            # head-of-line beats starving it behind smaller requests)
+            fits = getattr(self.engine, "can_join", None)
             for slot in range(self.engine.slots):
                 if slot in self.inflight:
                     continue
-                claimed = self.gateway.claim(self.index, now)
+                claimed = self.gateway.claim(self.index, now, fits=fits)
                 if claimed is None:
                     break
                 claimed.slice_index = self.index
@@ -745,13 +883,19 @@ class Gateway:
 
     # ------------------------------------------------------------- dispatch
 
-    def claim(self, slice_index: int, now: float) -> Request | None:
+    def claim(self, slice_index: int, now: float,
+              fits: Callable | None = None) -> Request | None:
         """One request for a free slot on `slice_index`, oldest-first
         across buckets (bucketing batches compiled shapes, it must not
         starve a sparse bucket), or None when every bucket is empty or
         the slice may not take new work. Requests whose deadline has
         already passed are skipped-and-expired here instead of burning
-        slot capacity on callers that gave up."""
+        slot capacity on callers that gave up. `fits` is the engine's
+        page-capacity probe (can_join): when the OLDEST request cannot
+        be cached right now, claim returns None and the request keeps
+        its place — head-of-line blocking is the honest policy
+        (skipping ahead would starve big prompts behind an endless
+        stream of small ones)."""
         if self.slice_mode(slice_index) != SERVE:
             return None
         while True:
@@ -761,11 +905,15 @@ class Gateway:
                     best = q
             if best is None:
                 return None
-            req = best.popleft()
+            req = best[0]
             deadline = self.deadline_at(req)
             if deadline is not None and now >= deadline:
+                best.popleft()
                 self.expire(req, "queue", now)
                 continue
+            if fits is not None and not fits(req):
+                return None
+            best.popleft()
             req.dispatched_at = now
             view = self.view
             self._journal(
@@ -1044,6 +1192,48 @@ class Gateway:
 
     # -------------------------------------------------------------- reports
 
+    def engine_report(self) -> dict | None:
+        """Aggregate the workers' paged-KV/prefix stats — why
+        throughput moved, for `report()` and `/healthz`: pages in use
+        vs total, KV-memory utilization, prefix hit/miss/eviction
+        counters and the prefill tokens the cache skipped."""
+        per_slice = {
+            index: worker.engine.stats()
+            for index, worker in sorted(self.workers.items())
+            if hasattr(worker.engine, "stats")
+        }
+        if not per_slice:
+            return None
+        stats = list(per_slice.values())
+        bounded = [s["pages_total"] for s in stats
+                   if s["pages_total"] is not None]
+        pages_total = sum(bounded) if len(bounded) == len(stats) else None
+        pages_in_use = sum(s["pages_in_use"] for s in stats)
+        prefix_stats = [s["prefix"] for s in stats
+                        if s["prefix"] is not None]
+        prefix = None
+        if prefix_stats:
+            prefix = {
+                key: sum(p[key] for p in prefix_stats)
+                for key in ("entries", "hits", "misses", "block_hits",
+                            "hit_tokens", "evictions")
+            }
+            asked = prefix["hits"] + prefix["misses"]
+            prefix["hit_rate"] = (round(prefix["hits"] / asked, 4)
+                                  if asked else None)
+        return {
+            "pages_in_use": pages_in_use,
+            "pages_total": pages_total,
+            "kv_utilization": (round(pages_in_use / pages_total, 4)
+                               if pages_total else None),
+            "peak_pages_in_use": sum(s["peak_pages_in_use"]
+                                     for s in stats),
+            "peak_slots_busy": max(s["peak_slots_busy"] for s in stats),
+            "prefill_tokens": sum(s["prefill_tokens"] for s in stats),
+            "prefix": prefix,
+            "per_slice": per_slice,
+        }
+
     def report(self) -> dict:
         """The machine-readable serving summary (the drill/bench
         document's core)."""
@@ -1078,4 +1268,7 @@ class Gateway:
                     REJECT_NO_FLEET_VIEW, 0),
                 "engine_failures": len(m.engine_failures),
             },
+            # the paged-KV/prefix observability block (why did
+            # throughput move): docs/performance.md "Engine hot path"
+            "engine": self.engine_report(),
         }

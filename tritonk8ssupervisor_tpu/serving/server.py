@@ -140,6 +140,13 @@ def make_handler(gateway: Gateway, lock: threading.Lock,
                 gateway.poll(time.monotonic(), force=True)
                 shedding = gateway.shed_reason()
                 crashed = loop.crashed if loop is not None else None
+                engine = gateway.engine_report()
+                if engine is not None:
+                    # the bounded aggregate (pages, KV utilization,
+                    # prefix hit/miss/eviction) — per-slice detail
+                    # stays in report()/the drill JSON
+                    engine = {k: v for k, v in engine.items()
+                              if k != "per_slice"}
                 doc = {
                     "shedding": shedding,
                     "eligible_slices": gateway.eligible_slices(),
@@ -147,6 +154,7 @@ def make_handler(gateway: Gateway, lock: threading.Lock,
                     "engine_crashed": (repr(crashed)
                                        if crashed is not None else None),
                     "serving": gateway.report()["serving"],
+                    "engine": engine,
                 }
             self._reply(503 if shedding or crashed else 200, doc)
 

@@ -49,6 +49,15 @@ class TrafficModel:
     # and an idempotency key (serving/reqlog.py) when these are set
     deadline_s: float | None = None
     key_prefix: str | None = None
+    # shared-system-prompt shape — the realistic millions-of-users
+    # traffic the prefix cache targets: a `shared_prefix_share`
+    # fraction of arrivals open with the SAME `shared_prefix_len`-token
+    # system prompt (identified by prefix_id = "sys-<seed>"; distinct
+    # seeds are distinct prompts) followed by a unique suffix. The
+    # engines' prefix stores should re-prefill ~0 of the shared prefix
+    # after the first request warms it.
+    shared_prefix_len: int = 0
+    shared_prefix_share: float = 0.0
 
     def rate(self, t: float) -> float:
         rate = self.base_rps * (
@@ -89,11 +98,23 @@ def generate_arrivals(model: TrafficModel, duration_s: float,
                              weights=model.prompt_weights)[0]
         new = rng.choices(model.new_tokens_choices,
                           weights=model.new_tokens_weights)[0]
+        # the share draw only happens when the shape is ON, so legacy
+        # scenarios keep their exact seeded streams; prefix-cache A/B
+        # drives hold the TRAFFIC fixed (same share > 0) and flip the
+        # ENGINE's prefix_cache instead — same arrivals, same tags,
+        # only the cache differs
+        shared = (model.shared_prefix_share > 0
+                  and model.shared_prefix_len > 0
+                  and rng.random() < model.shared_prefix_share)
+        prefix_len = (min(int(model.shared_prefix_len), int(prompt) - 1)
+                      if shared else 0)
         out.append(Request(
             rid=rid, prompt_len=int(prompt), max_new_tokens=int(new),
             arrival=t, deadline_s=model.deadline_s,
             key=(f"{model.key_prefix}-{rid}"
                  if model.key_prefix is not None else None),
+            prefix_len=prefix_len,
+            prefix_id=(f"sys-{model.seed}" if prefix_len > 0 else None),
         ))
         rid += 1
     return out
