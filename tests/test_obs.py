@@ -1,0 +1,613 @@
+"""Unified telemetry plane (obs/): registry, spans, analyzers, CLI.
+
+Four layers under test:
+
+- the metrics registry (obs/metrics.py): exposition-format correctness
+  (label escaping, histogram bucket edges, cumulative counts),
+  concurrent increments under threads, atomic JSON snapshots;
+- the span log (obs/trace.py): the EventLedger durability discipline
+  inherited — torn-final-line truncation on restart, buffered-mode
+  visibility through replay();
+- the analyzers (obs/analyze.py): one request's timeline joined from
+  span log + request journal across gateway incarnations, and latency
+  spikes attributed to overlapping fleet events;
+- the wiring: metrics-vs-ledger consistency (the chaos checker's new
+  invariant class), the `./setup.sh trace <key>` acceptance over a
+  REAL gateway-SIGKILL drill workdir, the supervisor's telemetry block
+  in `status --json`, and the <5% instrumentation-overhead smoke.
+"""
+
+import json
+import threading
+from pathlib import Path
+
+import pytest
+
+from tritonk8ssupervisor_tpu.obs import Telemetry, analyze, metrics, trace
+
+
+# ------------------------------------------------------------- registry
+
+
+def test_counter_labels_and_totals():
+    reg = metrics.MetricsRegistry(clock=lambda: 7.0)
+    c = reg.counter("requests_total", "requests")
+    c.inc()
+    c.inc(2, reason="overload")
+    c.inc(3, reason="breaker-open")
+    assert c.value() == 1
+    assert c.value(reason="overload") == 2
+    assert c.total() == 6
+    assert c.per_label("reason") == {"overload": 2.0, "breaker-open": 3.0}
+    with pytest.raises(ValueError):
+        c.inc(-1)
+
+
+def test_registry_get_or_create_is_idempotent_and_kind_checked():
+    reg = metrics.MetricsRegistry()
+    assert reg.counter("x", "h") is reg.counter("x")
+    with pytest.raises(ValueError):
+        reg.gauge("x")
+
+
+def test_exposition_format_and_label_escaping():
+    reg = metrics.MetricsRegistry()
+    c = reg.counter("weird_total", "counts weird things")
+    c.inc(2, path='a"b\\c\nd')
+    g = reg.gauge("depth", "queue depth")
+    g.set(4)
+    text = reg.render()
+    assert "# HELP weird_total counts weird things" in text
+    assert "# TYPE weird_total counter" in text
+    assert "# TYPE depth gauge" in text
+    # backslash, quote, and newline all escaped per the text format
+    assert 'weird_total{path="a\\"b\\\\c\\nd"} 2' in text
+    assert "depth 4" in text.splitlines()
+    # deterministic: metric names sorted, so scrapes diff cleanly
+    assert text.index("# TYPE depth") < text.index("# TYPE weird_total")
+
+
+def test_histogram_bucket_edges_are_inclusive_and_cumulative():
+    reg = metrics.MetricsRegistry()
+    h = reg.histogram("lat", "latency", buckets=(0.1, 1.0, 10.0))
+    h.observe(0.1)   # exactly ON an edge: that bucket (le semantics)
+    h.observe(0.100001)  # just past: next bucket
+    h.observe(5.0)
+    h.observe(100.0)  # overflow -> +Inf only
+    snap = h.snapshot_value()
+    assert snap["buckets"] == [(0.1, 1), (1.0, 1), (10.0, 1)]
+    assert snap["overflow"] == 1
+    assert snap["count"] == 4
+    text = reg.render()
+    # cumulative exposition: each le includes everything below it
+    assert 'lat_bucket{le="0.1"} 1' in text
+    assert 'lat_bucket{le="1"} 2' in text
+    assert 'lat_bucket{le="10"} 3' in text
+    assert 'lat_bucket{le="+Inf"} 4' in text
+    assert "lat_count 4" in text
+    assert h.sum() == pytest.approx(105.200001)
+
+
+def test_log_buckets_grow_geometrically():
+    edges = metrics.log_buckets(0.001, 2.0, 5)
+    assert edges == (0.001, 0.002, 0.004, 0.008, 0.016)
+    with pytest.raises(ValueError):
+        metrics.log_buckets(0.0, 2.0, 5)
+
+
+def test_concurrent_increments_are_exact():
+    """8 threads x 5000 increments each across counter, labeled
+    counter, and histogram: the registry lock must make every update
+    land — a lost increment here is a lost request in production."""
+    reg = metrics.MetricsRegistry()
+    c = reg.counter("hits_total")
+    h = reg.histogram("obs", buckets=(1.0, 10.0))
+
+    def worker(tid):
+        for i in range(5000):
+            c.inc()
+            c.inc(1, shard=str(tid % 2))
+            h.observe(i % 12)
+
+    threads = [threading.Thread(target=worker, args=(t,))
+               for t in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value() == 40000
+    assert c.total() == 80000
+    assert h.count() == 40000
+
+
+def test_snapshot_roundtrip_and_atomic_write(tmp_path):
+    clock = [100.0]
+    reg = metrics.MetricsRegistry(clock=lambda: clock[0])
+    reg.counter("a_total").inc(3, kind="x")
+    reg.gauge("b").set(1.5)
+    reg.histogram("c", buckets=(1.0,)).observe(0.5)
+    path = tmp_path / "metrics.json"
+    doc = reg.write_snapshot(path)
+    on_disk = json.loads(path.read_text())
+    assert on_disk == doc
+    assert on_disk["ts"] == 100.0
+    assert metrics.counter_total(on_disk, "a_total") == 3
+    assert metrics.counter_by_label(on_disk, "a_total", "kind") == {"x": 3}
+    assert metrics.gauge_value(on_disk, "b") == 1.5
+    assert metrics.counter_total(on_disk, "missing") == 0.0
+    assert metrics.gauge_value(on_disk, "missing") is None
+    # no temp residue from the atomic write
+    assert list(tmp_path.glob(".*tmp")) == []
+
+
+# -------------------------------------------------------------- span log
+
+
+def test_span_log_torn_final_line_truncated_on_restart(tmp_path):
+    """The EventLedger discipline, inherited: a torn final line (the
+    write a SIGKILL interrupted) is physically truncated on replay and
+    the restarted writer appends cleanly after it."""
+    path = tmp_path / "spans.jsonl"
+    log = trace.SpanLog(path, clock=lambda: 1.0,
+                        echo=lambda line: None)
+    tracer = trace.Tracer(log, clock=lambda: 1.0)
+    tracer.emit("tick", 0.0, 1.0)
+    tracer.emit("heal", 1.0, 2.0, slices=[2])
+    del log, tracer
+    with path.open("a") as f:
+        f.write('{"v": 1, "kind": "span", "span": "tor')  # torn write
+    restarted = trace.SpanLog(path, clock=lambda: 5.0,
+                              echo=lambda line: None)
+    spans = restarted.spans()
+    assert [s["span"] for s in spans] == ["tick", "heal"]
+    trace.Tracer(restarted, clock=lambda: 5.0).emit("tick", 5.0, 6.0)
+    assert len(restarted.spans()) == 3
+    # the torn bytes are GONE from disk, not just skipped
+    assert "tor" not in path.read_text()
+
+
+def test_buffered_span_log_visible_through_replay(tmp_path):
+    """fsync=False spans are buffered for hot-path cheapness; replay()
+    flushes the live writer first, so a mid-run read (the kill drill's
+    fold, the analyzers) still sees every span."""
+    log = trace.SpanLog(tmp_path / "s.jsonl", clock=lambda: 1.0,
+                        echo=lambda line: None, fsync=False)
+    tracer = trace.Tracer(log)
+    for i in range(5):
+        tracer.event("admission", float(i), key=f"k{i}")
+    assert len(log.spans()) == 5
+
+
+def test_disabled_tracer_writes_nothing(tmp_path):
+    tracer = trace.Tracer(None, clock=lambda: 1.0)
+    tracer.emit("x", 0.0, 1.0)
+    tracer.event("y", 2.0)
+    tracer.emit_many([("z", 0.0, 1.0, None, {})])
+    with tracer.span("w"):
+        pass
+    assert not tracer.enabled
+    assert list(tmp_path.iterdir()) == []
+
+
+def test_span_context_manager_times_body(tmp_path):
+    clock = [10.0]
+    log = trace.SpanLog(tmp_path / "s.jsonl", clock=lambda: clock[0],
+                        echo=lambda line: None, fsync=False)
+    tracer = trace.Tracer(log, plane=trace.SUPERVISOR,
+                          clock=lambda: clock[0])
+    with tracer.span("tick", tick=3):
+        clock[0] = 12.5
+    (span,) = log.spans()
+    assert span["span"] == "tick" and span["plane"] == "supervisor"
+    assert span["start"] == 10.0 and span["end"] == 12.5
+    assert span["tick"] == 3
+
+
+# ------------------------------------------------------------- analyzers
+
+
+def _span(name, start, end, key=None, plane="serving", inc=1, **attrs):
+    return {"kind": "span", "ts": end, "span": name, "plane": plane,
+            "start": start, "end": end, "key": key,
+            "incarnation": inc, **attrs}
+
+
+def test_request_timeline_joins_journal_and_spans_across_incarnations():
+    req_records = [
+        {"kind": "accepted", "ts": 1.0, "key": "k", "prompt_len": 8,
+         "max_new_tokens": 4, "deadline_s": 60.0},
+        {"kind": "dispatched", "ts": 2.0, "key": "k", "slice": 1,
+         "queued_s": 1.0},
+        {"kind": "requeued", "ts": 5.0, "key": "k",
+         "cause": "gateway-restart", "retries": 1},
+        {"kind": "dispatched", "ts": 6.0, "key": "k", "slice": 0,
+         "queued_s": 5.0},
+        {"kind": "completed", "ts": 9.0, "key": "k", "latency_s": 8.0},
+        {"kind": "accepted", "ts": 1.5, "key": "other"},
+    ]
+    spans = [
+        _span("admission", 1.0, 1.0, key="k", inc=1),
+        _span("queue-wait", 1.0, 6.0, key="k", inc=2),
+        _span("prefill", 6.0, 7.0, key="k", inc=2),
+        _span("decode", 7.0, 9.0, key="k", inc=2),
+        _span("complete", 9.0, 9.0, key="k", inc=2, latency_s=8.0),
+        _span("tick", 0.0, 1.0, plane="supervisor"),  # no key: ignored
+    ]
+    timeline = analyze.request_timeline("k", spans, req_records)
+    assert timeline["complete"] is True
+    assert timeline["accepts"] == 1 and timeline["terminals"] == 1
+    assert timeline["incarnations"] == [1, 2]  # both gateway lives
+    assert timeline["state"] == "completed"
+    assert timeline["phases"] == {"queue-wait": 5.0, "prefill": 1.0,
+                                  "decode": 2.0}
+    times = [e["t"] for e in timeline["entries"]]
+    assert times == sorted(times)
+    assert all("other" not in json.dumps(e) for e in timeline["entries"])
+    rendered = "\n".join(analyze.render_timeline(timeline))
+    assert "COMPLETE" in rendered and "incarnations): 1, 2" in rendered
+
+
+def test_request_timeline_flags_terminal_gap():
+    req_records = [{"kind": "accepted", "ts": 1.0, "key": "k"}]
+    timeline = analyze.request_timeline("k", [], req_records)
+    assert timeline["complete"] is False
+    assert timeline["accepts"] == 1 and timeline["terminals"] == 0
+    missing = analyze.request_timeline("nope", [], req_records)
+    assert missing["found"] is False and missing["complete"] is False
+
+
+def test_fleet_intervals_rebuild_heals_breakers_and_orphans():
+    ledger = [
+        {"kind": "heal-start", "ts": 100.0, "id": "h1", "slices": [2]},
+        {"kind": "heal-done", "ts": 220.0, "id": "h1", "slices": [2]},
+        {"kind": "breaker-open", "ts": 300.0},
+        {"kind": "breaker-close", "ts": 400.0},
+        {"kind": "heal-start", "ts": 500.0, "id": "h2", "slices": [3],
+         "canary": True},  # never closed: kill orphan -> open interval
+    ]
+    intervals = analyze.fleet_intervals(ledger)
+    by_kind = {iv["kind"]: iv for iv in intervals}
+    assert by_kind["heal"]["slices"] == [2] or len(intervals) == 3
+    heal = [iv for iv in intervals if iv["kind"] == "heal"
+            and iv.get("id") == "h1"][0]
+    assert (heal["start"], heal["end"], heal["ok"]) == (100.0, 220.0, True)
+    hold = [iv for iv in intervals if iv["kind"] == "breaker-hold"][0]
+    assert (hold["start"], hold["end"]) == (300.0, 400.0)
+    orphan = [iv for iv in intervals if iv.get("orphaned")][0]
+    assert orphan["end"] == float("inf") and orphan["canary"] is True
+
+
+def test_correlate_attributes_spike_to_overlapping_heal():
+    """The tentpole's acceptance sentence, as a unit: a p99 window
+    overlapping a heal interval names that heal (and its slices) as
+    the candidate cause; quiet windows attribute nothing."""
+    spans = []
+    # baseline: steady 1s completions for 5 minutes
+    for i in range(120):
+        t = 2.5 * i
+        spans.append(_span("complete", t, t, key=f"b{i}", latency_s=1.0))
+    # spike: 20s latencies landing inside t=300..360
+    for i in range(10):
+        t = 305.0 + 5 * i
+        spans.append(_span("complete", t, t, key=f"s{i}", latency_s=20.0))
+    ledger = [
+        {"kind": "heal-start", "ts": 290.0, "id": "h7", "slices": [2]},
+        {"kind": "heal-done", "ts": 410.0, "id": "h7", "slices": [2]},
+    ]
+    out = analyze.correlate(spans, ledger, window_s=60.0)
+    assert out["completions"] == 130
+    assert out["spikes"], "the 20s window must register as a spike"
+    assert any("heal 'h7' for slice(s) 2" in line
+               for line in out["attributions"])
+    # no-spike input: clean verdict, not an error
+    quiet = analyze.correlate(spans[:120], [], window_s=60.0)
+    assert quiet["spikes"] == [] and quiet["attributions"] == []
+    empty = analyze.correlate([], [], req_records=[])
+    assert empty["completions"] == 0 and empty["overall_p50_s"] is None
+
+
+def test_correlate_reads_journal_when_spans_absent():
+    req = [{"kind": "completed", "ts": 10.0 + i, "key": f"k{i}",
+            "latency_s": 1.0} for i in range(20)]
+    out = analyze.correlate([], [], req_records=req, window_s=10.0)
+    assert out["completions"] == 20
+    assert out["overall_p50_s"] == 1.0
+
+
+# ------------------------------------------ metrics-vs-ledger invariants
+
+
+def _mk_snapshot(**totals):
+    reg = metrics.MetricsRegistry(clock=lambda: 0.0)
+    for name, value in totals.items():
+        reg.counter(name.replace("__", "_")).inc(value)
+    return reg.snapshot()
+
+
+def test_metrics_vs_ledger_checker_consistent_and_tampered():
+    from tritonk8ssupervisor_tpu.serving import gateway as gw
+    from tritonk8ssupervisor_tpu.testing.chaos import (
+        ServeInvariantChecker,
+    )
+
+    req_records = [
+        {"kind": "accepted", "ts": 1.0, "key": "a"},
+        {"kind": "dispatched", "ts": 2.0, "key": "a"},
+        {"kind": "completed", "ts": 3.0, "key": "a"},
+        {"kind": "shed", "ts": 4.0, "reason": "overload", "depth": 64,
+         "retry_after_s": 5.0},
+    ]
+    checker = ServeInvariantChecker(gw.GatewayPolicy())
+    good = _mk_snapshot(
+        serving_requests_accepted_total=1,
+        serving_requests_completed_total=1,
+        serving_requests_rejected_total=1,
+    )
+    assert checker.check_metrics_consistency(req_records, good) == []
+    bad = _mk_snapshot(
+        serving_requests_accepted_total=3,  # counter drifted
+        serving_requests_completed_total=1,
+        serving_requests_rejected_total=1,
+    )
+    got = checker.check_metrics_consistency(req_records, bad)
+    assert len(got) == 1 and "accepted_total" in got[0]
+    # occupancy gauge over capacity
+    reg = metrics.MetricsRegistry(clock=lambda: 0.0)
+    reg.counter("serving_requests_accepted_total").inc(1)
+    reg.counter("serving_requests_completed_total").inc(1)
+    reg.counter("serving_requests_rejected_total").inc(1)
+    reg.gauge("serving_slots_busy_peak").set(9)
+    reg.gauge("serving_slots_total").set(8)
+    got = checker.check_metrics_consistency(req_records, reg.snapshot())
+    assert len(got) == 1 and "slots_busy_peak" in got[0]
+
+
+def test_gateway_report_counts_come_from_registry():
+    """The satellite refactor pin: report()'s counts read from the
+    registry (the /metrics source of truth), with the pre-registry key
+    set preserved exactly."""
+    from tritonk8ssupervisor_tpu.serving import gateway as gw
+
+    engine = gw.ModeledEngine(slots=2, prefill_chunk=16)
+    gateway = gw.Gateway({0: engine}, None,
+                         policy=gw.GatewayPolicy(
+                             bucket_bounds=(64,), queue_budget=2))
+    now = 0.0
+    assert gateway.submit(gw.Request(rid=1, prompt_len=8,
+                                     max_new_tokens=4), now).ok
+    assert not gateway.submit(
+        gw.Request(rid=2, prompt_len=9999, max_new_tokens=4), now).ok
+    report = gateway.report()
+    assert set(report) == {
+        "submitted", "completed", "rejected",
+        "requeued_after_slice_loss", "tokens_generated",
+        "p50_latency_s", "p99_latency_s", "max_queue_depth", "expired",
+        "expired_where", "replayed_from_journal", "serving", "engine",
+    }
+    assert report["submitted"] == 2
+    assert report["rejected"] == {"unservable": 1}
+    assert isinstance(report["submitted"], int)
+    reg = gateway.telemetry.metrics
+    assert reg.counter("serving_requests_submitted_total").total() == 2
+    # /metrics renders the same story without touching report()
+    assert "serving_requests_submitted_total 2" in reg.render()
+
+
+def test_gateway_update_gauges_reflects_occupancy():
+    from tritonk8ssupervisor_tpu.serving import gateway as gw
+
+    engine = gw.ModeledEngine(slots=4, prefill_chunk=16, num_pages=32)
+    gateway = gw.Gateway({0: engine}, None,
+                         policy=gw.GatewayPolicy(bucket_bounds=(64,)))
+    gateway.submit(gw.Request(rid=1, prompt_len=16, max_new_tokens=4),
+                   0.0)
+    gateway.workers[0].step(0.0)
+    gateway.update_gauges()
+    reg = gateway.telemetry.metrics
+    assert reg.gauge("serving_slots_total").value() == 4
+    assert reg.gauge("serving_slots_busy").value() == 1
+    assert reg.gauge("serving_kv_pages_total").value() == 32
+    assert reg.gauge("serving_kv_pages_in_use").value() >= 1
+
+
+# ------------------------------------------------- cross-plane acceptance
+
+
+@pytest.fixture(scope="module")
+def kill_drill_workdir(tmp_path_factory):
+    """One REAL gateway-SIGKILL drill (testing/chaos.py) shared by the
+    trace-acceptance tests: the workdir holds the request journal, the
+    span log with BOTH gateway incarnations, and the metrics
+    snapshot."""
+    from tritonk8ssupervisor_tpu.testing import chaos
+
+    root = tmp_path_factory.mktemp("kill-drill")
+    result = chaos.run_gateway_kill_drill(root)
+    return root, result
+
+
+def test_trace_acceptance_kill_survivor_both_incarnations(
+        kill_drill_workdir):
+    """THE acceptance pin: `./setup.sh trace <key>` reconstructs a
+    complete end-to-end timeline for a request that survived the
+    gateway SIGKILL mid-dispatch — spans from both gateway
+    incarnations, no gaps in terminal accounting."""
+    from tritonk8ssupervisor_tpu.provision.state import RunPaths
+    from tritonk8ssupervisor_tpu.serving import reqlog as reqlog_mod
+    from tritonk8ssupervisor_tpu.obs.trace import SpanLog
+
+    root, result = kill_drill_workdir
+    assert result["requests_lost"] == 0
+    assert result["redone_keys"], "the kill must strand in-flight work"
+    paths = RunPaths(root)
+    spans = SpanLog(paths.span_log, echo=lambda line: None).spans()
+    req_records = reqlog_mod.RequestLog(
+        paths.request_log, echo=lambda line: None).replay()
+    for key in result["redone_keys"]:
+        timeline = analyze.request_timeline(key, spans, req_records)
+        assert timeline["complete"], (
+            f"key {key}: terminal accounting has gaps"
+        )
+        assert timeline["incarnations"] == [1, 2], (
+            f"key {key}: expected spans from both gateway lives, got "
+            f"{timeline['incarnations']}"
+        )
+    assert result["violations"] == []  # incl. metrics-vs-ledger
+
+
+def test_trace_cli_exit_codes_and_json(kill_drill_workdir, capsys):
+    from tritonk8ssupervisor_tpu.cli import main as cli_main
+
+    root, result = kill_drill_workdir
+    key = result["redone_keys"][0]
+    rc = cli_main.main(["trace", key, "--json",
+                        "--workdir", str(root)])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["complete"] is True and doc["incarnations"] == [1, 2]
+    # an unknown key is an incomplete timeline: exit 2, not a crash
+    assert cli_main.main(["trace", "no-such-key",
+                          "--workdir", str(root)]) == 2
+
+
+def test_analyze_cli_correlate_over_drill(kill_drill_workdir, capsys):
+    from tritonk8ssupervisor_tpu.cli import main as cli_main
+
+    root, _ = kill_drill_workdir
+    rc = cli_main.main(["analyze", "--correlate", "--json",
+                        "--workdir", str(root)])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["spans"] > 0
+    assert doc["correlate"]["completions"] > 0
+    assert "serving/complete" in doc["spans_by_kind"]
+
+
+def test_supervisor_tick_publishes_metrics_snapshot_and_spans(tmp_path):
+    """The supervisor side of the plane: two ticks over a scripted
+    world write metrics.json (atomic, with tick counters), tick +
+    diagnose spans, and a status document whose telemetry block names
+    the snapshot it was built alongside."""
+    from tritonk8ssupervisor_tpu.provision import supervisor as sup_mod
+    from tritonk8ssupervisor_tpu.testing import chaos
+    from tritonk8ssupervisor_tpu.testing.simclock import SimClock
+
+    clock = SimClock()
+    config = chaos.sim_config(2)
+    world = chaos.ChaosFleet(tmp_path, clock, config)
+    telemetry = Telemetry.for_run(world.paths, clock=clock.time,
+                                  plane="supervisor", fsync=False,
+                                  echo=lambda line: None)
+    sup = sup_mod.Supervisor(
+        config, world.paths, chaos._Quiet(),
+        run=world.run, run_quiet=world.run_quiet,
+        policy=chaos.default_policy(),
+        clock=clock.time, sleep=clock.sleep, rng=lambda: 0.0,
+        readiness_timeout=60.0, hooks=clock, telemetry=telemetry,
+    )
+    clock.begin()
+    try:
+        sup.tick()
+        clock.sleep(30.0)
+        sup.tick()
+    finally:
+        clock.release()
+    snap = json.loads(world.paths.metrics_snapshot.read_text())
+    assert metrics.counter_total(snap, "supervisor_ticks_total") == 2
+    assert metrics.gauge_value(
+        snap, "supervisor_last_tick_seconds") is not None
+    spans = telemetry.tracer.log.spans()
+    kinds = {s["span"] for s in spans}
+    assert {"tick", "diagnose"} <= kinds
+    doc = sup.status_doc(clock.time())
+    assert doc["telemetry"]["metrics_snapshot"] == str(
+        world.paths.metrics_snapshot)
+    assert doc["telemetry"]["last_tick_s"] is not None
+    assert doc["telemetry"]["span_log_bytes"] is not None
+
+
+def test_status_cmd_synthesizes_telemetry_block(tmp_path, capsys):
+    """A pre-telemetry status file (or a ledger fold) still answers
+    'where do I scrape': status --json grows a telemetry block built
+    from the on-disk artifacts."""
+    from tritonk8ssupervisor_tpu.cli import main as cli_main
+    from tritonk8ssupervisor_tpu.provision import events as ev
+    from tritonk8ssupervisor_tpu.provision.state import RunPaths
+
+    paths = RunPaths(tmp_path)
+    view = ev.fold([{"kind": "supervisor-start", "ts": 1.0},
+                    {"kind": "tick", "ts": 2.0,
+                     "states": {"0": "healthy"}}])
+    ev.write_fleet_status(paths.fleet_status,
+                          ev.fleet_status(view, 3.0))
+    reg = metrics.MetricsRegistry(clock=lambda: 3.0)
+    reg.gauge("supervisor_last_tick_seconds").set(0.25)
+    reg.write_snapshot(paths.metrics_snapshot)
+    paths.span_log.write_text("")
+    rc = cli_main.main(["status", "--json", "--workdir", str(tmp_path)])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert doc["telemetry"]["metrics_snapshot"] == str(
+        paths.metrics_snapshot)
+    assert doc["telemetry"]["last_tick_s"] == 0.25
+    assert doc["telemetry"]["span_log"] == str(paths.span_log)
+
+
+def test_teardown_scrubs_span_log_and_metrics_snapshot(tmp_path):
+    from tritonk8ssupervisor_tpu.provision.state import RunPaths
+
+    paths = RunPaths(tmp_path)
+    assert paths.span_log.name == "telemetry-spans.jsonl"
+    assert paths.metrics_snapshot.name == "metrics.json"
+    # the scrub list in teardown names both (source-level pin: the
+    # teardown e2e path needs a full terraform world)
+    import inspect
+
+    from tritonk8ssupervisor_tpu.provision import teardown
+
+    src = inspect.getsource(teardown.clean)
+    assert "span_log" in src and "metrics_snapshot" in src
+
+
+# ------------------------------------------------------------ perf smoke
+
+
+@pytest.mark.perf
+def test_obs_overhead_smoke_claim_path():
+    """Tier-1 smoke for the <5% instrumentation-overhead gate, on the
+    cheap arm (the claim path; the full gate incl. the real-engine
+    step arm runs in bench_provision.py --obs / --check). Best paired
+    comparison, same estimator as the bench."""
+    import tempfile
+    from pathlib import Path
+
+    import bench_provision as bp
+
+    with tempfile.TemporaryDirectory() as tmp:
+        root = Path(tmp)
+        ratios = []
+        for _ in range(5):
+            off = bp._obs_claim_trial(root, False, 2000)
+            on = bp._obs_claim_trial(root, True, 2000)
+            ratios.append(on / off)
+            for residue in root.glob("*.jsonl"):
+                residue.unlink()
+    assert min(ratios) < 1.05, (
+        f"claim-path instrumentation overhead {min(ratios):.3f}x "
+        "(best of 5 paired runs) exceeds the 5% bar"
+    )
+
+
+@pytest.mark.perf
+def test_committed_bench_obs_doc_passes():
+    """The committed BENCH_obs.json is the evidence of record for the
+    <5% acceptance: it must exist, pass, and gate the right arms."""
+    doc = json.loads(
+        (Path(__file__).resolve().parent.parent / "BENCH_obs.json")
+        .read_text()
+    )
+    assert doc["passes"] is True
+    assert doc["value"] < 5.0
+    assert set(doc["gated"]) == {"claim", "real_step"}
+    assert doc["real_step"]["overhead_pct"] < 5.0
+    assert doc["claim"]["overhead_pct"] < 5.0

@@ -925,6 +925,57 @@ def test_http_serve_one_request(tmp_path):
         loop.stop()
 
 
+def test_http_metrics_endpoint_serves_exposition(tmp_path):
+    """GET /metrics: the Prometheus text exposition of the gateway's
+    registry, with the pull-derived gauges refreshed at scrape time —
+    the telemetry plane's scrape surface (docs/observability.md)."""
+    import http.client
+    from http.server import ThreadingHTTPServer
+
+    from tritonk8ssupervisor_tpu.serving import server as server_mod
+
+    policy = gw.GatewayPolicy(max_seq_len=512,
+                              bucket_bounds=(64, 128, 256),
+                              slots_per_slice=2)
+    gateway = gw.Gateway(
+        {0: gw.ModeledEngine(slots=2, prefill_chunk=64)}, None,
+        policy=policy,
+    )
+    lock = threading.Lock()
+    loop = server_mod.EngineLoop(gateway, lock)
+    server = ThreadingHTTPServer(
+        ("127.0.0.1", 0),
+        server_mod.make_handler(gateway, lock, loop=loop),
+    )
+    port = server.server_address[1]
+    server_thread = threading.Thread(target=server.serve_forever,
+                                     kwargs={"poll_interval": 0.05},
+                                     daemon=True)
+    loop.start()
+    server_thread.start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+        body = json.dumps({"tokens": [1, 2, 3], "max_new_tokens": 3})
+        conn.request("POST", "/generate", body=body,
+                     headers={"Content-Type": "application/json"})
+        assert conn.getresponse().status == 200
+        conn.request("GET", "/metrics")
+        resp = conn.getresponse()
+        text = resp.read().decode()
+        assert resp.status == 200
+        assert resp.headers["Content-Type"].startswith("text/plain")
+        assert "# TYPE serving_requests_submitted_total counter" in text
+        assert "serving_requests_submitted_total 1" in text
+        assert "serving_requests_completed_total 1" in text
+        assert "serving_slots_total 2" in text
+        assert "serving_engine_step_seconds_count" in text
+        conn.close()
+    finally:
+        server.shutdown()
+        server.server_close()
+        loop.stop()
+
+
 def test_http_deadline_504_carries_journal_trail(tmp_path):
     """The request-plane front door: a request whose deadline expires
     gets a proper 504 JSON body with the journal trail summary (never

@@ -72,7 +72,8 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument(
         "command",
         nargs="?",
-        choices=["heal", "supervise", "status", "train", "serve"],
+        choices=["heal", "supervise", "status", "train", "serve",
+                 "trace", "analyze"],
         metavar="command",
         help="optional subcommand: `heal` diagnoses per-slice fleet "
         "health (missing / unready / draining) and repairs ONLY the "
@@ -90,7 +91,16 @@ def build_parser() -> argparse.ArgumentParser:
         "(serving/gateway.py) in front of the KV-cache decode stack, "
         "routed by this workdir's fleet-status.json — HTTP POST "
         "/generate by default, or --drill N for a no-network smoke "
-        "(docs/performance.md, Serving)",
+        "(docs/performance.md, Serving); `trace <key>` reconstructs "
+        "one request's end-to-end timeline from the span log + request "
+        "journal (docs/observability.md); `analyze` summarises the "
+        "span log, and with --correlate joins supervisor ledger events "
+        "with request spans to attribute latency spikes to fleet "
+        "events",
+    )
+    parser.add_argument(
+        "arg", nargs="?", default=None, metavar="key",
+        help="trace: the request idempotency key to reconstruct",
     )
     parser.add_argument(
         "-c", "--clean", action="store_true", help="destroy the cluster and all state"
@@ -223,6 +233,18 @@ def build_parser() -> argparse.ArgumentParser:
         "(folded from the event ledger) — the default document stays "
         "bounded at fleet scale: per-state counts plus only the "
         "not-healthy slices",
+    )
+    # --------------------------------------------------- trace / analyze
+    parser.add_argument(
+        "--correlate", action="store_true",
+        help="analyze: join the supervisor's event ledger with the "
+        "request spans and attribute latency-spike windows to fleet "
+        "events (heal waves, breaker holds, domain outages)",
+    )
+    parser.add_argument(
+        "--window", type=float, default=60.0, metavar="SECONDS",
+        help="analyze --correlate: latency-window width for spike "
+        "detection (default 60)",
     )
     # ---------------------------------------------------------- train drill
     parser.add_argument(
@@ -470,6 +492,10 @@ def main(argv: list[str] | None = None, prompter: Prompter | None = None) -> int
             return train_cmd(args, paths, prompter)
         if args.command == "serve":
             return serve_cmd(args, paths, prompter)
+        if args.command == "trace":
+            return trace_cmd(args, paths, prompter)
+        if args.command == "analyze":
+            return analyze_cmd(args, paths, prompter)
         if args.show_config:
             return show_config(args, paths, prompter)
         return provision(args, paths, prompter)
@@ -632,6 +658,8 @@ def supervise_cmd(args, paths: state.RunPaths, prompter: Prompter) -> int:
     if config.mode == "tpu-vm":
         ssh_key = discovery.find_ssh_key()
         ssh_user = discovery.ssh_username()
+    from tritonk8ssupervisor_tpu import obs as obs_mod
+
     sup = supervisor_mod.Supervisor(
         config, paths, prompter,
         run=run, run_quiet=run_quiet,
@@ -639,6 +667,12 @@ def supervise_cmd(args, paths: state.RunPaths, prompter: Prompter) -> int:
         ssh_key=str(ssh_key), ssh_user=ssh_user,
         timer=timer,
         readiness_timeout=args.readiness_timeout,
+        # tick/diagnose/heal-wave spans + the /metrics-shaped registry,
+        # snapshotted to metrics.json every tick (docs/observability.md)
+        telemetry=obs_mod.Telemetry.for_run(
+            paths, plane=obs_mod.SUPERVISOR,
+            echo=lambda line: prompter.say(line),
+        ),
     )
     # a signalled stop finishes the current tick, appends supervisor-stop,
     # and releases the pid lock — what teardown's SIGTERM relies on
@@ -696,6 +730,34 @@ def status_cmd(args, paths: state.RunPaths, prompter: Prompter) -> int:
             f"ledger at {paths.events} — run ./setup.sh supervise to "
             "start the reconcile loop"
         )
+    if "telemetry" not in doc:
+        # a ledger fold (or a pre-telemetry status file) carries no
+        # telemetry block; synthesize one from the on-disk artifacts so
+        # `status --json` always answers "where do I scrape"
+        from tritonk8ssupervisor_tpu.obs import metrics as metrics_mod
+
+        last_tick = None
+        if paths.metrics_snapshot.exists():
+            try:
+                snap = json_mod.loads(paths.metrics_snapshot.read_text())
+                last_tick = metrics_mod.gauge_value(
+                    snap, "supervisor_last_tick_seconds"
+                )
+            except ValueError:
+                pass  # torn copy: the pointer is still worth printing
+        try:
+            span_bytes = paths.span_log.stat().st_size
+        except OSError:
+            span_bytes = None
+        doc["telemetry"] = {
+            "metrics_snapshot": (str(paths.metrics_snapshot)
+                                 if paths.metrics_snapshot.exists()
+                                 else None),
+            "span_log": (str(paths.span_log)
+                         if paths.span_log.exists() else None),
+            "span_log_bytes": span_bytes,
+            "last_tick_s": last_tick,
+        }
     if args.json:
         prompter.say(json_mod.dumps(doc, indent=2, sort_keys=True))
     else:
@@ -764,6 +826,19 @@ def status_cmd(args, paths: state.RunPaths, prompter: Prompter) -> int:
                    if membership.get("heal_in_progress") else "")
                 + (f", draining {membership.get('draining')}"
                    if membership.get("draining") else "")
+            )
+        tel = doc.get("telemetry") or {}
+        if tel.get("metrics_snapshot") or tel.get("span_log"):
+            last_tick = tel.get("last_tick_s")
+            span_bytes = tel.get("span_log_bytes")
+            prompter.say(
+                "telemetry: "
+                + (f"last tick {last_tick:.3f}s, "
+                   if last_tick is not None else "")
+                + f"metrics {tel.get('metrics_snapshot') or '(none)'}"
+                + (f", spans {tel['span_log']}" if tel.get("span_log")
+                   else "")
+                + (f" ({span_bytes} B)" if span_bytes is not None else "")
             )
         job = doc.get("job", {})
         if job.get("phase"):
@@ -899,6 +974,7 @@ def serve_cmd(args, paths: state.RunPaths, prompter: Prompter) -> int:
     import jax
     import jax.numpy as jnp
 
+    from tritonk8ssupervisor_tpu import obs as obs_mod
     from tritonk8ssupervisor_tpu.models import TransformerLM
     from tritonk8ssupervisor_tpu.provision.fleetview import FileHealthSource
     from tritonk8ssupervisor_tpu.serving import engine as engine_mod
@@ -928,6 +1004,16 @@ def serve_cmd(args, paths: state.RunPaths, prompter: Prompter) -> int:
         pages_per_slice=(args.kv_pages if args.kv_pages > 0 else None),
         prefix_cache=not args.no_prefix_cache,
     )
+    # the telemetry plane (obs/): spans fsync'd to the workdir's span
+    # log (they survive a SIGKILL exactly like the request journal),
+    # metrics registry scraped by GET /metrics and snapshotted at drill
+    # exit. Incarnation = pid, so a restarted gateway's spans are
+    # distinguishable in `./setup.sh trace <key>`.
+    telemetry = obs_mod.Telemetry.for_run(
+        paths, clock=time_mod.monotonic, plane=obs_mod.SERVING,
+        incarnation=os.getpid(),
+        echo=lambda line: prompter.say(line),
+    )
     # one local engine: this process serves as "slice 0" of whatever
     # fleet the status file describes — the per-slice dispatch fan-out
     # is the bench/sim's subject (bench_provision.py --serve); the
@@ -938,6 +1024,7 @@ def serve_cmd(args, paths: state.RunPaths, prompter: Prompter) -> int:
         page_size=policy.page_size,
         num_pages=policy.pages_per_slice,
         prefix_cache=policy.prefix_cache,
+        tracer=telemetry.tracer, slice_index=0,
     )
     gw = gateway_mod.Gateway(
         {0: eng},
@@ -946,6 +1033,7 @@ def serve_cmd(args, paths: state.RunPaths, prompter: Prompter) -> int:
         echo=lambda line: prompter.say(line),
         reqlog=reqlog_mod.RequestLog(paths.request_log,
                                      echo=lambda line: prompter.say(line)),
+        telemetry=telemetry,
     )
     # crash-resume: a restarted gateway folds its request journal —
     # incomplete work re-admitted front-of-queue, completed idempotency
@@ -967,6 +1055,125 @@ def serve_cmd(args, paths: state.RunPaths, prompter: Prompter) -> int:
     return server_mod.serve_http(
         gw, "127.0.0.1", args.port, echo=lambda line: prompter.say(line)
     )
+
+
+def trace_cmd(args, paths: state.RunPaths, prompter: Prompter) -> int:
+    """`./setup.sh trace <key>` — one request's end-to-end timeline,
+    reconstructed from the span log (obs/trace.py) joined with the
+    request journal (serving/reqlog.py) under the idempotency key.
+    Works on a crashed workdir (both are durable ledgers); spans carry
+    the writer's incarnation, so a request that survived a gateway
+    SIGKILL shows records from both gateway lives. Exit 0 when the
+    terminal accounting is complete (every acceptance settled exactly
+    once), 2 when it has gaps."""
+    import json as json_mod
+
+    from tritonk8ssupervisor_tpu.obs import analyze as analyze_mod
+    from tritonk8ssupervisor_tpu.obs.trace import SpanLog
+    from tritonk8ssupervisor_tpu.serving import reqlog as reqlog_mod
+
+    if not args.arg:
+        raise state.MissingStateError(
+            "trace needs a request idempotency key: ./setup.sh trace "
+            "<key> (keys are journaled in serve-requests.jsonl; "
+            "./setup.sh analyze lists recent activity)"
+        )
+    spans = (SpanLog(paths.span_log).spans()
+             if paths.span_log.exists() else [])
+    req_records = (reqlog_mod.RequestLog(paths.request_log).replay()
+                   if paths.request_log.exists() else [])
+    if not spans and not req_records:
+        raise state.MissingStateError(
+            f"no span log at {paths.span_log} and no request journal "
+            f"at {paths.request_log} — run ./setup.sh serve (or a "
+            "bench/chaos drill) first"
+        )
+    timeline = analyze_mod.request_timeline(args.arg, spans, req_records)
+    if args.json:
+        prompter.say(json_mod.dumps(timeline, indent=2, sort_keys=True))
+    else:
+        for line in analyze_mod.render_timeline(timeline):
+            prompter.say(line)
+    return 0 if timeline["complete"] else 2
+
+
+def analyze_cmd(args, paths: state.RunPaths, prompter: Prompter) -> int:
+    """`./setup.sh analyze [--correlate]` — the cross-plane telemetry
+    summary. The base report counts spans per kind and plane over the
+    span log's time range; `--correlate` additionally joins the
+    supervisor's event ledger with the request spans and attributes
+    latency-spike windows to overlapping fleet events ("p99 window
+    t=300-480 overlaps heal-wave span for slice 2")."""
+    import json as json_mod
+
+    from tritonk8ssupervisor_tpu.obs import analyze as analyze_mod
+    from tritonk8ssupervisor_tpu.obs.trace import SpanLog
+    from tritonk8ssupervisor_tpu.provision import events as ev_mod
+    from tritonk8ssupervisor_tpu.serving import reqlog as reqlog_mod
+
+    spans = (SpanLog(paths.span_log).spans()
+             if paths.span_log.exists() else [])
+    req_records = (reqlog_mod.RequestLog(paths.request_log).replay()
+                   if paths.request_log.exists() else [])
+    ledger_records = (ev_mod.EventLedger(paths.events).replay()
+                      if paths.events.exists() else [])
+    if not spans and not req_records and not ledger_records:
+        raise state.MissingStateError(
+            f"no telemetry on record ({paths.span_log}, "
+            f"{paths.request_log}, {paths.events} all absent) — run "
+            "./setup.sh serve or supervise first"
+        )
+    by_kind: dict = {}
+    t_lo = t_hi = None
+    for span in spans:
+        label = f"{span.get('plane', '?')}/{span.get('span', '?')}"
+        by_kind[label] = by_kind.get(label, 0) + 1
+        start = span.get("start")
+        if start is not None:
+            t_lo = start if t_lo is None else min(t_lo, start)
+            t_hi = (span.get("end", start) if t_hi is None
+                    else max(t_hi, span.get("end", start)))
+    doc: dict = {
+        "span_log": str(paths.span_log),
+        "spans": len(spans),
+        "spans_by_kind": dict(sorted(by_kind.items())),
+        "span_time_range": ([round(t_lo, 3), round(t_hi, 3)]
+                            if t_lo is not None else None),
+        "journal_records": len(req_records),
+        "ledger_records": len(ledger_records),
+    }
+    if args.correlate:
+        doc["correlate"] = analyze_mod.correlate(
+            spans, ledger_records, req_records=req_records,
+            window_s=max(1.0, args.window),
+        )
+    if args.json:
+        prompter.say(json_mod.dumps(doc, indent=2, sort_keys=True))
+        return 0
+    prompter.say(
+        f"telemetry: {doc['spans']} span(s) in {doc['span_log']}, "
+        f"{doc['journal_records']} request-journal record(s), "
+        f"{doc['ledger_records']} supervisor ledger record(s)"
+    )
+    for label, count in sorted(by_kind.items()):
+        prompter.say(f"  {label:<28} {count}")
+    if args.correlate:
+        cor = doc["correlate"]
+        prompter.say(
+            f"correlate: {cor['completions']} completion(s), overall "
+            f"p50 {cor['overall_p50_s']}s / p99 {cor['overall_p99_s']}s "
+            f"over {cor['window_s']:.0f}s windows "
+            f"({cor['fleet_intervals']} fleet interval(s) on record)"
+        )
+        if cor["attributions"]:
+            for line in cor["attributions"]:
+                prompter.say(f"  {line}")
+        else:
+            prompter.say(
+                "  no latency-spike windows above the threshold — "
+                "nothing to attribute"
+            )
+    return 0
 
 
 def provision(args, paths: state.RunPaths, prompter: Prompter) -> int:

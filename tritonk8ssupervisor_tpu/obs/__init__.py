@@ -1,0 +1,96 @@
+"""Unified telemetry plane: metrics registry, durable spans, analyzers.
+
+One bundle (`Telemetry`) threads through every plane — the serving
+gateway/engine/server and the supervisor's reconcile loop — so the
+repo's three ledgers (event ledger, request journal, span log) and one
+scrape surface (/metrics + metrics.json) tell a SINGLE story:
+
+- obs/metrics.py: thread-safe Counters/Gauges/log-bucketed Histograms,
+  Prometheus text exposition, atomic JSON snapshots, injectable clock.
+- obs/trace.py: span model over the EventLedger durability discipline
+  (fsync'd, torn-final-line truncating) keyed by the request's
+  idempotency key, plus supervisor-side spans.
+- obs/analyze.py: `./setup.sh trace <key>` timeline reconstruction and
+  `./setup.sh analyze --correlate` spike-to-fleet-event attribution.
+
+Runbook, metric catalog, and span schema: docs/observability.md.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import sys
+import time
+from pathlib import Path
+
+from tritonk8ssupervisor_tpu.obs.metrics import MetricsRegistry
+from tritonk8ssupervisor_tpu.obs.trace import (
+    SERVING,
+    SUPERVISOR,
+    SpanLog,
+    Tracer,
+)
+
+__all__ = [
+    "MetricsRegistry",
+    "SpanLog",
+    "Tracer",
+    "Telemetry",
+    "SERVING",
+    "SUPERVISOR",
+]
+
+
+@dataclasses.dataclass
+class Telemetry:
+    """What an instrumented component holds: a metrics registry (always
+    real — report()-style surfaces read their counts from it even when
+    nothing scrapes) and a tracer (disabled unless a span log is
+    wired). `snapshot_path` set means `write_snapshot()` publishes the
+    registry as atomic JSON (metrics.json) — the supervisor does this
+    every tick next to fleet-status.json."""
+
+    metrics: MetricsRegistry
+    tracer: Tracer
+    snapshot_path: Path | None = None
+
+    @classmethod
+    def off(cls, clock=time.monotonic) -> "Telemetry":
+        """The un-wired default: live registry, disabled tracer. What
+        Gateway/Supervisor construct when nothing is passed, so the
+        counter-backed report paths always work."""
+        return cls(MetricsRegistry(clock=clock), Tracer(None, clock=clock))
+
+    @classmethod
+    def for_run(
+        cls,
+        paths,
+        clock=time.time,
+        plane: str = SERVING,
+        fsync: bool = True,
+        incarnation: int = 0,
+        echo=lambda line: print(line, file=sys.stderr, flush=True),
+    ) -> "Telemetry":
+        """The wired form over a workdir's RunPaths: spans to
+        paths.span_log (both planes share the file; records carry
+        `plane`), snapshots to paths.metrics_snapshot. `fsync=False`
+        is the virtual-clock harness mode, same as the request
+        journal's."""
+        log = SpanLog(paths.span_log, clock=clock, echo=echo, fsync=fsync)
+        return cls(
+            MetricsRegistry(clock=clock),
+            Tracer(log, plane=plane, clock=clock, incarnation=incarnation),
+            snapshot_path=paths.metrics_snapshot,
+        )
+
+    def bump_incarnation(self) -> int:
+        """A restarted writer (gateway crash-resume) announces itself:
+        spans after this carry the new incarnation, so a timeline shows
+        both lives of the process."""
+        self.tracer.incarnation += 1
+        return self.tracer.incarnation
+
+    def write_snapshot(self) -> dict | None:
+        if self.snapshot_path is None:
+            return None
+        return self.metrics.write_snapshot(self.snapshot_path)

@@ -99,7 +99,15 @@ class EventLedger:
     `fsync=False` drops the per-record fsync (flush only) — for the
     virtual-clock chaos/bench harnesses whose "crashes" are in-process
     object drops, which OS-buffered writes survive by construction.
-    Anything guarding against a real SIGKILL keeps the default."""
+    Anything guarding against a real SIGKILL keeps the default.
+
+    Subclasses may set `_buffered = True` to ALSO drop the per-record
+    flush in fsync=False mode (the span log does: spans are the
+    highest-volume ledger and nothing reads one mid-run except through
+    replay(), which flushes the live writer first). fsync=True always
+    flushes and fsyncs."""
+
+    _buffered = False
 
     def __init__(
         self,
@@ -113,24 +121,76 @@ class EventLedger:
         self._echo = echo
         self._fsync = bool(fsync)
         self._mutex = threading.Lock()
+        self._handle = None  # cached O_APPEND writer (lazy)
+
+    def _writer(self):
+        """The cached append handle. Opening (and mkdir-ing) per record
+        dominated append cost once the request plane and the span log
+        started writing per transition; one long-lived O_APPEND handle
+        keeps every durability property (flush + fsync per record) at a
+        fraction of the syscalls. Invalidated by compact()/scrub():
+        after an os.replace the old inode is no longer the ledger."""
+        f = self._handle
+        if f is None or f.closed:
+            self.path.parent.mkdir(parents=True, exist_ok=True)
+            f = self._handle = self.path.open("a")
+        return f
+
+    def _drop_writer(self) -> None:
+        if self._handle is not None:
+            try:
+                self._handle.close()
+            except OSError:
+                pass
+            self._handle = None
 
     def append(self, kind: str, **fields) -> dict:
         record = {"v": SCHEMA_VERSION, "ts": self._clock(), "kind": kind,
                   **fields}
         line = json.dumps(record, sort_keys=True) + "\n"
         with self._mutex:
-            self.path.parent.mkdir(parents=True, exist_ok=True)
-            with self.path.open("a") as f:
-                f.write(line)
+            f = self._writer()
+            f.write(line)
+            if self._fsync:
                 f.flush()
-                if self._fsync:
-                    os.fsync(f.fileno())
+                os.fsync(f.fileno())
+            elif not self._buffered:
+                f.flush()
         return record
+
+    def append_many(self, kinds_fields: list) -> list[dict]:
+        """Append several records under ONE lock/flush/fsync — the span
+        log's terminal-settle batch (a request's queue-wait + prefill +
+        decode + terminal land together). Durability is per BATCH,
+        which is exactly the settle's atomicity anyway."""
+        records = []
+        lines = []
+        for kind, fields in kinds_fields:
+            record = {"v": SCHEMA_VERSION, "ts": self._clock(),
+                      "kind": kind, **fields}
+            records.append(record)
+            lines.append(json.dumps(record, sort_keys=True) + "\n")
+        if not lines:
+            return records
+        with self._mutex:
+            f = self._writer()
+            f.write("".join(lines))
+            if self._fsync:
+                f.flush()
+                os.fsync(f.fileno())
+            elif not self._buffered:
+                f.flush()
+        return records
 
     def replay(self) -> list[dict]:
         """All records in append order — torn final line truncated away
         (the interrupted write), mid-file corruption fatal, newer-schema
-        records skipped (forward compat)."""
+        records skipped (forward compat). A live buffered writer (this
+        instance's own cached handle) is flushed first, so a replay
+        always sees everything this process appended."""
+        with self._mutex:
+            if self._handle is not None and not self._handle.closed:
+                self._handle.flush()
         if not self.path.exists():
             return []
         raw = self.path.read_text()
@@ -201,6 +261,7 @@ class EventLedger:
                 f.flush()
                 os.fsync(f.fileno())
             os.replace(tmp, self.path)
+            self._drop_writer()  # the cached handle names the old inode
         dropped = len(records) - 1
         self._echo(
             f"event ledger compacted: {len(records)} records -> 1 snapshot"
@@ -211,7 +272,9 @@ class EventLedger:
         """Delete the ledger — teardown's LAST act (after even the
         journal), so a clean that crashes halfway leaves the full flight
         record of what the supervisor saw and did."""
-        self.path.unlink(missing_ok=True)
+        with self._mutex:
+            self._drop_writer()
+            self.path.unlink(missing_ok=True)
 
 
 # ------------------------------------------------------------ replay fold
@@ -584,6 +647,7 @@ def fleet_status(
     now: float,
     pid: int | None = None,
     all_slices: bool = False,
+    telemetry: dict | None = None,
 ) -> dict:
     """The machine-readable status document. Written atomically to
     fleet-status.json every reconcile tick and rendered by
@@ -596,7 +660,13 @@ def fleet_status(
     status a FileHealthSource (parallel/elastic.py) parses every step
     boundary is a few hundred bytes, not a megabyte. `all_slices=True`
     (what `./setup.sh status --json --all` folds from the ledger) emits
-    the full per-slice dump."""
+    the full per-slice dump.
+
+    `telemetry` (the supervisor's `telemetry_block()`) records which
+    metrics snapshot this status was built alongside, the span log and
+    its size, and the last tick's duration — absent on documents built
+    by an un-wired fold (the status command synthesizes one from disk
+    then)."""
     from tritonk8ssupervisor_tpu.provision import heal as heal_mod
 
     degraded = sorted(
@@ -619,7 +689,7 @@ def fleet_status(
         sv.index for sv in view.slices.values()
         if sv.state == heal_mod.DRAINING
     )
-    return {
+    doc = {
         "v": SCHEMA_VERSION,
         "updated": now,
         "supervisor": {
@@ -733,6 +803,9 @@ def fleet_status(
             "failures_on_record": len(view.breaker_failures),
         },
     }
+    if telemetry is not None:
+        doc["telemetry"] = telemetry
+    return doc
 
 
 def write_fleet_status(path: Path, status: dict) -> None:

@@ -221,6 +221,24 @@ class RunPaths:
         return self.root / "serve-requests.jsonl"
 
     @property
+    def span_log(self) -> Path:
+        # the unified telemetry plane's span ledger (obs/trace.py):
+        # request-keyed serving spans (admission -> queue-wait ->
+        # prefill -> decode -> terminal) and supervisor spans (tick,
+        # diagnose, heal waves, breaker transitions) in one fsync'd
+        # torn-line-truncating JSONL — `./setup.sh trace <key>` and
+        # `analyze --correlate` fold it (docs/observability.md)
+        return self.root / "telemetry-spans.jsonl"
+
+    @property
+    def metrics_snapshot(self) -> Path:
+        # the metrics registry's atomic JSON snapshot (obs/metrics.py):
+        # rewritten by the supervisor every tick (and by serve drills at
+        # exit) next to fleet-status.json; `./setup.sh status --json`
+        # surfaces it in the telemetry block
+        return self.root / "metrics.json"
+
+    @property
     def supervisor_pid(self) -> Path:
         # the running supervisor's pid lockfile — one resident reconcile
         # loop per workdir, and what teardown signals to stop it

@@ -66,6 +66,7 @@ import time
 from pathlib import Path
 from typing import Callable
 
+from tritonk8ssupervisor_tpu import obs as obs_mod
 from tritonk8ssupervisor_tpu.config.schema import ClusterConfig, ConfigError
 from tritonk8ssupervisor_tpu.provision import events as events_mod
 from tritonk8ssupervisor_tpu.provision import heal as heal_mod
@@ -468,6 +469,7 @@ class Supervisor:
         readiness_timeout: float = 900.0,
         heal_fn=heal_mod.heal,
         hooks=None,
+        telemetry: "obs_mod.Telemetry | None" = None,
     ) -> None:
         if config.mode != "tpu-vm":
             raise ConfigError(
@@ -536,6 +538,41 @@ class Supervisor:
         # flap/incident bookkeeping share one re-entrant lock
         self._mutex = threading.RLock()
         self._ledger_records = 0  # appended + replayed, for auto-compact
+        # ---- telemetry plane (obs/) ----
+        # The registry is always real (the status telemetry block reads
+        # it); spans and metrics.json snapshots flow when supervise_cmd
+        # wires Telemetry.for_run. _record() mirrors heal/breaker
+        # events into it, so the scrape surface can never disagree with
+        # the ledger it was derived from.
+        self.telemetry = telemetry or obs_mod.Telemetry.off(clock=clock)
+        reg = self.telemetry.metrics
+        self._tracer = self.telemetry.tracer
+        self._c_ticks = reg.counter(
+            "supervisor_ticks_total", "reconcile ticks run")
+        self._h_tick = reg.histogram(
+            "supervisor_tick_seconds", "wall time of one reconcile tick")
+        self._g_last_tick = reg.gauge(
+            "supervisor_last_tick_seconds",
+            "duration of the most recent tick")
+        self._g_dirty = reg.gauge(
+            "supervisor_dirty_set_size",
+            "slices given the expensive diagnosis this tick")
+        self._c_heals = reg.counter(
+            "supervisor_heals_total",
+            "heal lifecycle events by result (start/done/failed/"
+            "rate-limited/deferred/suppressed)")
+        self._h_mttr = reg.histogram(
+            "supervisor_heal_mttr_seconds",
+            "per-slice incident-open to heal-done (the ledger's "
+            "mttr_s samples)")
+        self._g_breaker = reg.gauge(
+            "supervisor_breaker_state",
+            "0 closed / 1 half-open / 2 open, per domain "
+            "(domain=global is the last-resort breaker)")
+        self._c_outages = reg.counter(
+            "supervisor_domain_outages_total",
+            "correlated-failure classifications")
+        self._last_tick_s: float | None = None
 
     # ----------------------------------------------------------- plumbing
 
@@ -574,17 +611,61 @@ class Supervisor:
     def request_stop(self) -> None:
         self._stop = True
 
+    _BREAKER_LEVEL = {CLOSED: 0.0, HALF_OPEN: 1.0, OPEN: 2.0}
+
     def _record(self, kind: str, **fields) -> dict:
         """Append to the durable ledger AND fold into the live view —
         the status publish then costs O(view), not O(ledger): a
         week-long loop never re-reads its own history per tick.
         Serialised under the supervisor mutex: parallel heal workers
-        record concurrently, and the fold is a mutation."""
+        record concurrently, and the fold is a mutation. Selected kinds
+        mirror into the telemetry plane here, so the registry is
+        derived from exactly the records the ledger holds."""
         with self._mutex:
             record = self.ledger.append(kind, **fields)
             events_mod.apply(self._view, record)
             self._ledger_records += 1
+            self._mirror_telemetry(kind, record)
         return record
+
+    def _mirror_telemetry(self, kind: str, record: dict) -> None:
+        """Heal counters, MTTR samples, breaker-state gauges, and
+        breaker-transition span events, keyed off the ledger record
+        being appended (one mirror point — instrumentation can never
+        drift from the flight recorder)."""
+        ts = record.get("ts", 0.0)
+        if kind == events_mod.HEAL_START:
+            self._c_heals.inc(result="start")
+        elif kind == events_mod.HEAL_DONE:
+            self._c_heals.inc(result="done")
+            for sample in record.get("mttr_s") or []:
+                self._h_mttr.observe(float(sample))
+        elif kind == events_mod.HEAL_FAILED:
+            self._c_heals.inc(result="failed")
+        elif kind == events_mod.RATE_LIMITED:
+            self._c_heals.inc(result="rate-limited")
+        elif kind == events_mod.HEAL_DEFERRED:
+            self._c_heals.inc(result="deferred")
+        elif kind == events_mod.HEAL_SUPPRESSED:
+            self._c_heals.inc(result="suppressed")
+        elif kind == events_mod.DOMAIN_OUTAGE:
+            self._c_outages.inc()
+            self._tracer.event("domain-outage", ts,
+                               domain=record.get("domain", ""),
+                               slices=record.get("slices"))
+        elif kind in (events_mod.BREAKER_OPEN,
+                      events_mod.BREAKER_HALF_OPEN,
+                      events_mod.BREAKER_CLOSE,
+                      events_mod.DOMAIN_BREAKER_OPEN,
+                      events_mod.DOMAIN_BREAKER_HALF_OPEN,
+                      events_mod.DOMAIN_BREAKER_CLOSE):
+            state = {"open": OPEN, "half-open": HALF_OPEN,
+                     "close": CLOSED}[kind.rsplit("-", 1)[-1]]
+            domain = record.get("domain") or "global"
+            self._g_breaker.set(self._BREAKER_LEVEL[state],
+                                domain=domain)
+            self._tracer.event("breaker", ts, state=state,
+                               domain=domain)
 
     def say(self, text: str) -> None:
         self.prompter.say(text)
@@ -709,11 +790,14 @@ class Supervisor:
         self.ticks += 1
         self.snapshot.invalidate()  # every tick sees fresh fleet state
         dirty = self._dirty_set()
+        t_diag = self._clock()
         observed = heal_mod.diagnose(
             self.config, self.paths, run_quiet=self._run_quiet,
             ssh_user=self._ssh_user, ssh_key=self._ssh_key,
             snapshot=self.snapshot, only_slices=dirty,
         )
+        self._tracer.emit("diagnose", t_diag, self._clock(),
+                          tick=self.ticks, observed=len(dirty))
         for s in observed.slices:
             self._health_cache[s.index] = s
         health = heal_mod.FleetHealth(
@@ -796,6 +880,18 @@ class Supervisor:
                     "unhealthy; awaiting confirmation "
                     f"(flap threshold {self.policy.flap_threshold})"
                 )
+        # tick telemetry BEFORE the publish, so the metrics snapshot
+        # written next to fleet-status.json already includes this tick
+        done = self._clock()
+        self._last_tick_s = round(max(0.0, done - now), 6)
+        self._c_ticks.inc()
+        self._h_tick.observe(self._last_tick_s)
+        self._g_last_tick.set(self._last_tick_s)
+        self._g_dirty.set(len(dirty))
+        self._tracer.emit("tick", now, done, tick=self.ticks,
+                          observed=len(dirty),
+                          eligible=len(summary["eligible"]),
+                          healed=len(summary["healed"]))
         self._publish(now)
         return summary
 
@@ -1076,6 +1172,8 @@ class Supervisor:
                 )
             finally:
                 self._hooks.begin()
+            self._tracer.emit("heal-wave", wave_now, self._clock(),
+                              wave=start // width, slices=list(wave))
             healed.extend(i for i, ok in results.values() if ok)
         return sorted(healed)
 
@@ -1117,6 +1215,9 @@ class Supervisor:
             # stand-in, KeyboardInterrupt) must sail through UNrecorded:
             # the orphaned heal-start IS the crash signature resume reads.
             done = self._clock()
+            self._tracer.emit("heal", started, done, id=heal_id,
+                              slices=sorted(slices), ok=False,
+                              canary=bool(canary_domain))
             with self._mutex:
                 self._record(
                     events_mod.HEAL_FAILED, id=heal_id,
@@ -1166,6 +1267,9 @@ class Supervisor:
                     )
             return False
         done = self._clock()
+        self._tracer.emit("heal", started, done, id=heal_id,
+                          slices=sorted(slices), ok=True,
+                          canary=bool(canary_domain))
         with self._mutex:
             mttr = [round(done - self._incidents.get(i, now), 3)
                     for i in sorted(slices)]
@@ -1220,16 +1324,48 @@ class Supervisor:
     # ------------------------------------------------------------- status
 
     def _publish(self, now: float) -> None:
+        # metrics.json lands FIRST, so the fleet-status document's
+        # telemetry block always names a snapshot at least as fresh as
+        # the status that points at it
+        self.telemetry.write_snapshot()
         events_mod.write_fleet_status(
             self.paths.fleet_status, self.status_doc(now)
         )
+
+    def telemetry_block(self) -> dict:
+        """The status document's telemetry block: where the metrics
+        snapshot and span log live, how big the span log has grown, and
+        the last tick's duration — what `./setup.sh status --json`
+        surfaces (docs/observability.md)."""
+        tel = self.telemetry
+        span_path = tel.tracer.log.path if tel.tracer.enabled else None
+        span_bytes = None
+        if span_path is not None:
+            try:
+                span_bytes = span_path.stat().st_size
+            except OSError:
+                span_bytes = 0
+        return {
+            "metrics_snapshot": (str(tel.snapshot_path)
+                                 if tel.snapshot_path is not None
+                                 else None),
+            "span_log": str(span_path) if span_path is not None else None,
+            "span_log_bytes": span_bytes,
+            "last_tick_s": self._last_tick_s,
+            "ticks_observed": int(self._c_ticks.total()),
+        }
 
     def status_doc(self, now: float) -> dict:
         """The live view = restored history + every record this run
         appended (folded incrementally by `_record`) — identical to
         re-folding the ledger, which is what the status command does
-        out-of-process, without re-reading the file every tick."""
-        return events_mod.fleet_status(self._view, now, pid=os.getpid())
+        out-of-process, without re-reading the file every tick. The
+        telemetry block records the metrics snapshot the document was
+        built alongside."""
+        return events_mod.fleet_status(
+            self._view, now, pid=os.getpid(),
+            telemetry=self.telemetry_block(),
+        )
 
     # ---------------------------------------------------------------- run
 

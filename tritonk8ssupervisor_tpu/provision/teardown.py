@@ -98,9 +98,15 @@ def clean(
     journal_mod.Journal(paths.journal).scrub()
     paths.fleet_status.unlink(missing_ok=True)
     paths.job_ack.unlink(missing_ok=True)
+    # telemetry artifacts scrub with the ledgers: the metrics snapshot
+    # is derived state, and the span log is the telemetry plane's
+    # flight record (obs/trace.py) — kept until the very end with the
+    # request journal so an interrupted clean leaves the evidence
+    paths.metrics_snapshot.unlink(missing_ok=True)
     # the gateway's request journal holds client-owed work; like the
     # event ledger it outlives every resumable step above
     paths.request_log.unlink(missing_ok=True)
+    paths.span_log.unlink(missing_ok=True)
     events_mod.EventLedger(paths.events).scrub()
     prompter.say("Clean. Re-run ./setup.sh to provision again.")
     return True

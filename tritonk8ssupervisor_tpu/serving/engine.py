@@ -68,6 +68,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from tritonk8ssupervisor_tpu.obs.trace import Tracer
 from tritonk8ssupervisor_tpu.serving import kvpool
 from tritonk8ssupervisor_tpu.serving.gateway import Request, StepResult
 
@@ -87,7 +88,9 @@ class SlotEngine:
                  prefill_chunk: int = 32, page_size: int = 32,
                  num_pages: int | None = None,
                  cache_int8: bool = False,
-                 prefix_cache: bool = True) -> None:
+                 prefix_cache: bool = True,
+                 tracer: Tracer | None = None,
+                 slice_index: int | None = None) -> None:
         import jax
         import jax.numpy as jnp
 
@@ -131,8 +134,16 @@ class SlotEngine:
         self._prefill_rr = 0
         # counters the gateway's report()/healthz surface
         self.joins = 0
+        self.steps = 0  # step boundaries that did work
         self.prefill_tokens = 0  # prompt tokens actually processed
         self.peak_slots_busy = 0
+        # per-chunk prefill spans (obs/trace.py): a real compiled
+        # dispatch is ms-scale compute, so one span line per chunk is
+        # noise next to it — and exactly the "where did the 4k prompt
+        # ride along" evidence `./setup.sh trace` reconstructs. The
+        # modeled twin deliberately emits none (sim volume).
+        self._tracer = tracer if tracer is not None else Tracer(None)
+        self._slice_index = slice_index
         # model hyperparameters, the chunk length, and the page layout
         # are compile-time constants of this engine: close over them so
         # exactly two programs exist (one prefill-chunk shape, one
@@ -247,6 +258,8 @@ class SlotEngine:
             "done": shared_n * self.page_size,  # prefix pages: prefilled
             "budget": int(request.max_new_tokens),
             "out": [],
+            "key": request.key,  # span attribution (trace <key>)
+            "rid": request.rid,
             "keys": keys,
             "pages": list(shared_pages) + list(private),
             # nothing to register when every full-prompt block matched
@@ -290,6 +303,7 @@ class SlotEngine:
             "peak_pages_in_use": self.pages.peak_in_use,
             "peak_slots_busy": self.peak_slots_busy,
             "joins": self.joins,
+            "steps": self.steps,
             "prefill_tokens": self.prefill_tokens,
             "cache_int8": self.cache_int8,
         }
@@ -317,11 +331,19 @@ class SlotEngine:
             take = min(self.prefill_chunk, remaining)
             chunk = np.zeros((self.prefill_chunk,), np.int32)  # padded
             chunk[:take] = st["tokens"][start:start + take]
+            t0 = self._tracer.now() if self._tracer.enabled else 0.0
             self.pool, logits = self._prefill_fn(
                 self.params, self.pool, jnp.asarray(chunk),
                 jnp.asarray(self.tables[slot]),
                 jnp.int32(start), jnp.int32(take - 1),
             )
+            if self._tracer.enabled:
+                self._tracer.emit(
+                    "prefill-chunk", t0, self._tracer.now(),
+                    key=st["key"], rid=st["rid"], slot=slot,
+                    slice=self._slice_index, start_token=start,
+                    tokens=take,
+                )
             st["done"] += take
             self.prefill_tokens += take
             if st["done"] >= st["tokens"].size:
@@ -364,6 +386,7 @@ class SlotEngine:
                     finished[slot] = list(st["out"])
         if not emitted and not prefilling:
             return None
+        self.steps += 1
         return StepResult(dt=0.0, emitted=emitted, finished=finished)
 
 

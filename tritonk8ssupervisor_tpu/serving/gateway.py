@@ -79,6 +79,7 @@ import time
 from collections import deque
 from typing import Any, Callable
 
+from tritonk8ssupervisor_tpu import obs as obs_mod
 from tritonk8ssupervisor_tpu.provision.fleetview import (
     FleetView,
     HealthSource,
@@ -285,6 +286,7 @@ class ModeledEngine:
         self._slots: dict = {}  # slot -> {prefill_left, budget, generated}
         self._prefill_rr = 0  # round-robin pointer over prefilling slots
         self.joins = 0
+        self.steps = 0  # step boundaries that did work
         self.prefill_tokens = 0  # prompt tokens actually prefilled
         self.peak_slots_busy = 0
 
@@ -389,6 +391,7 @@ class ModeledEngine:
             "peak_pages_in_use": self.pages.peak_in_use,
             "peak_slots_busy": self.peak_slots_busy,
             "joins": self.joins,
+            "steps": self.steps,
             "prefill_tokens": self.prefill_tokens,
             "cache_int8": False,
             "prefix": (self.prefix.stats() if self.prefix is not None
@@ -439,6 +442,7 @@ class ModeledEngine:
                 emitted[slot] = emitted.get(slot, 0) + 1
                 if st["generated"] >= st["budget"]:
                     finished[slot] = None
+        self.steps += 1
         return StepResult(dt=dt, emitted=emitted, finished=finished)
 
 
@@ -596,6 +600,7 @@ class Gateway:
         clock: Callable[[], float] = time.monotonic,
         echo: Callable[[str], None] = lambda line: None,
         reqlog: reqlog_mod.RequestLog | None = None,
+        telemetry: "obs_mod.Telemetry | None" = None,
     ) -> None:
         self.policy = policy or GatewayPolicy()
         self.buckets = SequenceBuckets(self.policy.bucket_bounds)
@@ -603,6 +608,67 @@ class Gateway:
         self._clock = clock
         self._echo = echo
         self.reqlog = reqlog
+        # The telemetry plane (obs/): the registry is ALWAYS real —
+        # report()/healthz counts read from it as the single source of
+        # truth — while spans flow only when a SpanLog is wired
+        # (./setup.sh serve, the chaos campaigns). Handles are resolved
+        # once here; the hot paths (claim, step) pay one counter inc.
+        self.telemetry = telemetry or obs_mod.Telemetry.off(clock=clock)
+        reg = self.telemetry.metrics
+        self._tracer = self.telemetry.tracer
+        self._c_submitted = reg.counter(
+            "serving_requests_submitted_total",
+            "requests offered to admission (accepted or not)")
+        self._c_accepted = reg.counter(
+            "serving_requests_accepted_total",
+            "admissions that opened a conservation obligation "
+            "(must equal the journal's ACCEPTED records)")
+        self._c_rejected = reg.counter(
+            "serving_requests_rejected_total",
+            "admission refusals by reason (400/429-class)")
+        self._c_completed = reg.counter(
+            "serving_requests_completed_total",
+            "requests served to completion")
+        self._c_expired = reg.counter(
+            "serving_requests_expired_total",
+            "504-class terminal expiries by where the time went")
+        self._c_requeued = reg.counter(
+            "serving_requests_requeued_total",
+            "in-flight work re-admitted front-of-queue by cause")
+        self._c_replayed = reg.counter(
+            "serving_requests_replayed_total",
+            "duplicate submissions answered from the request journal")
+        self._c_dispatched = reg.counter(
+            "serving_requests_dispatched_total",
+            "queue claims handed to slice workers")
+        self._c_tokens = reg.counter(
+            "serving_tokens_generated_total",
+            "tokens emitted by completed requests")
+        self._c_engine_failures = reg.counter(
+            "serving_engine_failures_total",
+            "engines that crashed mid-step (EngineLoop containment)")
+        self._h_latency = reg.histogram(
+            "serving_request_latency_seconds",
+            "arrival-to-completion latency (seconds, log buckets)")
+        self._h_queue_wait = reg.histogram(
+            "serving_queue_wait_seconds",
+            "arrival-to-dispatch queue wait of completed requests")
+        self._g_depth = reg.gauge(
+            "serving_queue_depth", "queued requests across all buckets")
+        self._g_slots_busy = reg.gauge(
+            "serving_slots_busy", "in-flight slots across all workers")
+        self._g_slots_total = reg.gauge(
+            "serving_slots_total", "decode slots across all workers")
+        self._g_slots_peak = reg.gauge(
+            "serving_slots_busy_peak",
+            "sum of per-engine peak busy slots (must stay <= total)")
+        self._g_pages_in_use = reg.gauge(
+            "serving_kv_pages_in_use", "KV pages referenced right now")
+        self._g_pages_total = reg.gauge(
+            "serving_kv_pages_total", "KV page pool capacity (bounded pools)")
+        self._g_pages_peak = reg.gauge(
+            "serving_kv_pages_in_use_peak",
+            "sum of per-engine peak pages in use")
         self.workers = {
             int(i): SliceWorker(int(i), engine, self)
             for i, engine in engines.items()
@@ -727,6 +793,9 @@ class Gateway:
             self.queues[req.bucket].appendleft(req)
             self._journal(reqlog_mod.REQUEUED, key=req.key, rid=req.rid,
                           cause=cause, retries=req.retries)
+            self._c_requeued.inc(cause=cause)
+            self._tracer.event("requeue", now, key=req.key, rid=req.rid,
+                               cause=cause, retries=req.retries)
             requeued += 1
         self.metrics.requeued += requeued
         return requeued
@@ -745,6 +814,8 @@ class Gateway:
         self.metrics.engine_failures.append(
             {"ts": now, "slice": int(index), "error": str(error)[:200]}
         )
+        self._c_engine_failures.inc()
+        self._tracer.event("engine-failure", now, slice=int(index))
         self._echo(
             f"[gateway] slice {index} engine failed ({error}): "
             f"requeued {requeued} in-flight request(s)"
@@ -787,6 +858,7 @@ class Gateway:
         now = self._clock() if now is None else now
         self.poll(now)
         self.metrics.submitted += 1
+        self._c_submitted.inc()
         request.arrival = now
         if request.deadline_s is None:
             request.deadline_s = self.policy.default_deadline_s
@@ -798,6 +870,9 @@ class Gateway:
                     # exactly-once from the client's view: the recorded
                     # result answers the duplicate, nothing regenerates
                     self.metrics.replayed += 1
+                    self._c_replayed.inc()
+                    self._tracer.event("replay", now, key=request.key,
+                                       rid=request.rid)
                     self._journal(reqlog_mod.REPLAYED, key=request.key,
                                   rid=request.rid)
                     return Admission(True, REPLAYED, None, result=result)
@@ -846,7 +921,12 @@ class Gateway:
                       **({"tokens": [int(t) for t in request.tokens]}
                          if request.tokens is not None else {}))
         self.metrics.accepted.append((now, request.rid))
+        self._c_accepted.inc()
         self.metrics.depth_samples.append((now, self.queue_depth()))
+        self._tracer.event("admission", now, key=request.key,
+                           rid=request.rid, prompt_len=request.prompt_len,
+                           max_new_tokens=request.max_new_tokens,
+                           deadline_s=request.deadline_s)
         return Admission(True)
 
     def _refuse(self, request: Request, reason: str, now: float,
@@ -858,6 +938,9 @@ class Gateway:
             "ts": now, "reason": reason, "depth": depth,
             "rid": request.rid,
         })
+        self._c_rejected.inc(reason=reason)
+        self._tracer.event("shed", now, key=request.key,
+                           rid=request.rid, reason=reason, depth=depth)
         self._journal(reqlog_mod.SHED, key=request.key, rid=request.rid,
                       reason=reason, depth=depth,
                       retry_after_s=retry_after)
@@ -926,6 +1009,11 @@ class Gateway:
                             if view is not None
                             and view.updated is not None else None),
             )
+            # hot path: ONE unlabeled counter inc — span detail for the
+            # dispatch lives in the journal record above, and the
+            # queue-wait histogram is observed at terminal settle, so
+            # the claim path stays inside the <5% overhead gate
+            self._c_dispatched.inc()
             self.metrics.depth_samples.append((now, self.queue_depth()))
             return req
 
@@ -954,6 +1042,13 @@ class Gateway:
             "served_s": served, "retries": request.retries,
         }
         self.metrics.expired.append(audit)
+        self._c_expired.inc(where=where)
+        if request.dispatched_at is not None:
+            self._h_queue_wait.observe(audit["queued_s"])
+        self._tracer.event("expiry", now, key=request.key,
+                           rid=request.rid, where=where,
+                           queued_s=audit["queued_s"], served_s=served,
+                           retries=request.retries)
         if request.key is not None:
             self._settle_key(request.key, "expired", None)
         self._journal(reqlog_mod.EXPIRED, key=request.key,
@@ -1013,10 +1108,44 @@ class Gateway:
 
     def complete(self, request: Request) -> None:
         self.metrics.completed.append(request)
-        self._completion_times.append(
-            request.done_at if request.done_at is not None
-            else self._clock()
-        )
+        done = (request.done_at if request.done_at is not None
+                else self._clock())
+        self._completion_times.append(done)
+        self._c_completed.inc()
+        self._c_tokens.inc(max(0, request.generated))
+        latency = max(0.0, done - request.arrival)
+        self._h_latency.observe(latency)
+        # the request's span set, emitted at terminal settle as ONE
+        # batched write (never on the claim/step hot paths): queue
+        # wait, prefill occupancy (dispatch -> first token), decode
+        # occupancy (first token -> done), and the terminal event the
+        # analyzers key on
+        if self._tracer.enabled:
+            spans = []
+            if request.dispatched_at is not None:
+                spans.append(("queue-wait", request.arrival,
+                              request.dispatched_at, request.key,
+                              {"rid": request.rid}))
+                first = request.first_token_at
+                if first is not None and first >= request.dispatched_at:
+                    spans.append(("prefill", request.dispatched_at,
+                                  first, request.key,
+                                  {"rid": request.rid,
+                                   "slice": request.slice_index}))
+                    spans.append(("decode", first, done, request.key,
+                                  {"rid": request.rid,
+                                   "slice": request.slice_index,
+                                   "generated": request.generated}))
+            spans.append(("complete", done, done, request.key,
+                          {"rid": request.rid,
+                           "slice": request.slice_index,
+                           "latency_s": round(latency, 6),
+                           "generated": request.generated,
+                           "retries": request.retries}))
+            self._tracer.emit_many(spans)
+        if request.dispatched_at is not None:
+            self._h_queue_wait.observe(
+                max(0.0, request.dispatched_at - request.arrival))
         if request.key is not None:
             result = {
                 "rid": request.rid,
@@ -1177,6 +1306,10 @@ class Gateway:
             self.queues[bound].appendleft(req)
             self._journal(reqlog_mod.REQUEUED, key=kv.key, rid=kv.rid,
                           cause="gateway-restart", retries=req.retries)
+            self._c_requeued.inc(cause="gateway-restart")
+            self._tracer.event("requeue", now, key=kv.key, rid=kv.rid,
+                               cause="gateway-restart",
+                               retries=req.retries)
             redone += 1
         self.metrics.requeued += redone
         if redone or expired or cached or unrecoverable:
@@ -1234,39 +1367,63 @@ class Gateway:
             "per_slice": per_slice,
         }
 
+    def update_gauges(self) -> None:
+        """Refresh the pull-derived gauges (queue depth, slot and page
+        occupancy) from the live structures. Called at scrape time
+        (GET /metrics), at snapshot writes, and by the chaos checker —
+        never on the claim/step hot paths, which is why occupancy is a
+        gauge and not per-step bookkeeping."""
+        self._g_depth.set(self.queue_depth())
+        slots_total = busy = peak = 0
+        for worker in self.workers.values():
+            slots_total += int(getattr(worker.engine, "slots", 0))
+            busy += len(worker.inflight)
+            peak += int(getattr(worker.engine, "peak_slots_busy", 0))
+        self._g_slots_total.set(slots_total)
+        self._g_slots_busy.set(busy)
+        self._g_slots_peak.set(peak)
+        engine = self.engine_report()
+        if engine is not None:
+            self._g_pages_in_use.set(engine["pages_in_use"])
+            self._g_pages_peak.set(engine["peak_pages_in_use"])
+            if engine["pages_total"] is not None:
+                self._g_pages_total.set(engine["pages_total"])
+
     def report(self) -> dict:
         """The machine-readable serving summary (the drill/bench
-        document's core)."""
+        document's core). Counts come FROM the metrics registry — the
+        single source of truth the /metrics exposition scrapes — while
+        the exact-sample latency percentiles and audit lists stay on
+        GatewayMetrics (a log-bucketed histogram would round the p99
+        the benches pin). Keys and value semantics are the pre-registry
+        schema byte-for-byte (pinned in tests/test_serving.py)."""
         m = self.metrics
-        rejects: dict = {}
-        for r in m.rejected:
-            rejects[r["reason"]] = rejects.get(r["reason"], 0) + 1
-        expired_where: dict = {}
-        for e in m.expired:
-            expired_where[e["where"]] = (
-                expired_where.get(e["where"], 0) + 1
-            )
+        rejects = {reason: int(count) for reason, count
+                   in sorted(self._c_rejected.per_label("reason").items())}
+        expired_where = {where: int(count) for where, count
+                         in sorted(self._c_expired.per_label(
+                             "where").items())}
         return {
-            "submitted": m.submitted,
-            "completed": len(m.completed),
+            "submitted": int(self._c_submitted.total()),
+            "completed": int(self._c_completed.total()),
             "rejected": rejects,
-            "requeued_after_slice_loss": m.requeued,
-            "tokens_generated": m.tokens_generated(),
+            "requeued_after_slice_loss": int(self._c_requeued.total()),
+            "tokens_generated": int(self._c_tokens.total()),
             "p50_latency_s": m.percentile(0.50),
             "p99_latency_s": m.percentile(0.99),
             "max_queue_depth": max(
                 (d for _, d in m.depth_samples), default=0
             ),
-            "expired": len(m.expired),
+            "expired": int(self._c_expired.total()),
             "expired_where": expired_where,
-            "replayed_from_journal": m.replayed,
+            "replayed_from_journal": int(self._c_replayed.total()),
             # the routing-advice audit (the no_fleet_view cold-start
             # counter lives here and in rejected["no-fleet-view"])
             "serving": {
                 "view": "ok" if self.view is not None else "none",
                 "no_fleet_view_sheds": rejects.get(
                     REJECT_NO_FLEET_VIEW, 0),
-                "engine_failures": len(m.engine_failures),
+                "engine_failures": int(self._c_engine_failures.total()),
             },
             # the paged-KV/prefix observability block (why did
             # throughput move): docs/performance.md "Engine hot path"

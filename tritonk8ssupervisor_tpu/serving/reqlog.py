@@ -107,6 +107,7 @@ class RequestLog(EventLedger):
                 f.flush()
                 os.fsync(f.fileno())
             os.replace(tmp, self.path)
+            self._drop_writer()  # the cached handle names the old inode
         dropped = len(records) - len(lines)
         self._echo(
             f"request journal compacted: {len(records)} records -> "

@@ -52,6 +52,16 @@ class EngineLoop(threading.Thread):
         self.idle_s = idle_s
         self.stop_event = threading.Event()
         self.crashed: BaseException | None = None  # last engine crash
+        # step-boundary wall time onto the gateway's registry: the
+        # engines return dt=0.0 (real compute measures itself here),
+        # and /metrics wants the distribution
+        reg = gateway.telemetry.metrics
+        self._h_step = reg.histogram(
+            "serving_engine_step_seconds",
+            "wall time of one slice worker's step boundary")
+        self._g_crashed = reg.gauge(
+            "serving_engine_crashed",
+            "1 after an engine crashed mid-step (healthz is 503)")
 
     def run(self) -> None:
         while not self.stop_event.is_set():
@@ -62,10 +72,14 @@ class EngineLoop(threading.Thread):
                     if not worker.alive:
                         continue
                     try:
-                        if worker.step(self.clock()) is not None:
+                        t0 = self.clock()
+                        if worker.step(t0) is not None:
                             advanced = True
+                            self._h_step.observe(
+                                max(0.0, self.clock() - t0))
                     except Exception as e:  # noqa: BLE001 - contained
                         self.crashed = e
+                        self._g_crashed.set(1)
                         try:
                             self.gateway.fail_worker(index, self.clock(),
                                                      error=repr(e))
@@ -115,7 +129,9 @@ def make_handler(gateway: Gateway, lock: threading.Lock,
     {"tokens": [...], "max_new_tokens": N} and optionally
     {"deadline_s": S, "idempotency_key": K}; GET /healthz reports the
     routed view (503 while shedding or after an engine crash — load
-    balancers read this)."""
+    balancers read this); GET /metrics is the Prometheus text
+    exposition of the gateway's registry (obs/metrics.py — scrape
+    example in docs/observability.md)."""
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, fmt, *args):  # noqa: N802 - stdlib name
@@ -133,6 +149,19 @@ def make_handler(gateway: Gateway, lock: threading.Lock,
             self.wfile.write(body)
 
         def do_GET(self):  # noqa: N802 - stdlib name
+            if self.path == "/metrics":
+                with lock:
+                    # pull-derived gauges refresh at scrape time — the
+                    # claim/step hot paths never pay for occupancy
+                    gateway.update_gauges()
+                    body = gateway.telemetry.metrics.render().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
             if self.path != "/healthz":
                 self._reply(404, {"error": "unknown path"})
                 return
@@ -296,6 +325,10 @@ def run_drill(gateway: Gateway, requests: int, vocab_size: int,
                 )
     finally:
         loop.stop()
+    # publish the drill's telemetry: gauges refreshed, atomic snapshot
+    # written when the gateway's Telemetry carries a snapshot path
+    gateway.update_gauges()
+    gateway.telemetry.write_snapshot()
     report = gateway.report()
     report["results"] = [_result_doc(r) for r, _ in pending
                          if r.done_at is not None]

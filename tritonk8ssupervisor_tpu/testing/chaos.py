@@ -782,6 +782,7 @@ def run_serve_campaign(
     Scheduled gateway kills drop the in-memory gateway and resume a
     fresh one from the journal. Afterwards the ServeInvariantChecker
     folds BOTH ledgers; the campaign verdict carries its violations."""
+    from tritonk8ssupervisor_tpu import obs as obs_lib
     from tritonk8ssupervisor_tpu.provision.fleetview import FileHealthSource
     from tritonk8ssupervisor_tpu.serving import gateway as gw_mod
     from tritonk8ssupervisor_tpu.serving import reqlog as reqlog_mod
@@ -825,6 +826,28 @@ def run_serve_campaign(
     reqlog = reqlog_mod.RequestLog(world.paths.request_log,
                                    clock=clock.time,
                                    echo=lambda line: None, fsync=False)
+    # ONE telemetry plane for the whole campaign, shared across gateway
+    # incarnations exactly like the reqlog (the in-process "SIGKILL"
+    # drops the gateway object, not the process): spans from both
+    # gateway lives land in one span log tagged by incarnation, and the
+    # registry's counters stay comparable to the journal's fold — the
+    # metrics-vs-ledger invariant the checker asserts at the end. The
+    # supervisor co-actor SHARES the registry and span log (metric
+    # names are disjoint; spans carry plane=supervisor).
+    span_log = obs_lib.SpanLog(world.paths.span_log, clock=clock.time,
+                               echo=lambda line: None, fsync=False)
+    registry = obs_lib.MetricsRegistry(clock=clock.time)
+    telemetry = obs_lib.Telemetry(
+        registry,
+        obs_lib.Tracer(span_log, plane=obs_lib.SERVING,
+                       clock=clock.time, incarnation=1),
+        snapshot_path=world.paths.metrics_snapshot,
+    )
+    sup_telemetry = obs_lib.Telemetry(
+        registry,
+        obs_lib.Tracer(span_log, plane=obs_lib.SUPERVISOR,
+                       clock=clock.time),
+    )
     gw_policy = gw_policy or gw_mod.GatewayPolicy(
         max_seq_len=512, slots_per_slice=4, prefill_chunk=64,
         queue_budget=32, bucket_bounds=(64, 128, 256),
@@ -843,6 +866,7 @@ def run_serve_campaign(
                 run=world.run, run_quiet=world.run_quiet, policy=policy,
                 ledger=ledger, clock=clock.time, sleep=clock.sleep,
                 rng=lambda: 0.0, readiness_timeout=60.0, hooks=clock,
+                telemetry=sup_telemetry,
             )
             supervisor.restore()
             while not stop.is_set():
@@ -865,6 +889,7 @@ def run_serve_campaign(
         return gw_mod.Gateway(
             engines, FileHealthSource(world.paths.fleet_status),
             policy=gw_policy, clock=clock.time, reqlog=reqlog,
+            telemetry=telemetry,
         )
 
     model = traffic_mod.TrafficModel(
@@ -897,6 +922,7 @@ def run_serve_campaign(
                 # request in MEMORY is gone; the journal is not
                 kill_at.pop(0)
                 kills += 1
+                telemetry.bump_incarnation()
                 gateway = make_gateway()
                 recovered = gateway.recover(now)
                 redone += recovered["redone"]
@@ -953,6 +979,11 @@ def run_serve_campaign(
 
     req_records = reqlog.replay()
     led_records = ledger.replay()
+    # final telemetry publish: gauges refreshed from the surviving
+    # gateway, then the registry snapshot the metrics-vs-ledger
+    # invariants are asserted against (and metrics.json on disk)
+    gateway.update_gauges()
+    metrics_snapshot = telemetry.write_snapshot() or registry.snapshot()
     # the worst HONEST view age: a tick that waits out up to two heal
     # waves cannot publish mid-wait, plus flap-confirm ticks either
     # side — the gateway keeps routing on its last good view throughout
@@ -961,7 +992,8 @@ def run_serve_campaign(
         staleness_bound_s=2.0 * heal_seconds + 4.0 * interval
         + gw_policy.poll_every_s,
     )
-    violations = checker.check(req_records, led_records)
+    violations = checker.check(req_records, led_records,
+                               metrics=metrics_snapshot)
     if not quiet:
         violations.append(
             f"convergence: request plane not quiescent by "
@@ -981,6 +1013,7 @@ def run_serve_campaign(
         "shed_reasons": dict(sorted(view.shed_reasons.items())),
         "gateway_kills": kills,
         "redone_after_kill": redone,
+        "spans": len(span_log.spans()),
         "violations": violations,
         "converged": quiet,
         "end_s": clock.time(),
@@ -1016,6 +1049,13 @@ class ServeInvariantChecker:
     - **cross-ledger**: the generations the gateway routed on must
       exist in the supervisor's ledger, and a breaker-open shed is only
       legal once the ledger actually shows a breaker opening.
+    - **metrics-vs-ledger** (`metrics=` a registry snapshot): the
+      telemetry plane must agree with the flight recorders it claims to
+      summarise — the accepted/completed/expired/requeued/replayed/
+      rejected counters equal the journal's fold, and the occupancy
+      gauges respect capacity (peak busy slots <= slots, peak pages <=
+      pool). A scrape surface that drifts from the ledgers is worse
+      than none: operators page off it.
     """
 
     _EPS = 1e-9
@@ -1035,7 +1075,8 @@ class ServeInvariantChecker:
             else 6.0 * self.interval_s + float(gw_policy.poll_every_s)
         )
 
-    def check(self, req_records: list, ledger_records: list = ()) -> list:
+    def check(self, req_records: list, ledger_records: list = (),
+              metrics: dict | None = None) -> list:
         violations: list = []
         violations += self.check_conservation(req_records)
         violations += self.check_no_double_service(req_records)
@@ -1045,6 +1086,9 @@ class ServeInvariantChecker:
         if ledger_records:
             violations += self.check_cross_ledger(req_records,
                                                   ledger_records)
+        if metrics is not None:
+            violations += self.check_metrics_consistency(req_records,
+                                                         metrics)
         return violations
 
     # -- 1: request conservation -----------------------------------------
@@ -1233,6 +1277,59 @@ class ServeInvariantChecker:
                     )
         return violations
 
+    # -- 7: metrics-vs-ledger consistency --------------------------------
+
+    def check_metrics_consistency(self, req_records: list,
+                                  metrics: dict) -> list:
+        """`metrics` is an obs.MetricsRegistry snapshot taken over the
+        same lifetime as the journal (the campaign shares ONE registry
+        across gateway incarnations, the way it shares the reqlog —
+        in-process kills drop the gateway object, not the telemetry
+        plane). Counters must equal the journal's fold, which survives
+        compaction; occupancy gauges must respect capacity. Retention-
+        cap evictions would relax the counter side, but campaigns never
+        reach the caps (the raw-record checkers above would notice)."""
+        from tritonk8ssupervisor_tpu.obs import metrics as metrics_mod
+
+        violations: list = []
+        view = reqlog_mod.fold(list(req_records))
+        folded = {
+            "serving_requests_accepted_total":
+                sum(kv.accepts for kv in view.keys.values()),
+            "serving_requests_completed_total":
+                sum(kv.completions for kv in view.keys.values()),
+            "serving_requests_expired_total":
+                sum(kv.expiries for kv in view.keys.values()),
+            "serving_requests_requeued_total":
+                sum(kv.requeues for kv in view.keys.values()),
+            "serving_requests_replayed_total":
+                sum(kv.replays for kv in view.keys.values()),
+            "serving_requests_rejected_total": view.sheds,
+        }
+        for name, expected in sorted(folded.items()):
+            got = metrics_mod.counter_total(metrics, name)
+            if int(got) != int(expected):
+                violations.append(
+                    f"metrics-vs-ledger: counter {name} reads "
+                    f"{int(got)} but the request journal folds to "
+                    f"{int(expected)}"
+                )
+        pairs = (
+            ("serving_slots_busy_peak", "serving_slots_total"),
+            ("serving_kv_pages_in_use_peak", "serving_kv_pages_total"),
+            ("serving_slots_busy", "serving_slots_total"),
+            ("serving_kv_pages_in_use", "serving_kv_pages_total"),
+        )
+        for used_name, cap_name in pairs:
+            used = metrics_mod.gauge_value(metrics, used_name)
+            cap = metrics_mod.gauge_value(metrics, cap_name)
+            if used is not None and cap is not None and used > cap:
+                violations.append(
+                    f"metrics-vs-ledger: gauge {used_name}={used} "
+                    f"exceeds capacity {cap_name}={cap}"
+                )
+        return violations
+
 
 def _static_status_doc(now: float, num_slices: int,
                        generation: int = 1) -> dict:
@@ -1270,6 +1367,7 @@ def run_gateway_kill_drill(
     (re-admitted front-of-queue) vs LOST (accepted but never terminal —
     must be 0), duplicates of pre-kill completions answered from the
     journal without regenerating, and restart-to-first-token MTTR."""
+    from tritonk8ssupervisor_tpu import obs as obs_lib
     from tritonk8ssupervisor_tpu.provision.fleetview import FileHealthSource
     from tritonk8ssupervisor_tpu.serving import gateway as gw_mod
     from tritonk8ssupervisor_tpu.serving import reqlog as reqlog_mod
@@ -1285,6 +1383,20 @@ def run_gateway_kill_drill(
     reqlog = reqlog_mod.RequestLog(root / "serve-requests.jsonl",
                                    clock=clock.time,
                                    echo=lambda line: None, fsync=False)
+    # spans shared across both gateway incarnations (bump at the kill):
+    # the `./setup.sh trace <key>` acceptance reads this workdir —
+    # a redone key must show spans from BOTH lives with no gap in
+    # terminal accounting (tests/test_serve_chaos.py pins it)
+    drill_paths = RunPaths(root)
+    telemetry = obs_lib.Telemetry(
+        obs_lib.MetricsRegistry(clock=clock.time),
+        obs_lib.Tracer(
+            obs_lib.SpanLog(drill_paths.span_log, clock=clock.time,
+                            echo=lambda line: None, fsync=False),
+            plane=obs_lib.SERVING, clock=clock.time, incarnation=1,
+        ),
+        snapshot_path=drill_paths.metrics_snapshot,
+    )
     policy = gw_mod.GatewayPolicy(
         max_seq_len=512, slots_per_slice=4, prefill_chunk=64,
         queue_budget=64, bucket_bounds=(64, 128, 256),
@@ -1301,7 +1413,7 @@ def run_gateway_kill_drill(
         }
         return gw_mod.Gateway(
             engines, FileHealthSource(status_path), policy=policy,
-            clock=clock.time, reqlog=reqlog,
+            clock=clock.time, reqlog=reqlog, telemetry=telemetry,
         )
 
     model = traffic_mod.TrafficModel(
@@ -1320,6 +1432,7 @@ def run_gateway_kill_drill(
     killed = False
     inflight_at_kill = queued_at_kill = 0
     redone = 0
+    redone_keys: list = []
     replays_ok = 0
     resubmitted = 0
     post_kill_metrics = None
@@ -1341,12 +1454,18 @@ def run_gateway_kill_drill(
                     len(w.inflight) for w in gateway.workers.values()
                 )
                 queued_at_kill = gateway.queue_depth()
+                pre_kill_view = reqlog_mod.fold(reqlog.replay())
                 pre_kill_done = [
                     kv.key for kv in sorted(
-                        reqlog_mod.fold(reqlog.replay()).keys.values(),
+                        pre_kill_view.keys.values(),
                         key=lambda kv: kv.key)
                     if kv.state == "completed"
                 ]
+                # the keys mid-flight at the kill — what recover() owes
+                # a terminal, and what the trace acceptance replays
+                redone_keys = [kv.key for kv
+                               in pre_kill_view.incomplete()]
+                telemetry.bump_incarnation()
                 gateway = make_gateway()  # SIGKILL: memory gone
                 recovered = gateway.recover(now)
                 redone = recovered["redone"]
@@ -1409,8 +1528,10 @@ def run_gateway_kill_drill(
     ] if post_kill_metrics is not None else []
     restart_mttr = (round(min(first_tokens_after_kill) - kill_at, 3)
                     if first_tokens_after_kill else None)
+    gateway.update_gauges()
+    metrics_snapshot = telemetry.write_snapshot()
     checker = ServeInvariantChecker(policy, interval_s=30.0)
-    violations = checker.check(records)
+    violations = checker.check(records, metrics=metrics_snapshot)
     if lost:
         violations.append(
             f"gateway-kill: {len(lost)} accepted request(s) lost "
@@ -1428,6 +1549,7 @@ def run_gateway_kill_drill(
         "inflight_at_kill": inflight_at_kill,
         "queued_at_kill": queued_at_kill,
         "requests_redone": redone,
+        "redone_keys": redone_keys,
         "requests_lost": len(lost),
         "duplicates_resubmitted": resubmitted,
         "duplicates_replayed_from_journal": replays_ok,
