@@ -1,0 +1,574 @@
+"""SLO-driven autoscaling (provision/autoscale.py + the supervisor's
+second controller): the demand-signal read contract (absent/torn/stale
+is never evidence), the hysteresis/cooldown fold, the ledger fold and
+its compact round-trip, and supervisor-level drills — confirmed
+scale-up, drain-then-teardown scale-down, drain abort on a mid-drain
+surge, SIGKILL-mid-scale resume without a double-provision, and the
+scale-thrash breaker holding the loop."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from tritonk8ssupervisor_tpu.provision import autoscale as as_mod
+from tritonk8ssupervisor_tpu.provision import events as ev
+from tritonk8ssupervisor_tpu.provision import supervisor as sup_mod
+from tritonk8ssupervisor_tpu.provision.state import atomic_write_text
+from tritonk8ssupervisor_tpu.testing import chaos
+from tritonk8ssupervisor_tpu.testing.faults import (
+    FaultPlan,
+    FaultRule,
+    SupervisorKilled,
+)
+from tritonk8ssupervisor_tpu.testing.simclock import SimClock
+
+
+def demand_doc(now, queue_depth=0, inflight=None, sheds=0, p99=None,
+               rate=None):
+    return {
+        "v": 1, "updated": now, "queue_depth": queue_depth,
+        "service_rate": rate, "p99_s": p99, "recent_sheds": sheds,
+        "deadline_headroom_s": None,
+        "inflight": {str(k): v for k, v in (inflight or {}).items()},
+        "active_workers": [],
+    }
+
+
+def write_demand(path, now, **kwargs):
+    atomic_write_text(path, json.dumps(demand_doc(now, **kwargs)))
+
+
+def signal(now, **kwargs):
+    return as_mod.parse_demand_signal(demand_doc(now, **kwargs))
+
+
+def make_autoscaler(envelope=4, **overrides):
+    policy = as_mod.AutoscalePolicy(
+        min_slices=1, max_slices=envelope, up_queue_per_slice=8.0,
+        down_queue_per_slice=2.0, slo_p99_s=30.0, confirm_up=2,
+        confirm_down=3, cooldown_s=60.0, cooldown_cap_s=600.0,
+        drain_timeout_s=120.0, signal_max_age_s=90.0,
+    )
+    for key, value in overrides.items():
+        setattr(policy, key, value)
+    return as_mod.Autoscaler(policy, envelope)
+
+
+# ------------------------------------------------ demand-signal contract
+
+
+def test_read_demand_signal_absent_torn_wrong_shape(tmp_path):
+    """Satellite pin: a missing, half-written, or wrong-shaped
+    demand-signal.json is 'unknown, retry' — NEVER a demand
+    observation (the fleet-status reader contract, applied to
+    capacity)."""
+    path = tmp_path / "demand-signal.json"
+    assert as_mod.read_demand_signal(path) is None  # absent
+    path.write_text('{"updated": 10.0, "queue_de')
+    assert as_mod.read_demand_signal(path) is None  # torn
+    path.write_text('[1, 2, 3]')
+    assert as_mod.read_demand_signal(path) is None  # wrong shape
+    path.write_text('{"queue_depth": 4}')
+    assert as_mod.read_demand_signal(path) is None  # no updated stamp
+    write_demand(path, 10.0, queue_depth=7, inflight={2: 3}, sheds=1)
+    got = as_mod.read_demand_signal(path)
+    assert got is not None
+    assert got.queue_depth == 7
+    assert got.recent_sheds == 1
+    assert got.inflight == {2: 3}
+    assert got.inflight_on([2, 3]) == 3
+
+
+def test_stale_demand_is_not_evidence():
+    """A pre-incident 'queue is empty' snapshot must never justify a
+    scale decision: observe() refuses signals older than
+    signal_max_age_s AND resets the confirmation streaks, so stale
+    windows cannot splice two half-streaks together."""
+    scaler = make_autoscaler()
+    busy = signal(100.0, queue_depth=100)
+    assert scaler.observe(busy, 2, now=100.0) is None  # streak 1
+    assert scaler.up_streak == 1
+    # same doc, read 200s later: stale — no decision, streak cleared
+    assert scaler.observe(busy, 2, now=300.0) is None
+    assert scaler.up_streak == 0
+    # and a None (torn/absent) read behaves identically
+    scaler.observe(signal(310.0, queue_depth=100), 2, now=310.0)
+    assert scaler.up_streak == 1
+    assert scaler.observe(None, 2, now=340.0) is None
+    assert scaler.up_streak == 0
+
+
+def test_demand_signal_concurrent_with_atomic_rewrite(tmp_path):
+    """Reads racing the gateway's atomic rewrite see the old or the
+    new document, never a torn one — the FileHealthSource race pin
+    (tests/test_elastic.py), applied to the demand signal."""
+    path = tmp_path / "demand-signal.json"
+    stop = threading.Event()
+
+    def writer():
+        stamp = 0
+        while not stop.is_set():
+            stamp += 1
+            write_demand(path, float(stamp), queue_depth=stamp)
+
+    thread = threading.Thread(target=writer)
+    thread.start()
+    try:
+        seen = []
+        deadline = time.monotonic() + 10.0
+        while len(seen) < 200 and time.monotonic() < deadline:
+            got = as_mod.read_demand_signal(path)
+            if got is not None:
+                seen.append(got)
+    finally:
+        stop.set()
+        thread.join()
+    assert seen, "no successful read before the 10s deadline"
+    stamps = [s.updated for s in seen]
+    assert stamps == sorted(stamps), "updated went backwards (torn read?)"
+    assert all(s.queue_depth == int(s.updated) for s in seen)
+
+
+# ------------------------------------------------------- hysteresis fold
+
+
+def test_scale_up_needs_consecutive_confirmation():
+    scaler = make_autoscaler()
+    busy = lambda t: signal(t, queue_depth=100)  # noqa: E731
+    assert scaler.observe(busy(0.0), 2, now=0.0) is None  # window 1
+    decision = scaler.observe(busy(30.0), 2, now=30.0)  # window 2
+    assert decision is not None and decision.direction == as_mod.UP
+    assert decision.windows == 2
+    assert decision.from_count == 2 and decision.to_count > 2
+
+
+def test_contrary_window_resets_the_streak():
+    scaler = make_autoscaler()
+    assert scaler.observe(signal(0.0, queue_depth=100), 2, 0.0) is None
+    # a calm window in between: the streak restarts
+    assert scaler.observe(signal(30.0, queue_depth=5), 2, 30.0) is None
+    assert scaler.observe(signal(60.0, queue_depth=100), 2, 60.0) is None
+    assert scaler.up_streak == 1
+
+
+def test_scale_down_confirmation_and_min_bound():
+    scaler = make_autoscaler()
+    idle = lambda t: signal(t, queue_depth=0)  # noqa: E731
+    assert scaler.observe(idle(0.0), 3, 0.0) is None
+    assert scaler.observe(idle(30.0), 3, 30.0) is None
+    decision = scaler.observe(idle(60.0), 3, 60.0)  # confirm_down = 3
+    assert decision is not None and decision.direction == as_mod.DOWN
+    assert decision.to_count == 2
+    # at the floor, idleness confirms nothing
+    fresh = make_autoscaler()
+    for k in range(6):
+        assert fresh.observe(idle(30.0 * k), 1, 30.0 * k) is None
+
+
+def test_scale_up_pinned_at_max_slices():
+    scaler = make_autoscaler(envelope=4, max_slices=2)
+    busy = lambda t: signal(t, queue_depth=500)  # noqa: E731
+    for k in range(5):
+        assert scaler.observe(busy(30.0 * k), 2, 30.0 * k) is None
+
+
+def test_sheds_and_slo_p99_are_up_pressure():
+    scaler = make_autoscaler()
+    shedding = signal(0.0, queue_depth=0, sheds=3)
+    assert scaler.up_reason(shedding, 2) is not None
+    slow = signal(0.0, queue_depth=0, p99=45.0)  # slo_p99_s = 30
+    assert scaler.up_reason(slow, 2) is not None
+    # and either blocks scale-down outright
+    assert scaler.down_reason(shedding, 3) is None
+
+
+def test_up_step_sized_by_backlog():
+    scaler = make_autoscaler()
+    surge = lambda t: signal(t, queue_depth=40)  # noqa: E731
+    scaler.observe(surge(0.0), 1, 0.0)
+    decision = scaler.observe(surge(30.0), 1, 30.0)
+    # backlog 40 against 8/slice on one slice: jump straight to 4+
+    # slices, clamped by max
+    assert decision.to_count == 4
+
+
+def test_cooldown_holds_without_destroying_the_streak():
+    scaler = make_autoscaler()
+    busy = lambda t: signal(t, queue_depth=100)  # noqa: E731
+    scaler.observe(busy(0.0), 2, 0.0)
+    assert scaler.observe(busy(30.0), 2, 30.0) is not None
+    until = scaler.note_action(30.0)
+    assert until > 30.0
+    # confirmed pressure inside the cooldown: held, streak grows
+    scaler.observe(busy(60.0), 3, 60.0)
+    assert scaler.observe(busy(until - 1.0), 3, until - 1.0) is None
+    # the moment the cooldown lapses, the still-confirmed streak fires
+    assert scaler.observe(busy(until + 1.0), 3, until + 1.0) is not None
+
+
+def test_policy_from_env(monkeypatch):
+    monkeypatch.setenv("TK8S_AUTOSCALE_MIN_SLICES", "2")
+    monkeypatch.setenv("TK8S_AUTOSCALE_CONFIRM_DOWN", "6")
+    monkeypatch.setenv("TK8S_AUTOSCALE_DRAIN_TIMEOUT", "450")
+    policy = as_mod.AutoscalePolicy.from_env()
+    assert policy.min_slices == 2
+    assert policy.confirm_down == 6
+    assert policy.drain_timeout_s == 450.0
+
+
+# --------------------------------------------------- ledger fold + status
+
+
+def scale_records():
+    return [
+        {"ts": 0.0, "kind": ev.SUPERVISOR_START, "autoscale": True,
+         "active": [0, 1, 2, 3]},
+        {"ts": 10.0, "kind": ev.TICK, "states": {
+            "0": "healthy", "1": "healthy", "2": "healthy",
+            "3": "healthy"}},
+        {"ts": 100.0, "kind": ev.SCALE_DECISION, "direction": "down",
+         "from_count": 4, "to_count": 3, "reason": "queue 0",
+         "windows": 3, "signal_age_s": 2.0},
+        {"ts": 100.0, "kind": ev.SCALE_START, "id": "scale-1",
+         "direction": "down", "slices": [3], "drain_deadline": 220.0,
+         "cooldown_until": 160.0},
+    ]
+
+
+def test_fold_drain_then_done_updates_membership_and_status():
+    records = scale_records()
+    view = ev.fold(records)
+    assert view.autoscale_enabled is True
+    assert view.open_scale is not None
+    assert view.slices[3].state == "draining"
+    doc = ev.fleet_status(view, now=110.0)
+    assert doc["autoscale"]["enabled"] is True
+    assert doc["autoscale"]["desired"] == 3
+    assert doc["autoscale"]["actual"] == 4  # still active while draining
+    assert doc["autoscale"]["in_progress"]["direction"] == "down"
+    assert doc["autoscale"]["cooldown_remaining_s"] == 50.0
+    assert doc["membership"]["draining"] == [3]
+    assert 3 not in doc["serving"]["eligible"]
+    gen_before = view.membership_generation
+    done = {"ts": 150.0, "kind": ev.SCALE_DONE, "id": "scale-1",
+            "direction": "down", "slices": [3], "stragglers": 0,
+            "active": [0, 1, 2]}
+    view = ev.fold(records + [done])
+    assert view.open_scale is None
+    assert view.autoscale_active == [0, 1, 2]
+    assert 3 not in view.slices  # torn down: gone from the document
+    assert view.membership_generation == gen_before + 1
+    doc = ev.fleet_status(view, now=160.0)
+    assert doc["autoscale"]["actual"] == 3
+    assert doc["autoscale"]["in_progress"] is None
+
+
+def test_fold_abort_returns_slices_to_service():
+    records = scale_records() + [
+        {"ts": 130.0, "kind": ev.SCALE_ABORT, "id": "scale-1",
+         "direction": "down", "slices": [3],
+         "reason": "demand rose mid-drain"},
+    ]
+    view = ev.fold(records)
+    assert view.open_scale is None
+    assert view.slices[3].state == "healthy"
+    assert view.scales_aborted == 1
+    assert view.scale_breaker_failures == [130.0]
+
+
+def test_scale_fold_survives_compaction(tmp_path):
+    """Compact round-trip: the open scale (the mid-scale crash
+    signature), active set, breaker state, and cooldown all survive a
+    fold-to-snapshot — fleet_status before == after."""
+    ledger = ev.EventLedger(tmp_path / "events.jsonl",
+                            clock=lambda: 999.0,
+                            echo=lambda line: None)
+    for record in scale_records() + [
+        {"ts": 140.0, "kind": ev.SCALE_BREAKER_OPEN, "reopen_at": 500.0,
+         "trip": 1},
+        {"ts": 141.0, "kind": ev.SCALE_HELD, "direction": "down"},
+    ]:
+        fields = {k: v for k, v in record.items()
+                  if k not in ("ts", "kind")}
+        ledger.append(record["kind"], **fields)
+    before = ev.fold(ledger.replay())
+    assert before.open_scale is not None
+    assert before.scale_breaker_state == "open"
+    ledger.compact()
+    after = ev.fold(ledger.replay())
+    assert (ev.fleet_status(after, 800.0)
+            == ev.fleet_status(before, 800.0))
+    assert after.open_scale["id"] == "scale-1"
+    assert after.scale_cooldown_until == 160.0
+
+
+def test_pre_autoscale_ledgers_fold_unchanged():
+    view = ev.fold([
+        {"ts": 0.0, "kind": ev.SUPERVISOR_START},
+        {"ts": 10.0, "kind": ev.TICK, "states": {"0": "healthy"}},
+    ])
+    assert view.autoscale_enabled is False
+    assert view.autoscale_active is None
+    doc = ev.fleet_status(view, now=20.0)
+    assert doc["autoscale"]["enabled"] is False
+    assert doc["autoscale"]["desired"] is None
+
+
+# ------------------------------------------- supervisor-level sim drills
+
+
+def make_scaled_world(tmp_path, num_slices=4, active=None,
+                      autoscale_overrides=None, run_fn=None,
+                      heal_seconds=30.0):
+    """A ChaosFleet + Supervisor(+Autoscaler) on one SimClock, ticked
+    by hand. `active` narrows the starting active set (the inactive
+    rest reads as torn down in the world, the white-box scale-up
+    seed)."""
+    clock = SimClock()
+    config = chaos.sim_config(num_slices)
+    world = chaos.ChaosFleet(tmp_path, clock, config,
+                             heal_seconds=heal_seconds,
+                             teardown_seconds=10.0)
+    policy = chaos.default_policy()
+    overrides = dict(confirm_up=2, confirm_down=3, cooldown_s=30.0,
+                     cooldown_cap_s=300.0, drain_timeout_s=120.0,
+                     signal_max_age_s=90.0)
+    overrides.update(autoscale_overrides or {})
+    autoscaler = make_autoscaler(envelope=num_slices, **overrides)
+    supervisor = sup_mod.Supervisor(
+        config, world.paths, chaos._Quiet(),
+        run=run_fn if run_fn is not None else world.run,
+        run_quiet=world.run_quiet,
+        policy=policy,
+        ledger=ev.EventLedger(world.paths.events, clock=clock.time,
+                              echo=lambda line: None),
+        clock=clock.time, sleep=clock.sleep, rng=lambda: 0.0,
+        readiness_timeout=60.0, hooks=clock, autoscaler=autoscaler,
+    )
+    if active is not None:
+        supervisor._active = set(active)
+        for i in set(range(num_slices)) - set(active):
+            world.removed.add(i)
+    return world, supervisor, clock
+
+
+def tick_n(supervisor, clock, world, n, interval=30.0, demand=None):
+    """Run n ticks, rewriting the demand signal freshly before each
+    (demand = dict kwargs for write_demand, or None to leave it)."""
+    for _ in range(n):
+        if demand is not None:
+            write_demand(world.paths.demand_signal, clock.time(),
+                         **demand)
+        supervisor.tick()
+        clock.sleep(interval)
+
+
+def test_supervisor_scales_up_on_confirmed_demand(tmp_path):
+    world, supervisor, clock = make_scaled_world(tmp_path,
+                                                 active=[0, 1])
+    clock.begin()
+    try:
+        supervisor.restore()
+        tick_n(supervisor, clock, world, 3,
+               demand=dict(queue_depth=60))
+    finally:
+        clock.release()
+    records = supervisor.ledger.replay()
+    kinds = [r["kind"] for r in records]
+    assert ev.SCALE_DECISION in kinds
+    starts = [r for r in records if r["kind"] == ev.SCALE_START]
+    dones = [r for r in records if r["kind"] == ev.SCALE_DONE]
+    assert starts and starts[0]["direction"] == "up"
+    assert dones and dones[0]["id"] == starts[0]["id"]
+    assert supervisor._active == {0, 1, 2, 3}
+    assert world.removed == set()
+    # the scale-up ran through the warm heal path: a scoped apply
+    assert any(2 in replaced or 3 in replaced
+               for replaced in world.applies)
+    doc = supervisor.status_doc(clock.time())
+    assert doc["autoscale"]["actual"] == 4
+
+
+def test_supervisor_drains_then_tears_down(tmp_path):
+    world, supervisor, clock = make_scaled_world(tmp_path)
+    clock.begin()
+    try:
+        supervisor.restore()
+        # three idle windows confirm the scale-down; slice 3 still
+        # holds in-flight work, so the drain WAITS
+        tick_n(supervisor, clock, world, 4,
+               demand=dict(queue_depth=0, inflight={3: 2}))
+        doc = supervisor.status_doc(clock.time())
+        assert doc["autoscale"]["in_progress"]["direction"] == "down"
+        assert doc["membership"]["draining"] == [3]
+        assert 3 not in doc["serving"]["eligible"]
+        assert world.destroys == []  # in-flight: no teardown yet
+        # the in-flight settles: the NEXT tick tears the slice down
+        tick_n(supervisor, clock, world, 1,
+               demand=dict(queue_depth=0, inflight={3: 0}))
+    finally:
+        clock.release()
+    assert world.destroys == [[3]]
+    assert world.removed == {3}
+    assert supervisor._active == {0, 1, 2}
+    records = supervisor.ledger.replay()
+    done = [r for r in records if r["kind"] == ev.SCALE_DONE]
+    assert done and done[0]["direction"] == "down"
+    assert done[0]["stragglers"] == 0
+    doc = supervisor.status_doc(clock.time())
+    assert doc["autoscale"]["actual"] == 3
+    assert doc["slices_total"] == 3  # the torn-down slice left the doc
+
+
+def test_drain_aborts_when_demand_rises(tmp_path):
+    world, supervisor, clock = make_scaled_world(tmp_path)
+    clock.begin()
+    try:
+        supervisor.restore()
+        tick_n(supervisor, clock, world, 4,
+               demand=dict(queue_depth=0, inflight={3: 2}))
+        assert supervisor._scale_open is not None
+        # the burst lands mid-drain: the next window must ABORT the
+        # drain, not tear capacity down under a surge
+        tick_n(supervisor, clock, world, 1,
+               demand=dict(queue_depth=80, inflight={3: 2}))
+    finally:
+        clock.release()
+    assert world.destroys == []
+    assert supervisor._active == {0, 1, 2, 3}
+    records = supervisor.ledger.replay()
+    aborts = [r for r in records if r["kind"] == ev.SCALE_ABORT]
+    assert aborts and "demand rose" in aborts[0]["reason"]
+    doc = supervisor.status_doc(clock.time())
+    assert doc["membership"]["draining"] == []
+    assert 3 in doc["serving"]["eligible"]
+
+
+def test_sigkill_mid_scale_down_resumes_without_sibling(tmp_path):
+    """THE mid-scale crash pin: killed inside the teardown, the
+    restarted supervisor RESUMES the open SCALE_START (same id) —
+    never a second scale, never an orphaned half-drained slice."""
+    plan = FaultPlan([FaultRule(match="terraform destroy", kill=True)],
+                     echo=lambda line: None)
+    world, supervisor, clock = make_scaled_world(tmp_path)
+    supervisor._run = plan.wrap(world.run)
+    clock.begin()
+    try:
+        supervisor.restore()
+        # three idle windows confirm and START the drain (inflight 0)
+        tick_n(supervisor, clock, world, 3,
+               demand=dict(queue_depth=0, inflight={3: 0}))
+        assert supervisor._scale_open is not None
+        # the next tick finalizes: the teardown order is where the
+        # SIGKILL lands — the open SCALE_START stays on the ledger
+        write_demand(world.paths.demand_signal, clock.time(),
+                     queue_depth=0, inflight={3: 0})
+        with pytest.raises(SupervisorKilled):
+            supervisor.tick()
+        # --- restart from the ledger (fault plan exhausted: times=1)
+        config = supervisor.config
+        restarted = sup_mod.Supervisor(
+            config, world.paths, chaos._Quiet(),
+            run=world.run, run_quiet=world.run_quiet,
+            policy=chaos.default_policy(),
+            ledger=ev.EventLedger(world.paths.events, clock=clock.time,
+                                  echo=lambda line: None),
+            clock=clock.time, sleep=clock.sleep, rng=lambda: 0.0,
+            readiness_timeout=60.0, hooks=clock,
+            autoscaler=make_autoscaler(envelope=4, confirm_up=2,
+                                       confirm_down=3),
+        )
+        restarted.restore()
+        assert restarted._scale_open is not None  # the crash signature
+        tick_n(restarted, clock, world, 1,
+               demand=dict(queue_depth=0, inflight={3: 0}))
+    finally:
+        clock.release()
+    records = restarted.ledger.replay()
+    starts = [r for r in records if r["kind"] == ev.SCALE_START]
+    dones = [r for r in records if r["kind"] == ev.SCALE_DONE]
+    assert len(starts) == 1, "resume minted a sibling scale"
+    assert len(dones) == 1 and dones[0]["id"] == starts[0]["id"]
+    assert world.destroys == [[3]]  # torn down exactly once post-kill
+    assert restarted._active == {0, 1, 2}
+    # the full record stream passes the scale invariants
+    checker = chaos.ServeInvariantChecker(
+        _gw_policy(), autoscale_policy=restarted.autoscaler.policy)
+    assert checker.check_scale_serialised(records) == []
+    assert checker.check_scale_confirmation(records) == []
+
+
+def _gw_policy():
+    from tritonk8ssupervisor_tpu.serving import gateway as gw_mod
+
+    return gw_mod.GatewayPolicy()
+
+
+def test_thrash_breaker_holds_after_repeated_aborts(tmp_path):
+    """Failed/aborted scale actions are thrash evidence: past the
+    threshold the breaker OPENs and confirmed decisions are HELD (no
+    SCALE_START), exactly what the chaos checker asserts."""
+    from tritonk8ssupervisor_tpu.provision import retry
+
+    world, supervisor, clock = make_scaled_world(
+        tmp_path, active=[0, 1],
+        autoscale_overrides=dict(cooldown_s=10.0, cooldown_cap_s=20.0))
+    # a hold long enough to outlast several decision windows, so the
+    # still-confirmed demand meets an OPEN breaker and is HELD
+    supervisor.scale_breaker = sup_mod.CircuitBreaker(
+        2, 3600.0, retry.Cooldown(600.0, 600.0, rng=lambda: 0.0)
+    )
+    world.apply_failures_remaining = 5  # every provision attempt dies
+    clock.begin()
+    try:
+        supervisor.restore()
+        tick_n(supervisor, clock, world, 8,
+               demand=dict(queue_depth=60))
+    finally:
+        clock.release()
+    records = supervisor.ledger.replay()
+    kinds = [r["kind"] for r in records]
+    assert kinds.count(ev.SCALE_ABORT) >= 2
+    assert ev.SCALE_BREAKER_OPEN in kinds
+    assert ev.SCALE_HELD in kinds
+    checker = chaos.ServeInvariantChecker(
+        _gw_policy(), autoscale_policy=supervisor.autoscaler.policy)
+    assert checker.check_scale_breaker_gate(records) == []
+    doc = supervisor.status_doc(clock.time())
+    assert doc["autoscale"]["breaker"]["state"] == "open"
+    assert doc["autoscale"]["scales"]["held"] >= 1
+
+
+def test_torn_or_stale_demand_never_scales(tmp_path):
+    """Satellite pin at the supervisor level: a torn demand file and a
+    stale one produce ZERO scale records across many windows; a fresh
+    one then scales — the machinery was live the whole time."""
+    world, supervisor, clock = make_scaled_world(tmp_path,
+                                                 active=[0, 1])
+    demand_path = world.paths.demand_signal
+    clock.begin()
+    try:
+        supervisor.restore()
+        # torn file every window
+        for _ in range(4):
+            demand_path.write_text('{"updated": 1.0, "queue_de')
+            supervisor.tick()
+            clock.sleep(30.0)
+        # a stale (never-rewritten) busy doc: not evidence either
+        write_demand(demand_path, clock.time(), queue_depth=90)
+        clock.sleep(300.0)
+        for _ in range(4):
+            supervisor.tick()
+            clock.sleep(30.0)
+        records = supervisor.ledger.replay()
+        assert [r for r in records
+                if r["kind"].startswith("scale-")] == []
+        # fresh evidence: the loop scales within two windows
+        tick_n(supervisor, clock, world, 2,
+               demand=dict(queue_depth=90))
+    finally:
+        clock.release()
+    records = supervisor.ledger.replay()
+    assert any(r["kind"] == ev.SCALE_DONE for r in records)

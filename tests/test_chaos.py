@@ -281,3 +281,191 @@ def test_supervise_policy_domain_env_overrides(monkeypatch):
     assert policy.domain_threshold == 5
     assert policy.domain_window_s == 120.0
     assert policy.quota_defer_cap_s == 450.0
+
+
+# ------------------------------------------------ autoscale invariants
+
+
+def serve_checker(**overrides):
+    from tritonk8ssupervisor_tpu.serving import gateway as gw_mod
+
+    policy = chaos.default_autoscale_policy(4)
+    for key, value in overrides.items():
+        setattr(policy, key, value)
+    return chaos.ServeInvariantChecker(
+        gw_mod.GatewayPolicy(poll_every_s=2.0),
+        autoscale_policy=policy,
+    )
+
+
+def test_checker_flags_unconfirmed_or_stale_scale_decision():
+    records = [
+        {"ts": 10.0, "kind": ev.SCALE_DECISION, "direction": "down",
+         "from_count": 4, "to_count": 3, "windows": 1,
+         "signal_age_s": 2.0},
+    ]
+    violations = serve_checker().check_scale_confirmation(records)
+    assert any("scale-confirmation" in v and "1 window" in v
+               for v in violations)
+    stale = [
+        {"ts": 10.0, "kind": ev.SCALE_DECISION, "direction": "up",
+         "from_count": 2, "to_count": 3, "windows": 2,
+         "signal_age_s": 500.0},
+    ]
+    violations = serve_checker().check_scale_confirmation(stale)
+    assert any("stale" in v for v in violations)
+    good = [
+        {"ts": 10.0, "kind": ev.SCALE_DECISION, "direction": "down",
+         "from_count": 4, "to_count": 3, "windows": 3,
+         "signal_age_s": 2.0},
+    ]
+    assert serve_checker().check_scale_confirmation(good) == []
+
+
+def test_checker_flags_scale_while_breaker_open():
+    records = [
+        {"ts": 10.0, "kind": ev.SCALE_BREAKER_OPEN, "reopen_at": 400.0},
+        {"ts": 100.0, "kind": ev.SCALE_START, "id": "s1",
+         "direction": "up", "slices": [2]},
+    ]
+    violations = serve_checker().check_scale_breaker_gate(records)
+    assert any("scale-breaker" in v for v in violations)
+    # past the reopen (the half-open probe) it is legal
+    legal = [
+        {"ts": 10.0, "kind": ev.SCALE_BREAKER_OPEN, "reopen_at": 400.0},
+        {"ts": 410.0, "kind": ev.SCALE_BREAKER_HALF_OPEN},
+        {"ts": 410.0, "kind": ev.SCALE_START, "id": "s1",
+         "direction": "up", "slices": [2]},
+    ]
+    assert serve_checker().check_scale_breaker_gate(legal) == []
+
+
+def test_checker_flags_concurrent_scales_and_cooldown_violation():
+    records = [
+        {"ts": 10.0, "kind": ev.SCALE_START, "id": "s1",
+         "direction": "down", "slices": [3], "cooldown_until": 200.0},
+        {"ts": 50.0, "kind": ev.SCALE_START, "id": "s2",
+         "direction": "up", "slices": [2], "cooldown_until": 300.0},
+        {"ts": 90.0, "kind": ev.SCALE_DONE, "id": "s1",
+         "direction": "down", "slices": [3], "active": [0, 1, 2]},
+        {"ts": 120.0, "kind": ev.SCALE_DONE, "id": "s2",
+         "direction": "up", "slices": [2], "active": [0, 1, 2]},
+    ]
+    violations = serve_checker().check_scale_serialised(records)
+    assert any("still in flight" in v for v in violations)
+    assert any("cooldown" in v for v in violations)
+    # a kill-orphaned start (never closes) + a later scale is the
+    # documented recovery path, not a violation
+    orphan = [
+        {"ts": 10.0, "kind": ev.SCALE_START, "id": "s1",
+         "direction": "up", "slices": [2], "cooldown_until": 60.0},
+        # SIGKILL: s1 never closes
+        {"ts": 700.0, "kind": ev.SCALE_START, "id": "s2",
+         "direction": "up", "slices": [2], "cooldown_until": 800.0},
+        {"ts": 760.0, "kind": ev.SCALE_DONE, "id": "s2",
+         "direction": "up", "slices": [2], "active": [0, 1, 2]},
+    ]
+    assert serve_checker().check_scale_serialised(orphan) == []
+
+
+def test_checker_flags_dispatch_to_draining_slice():
+    from tritonk8ssupervisor_tpu.serving import reqlog as rl
+
+    ledger = [
+        {"ts": 100.0, "kind": ev.SCALE_START, "id": "s1",
+         "direction": "down", "slices": [3], "drain_deadline": 220.0},
+        {"ts": 200.0, "kind": ev.SCALE_DONE, "id": "s1",
+         "direction": "down", "slices": [3], "active": [0, 1, 2]},
+    ]
+    bad = [{"ts": 150.0, "kind": rl.DISPATCHED, "key": "k1",
+            "slice": 3}]
+    violations = serve_checker().check_no_dispatch_to_draining(
+        bad, ledger)
+    assert any("dispatch-to-draining" in v for v in violations)
+    # inside the propagation grace, or on another slice: legal
+    legal = [
+        {"ts": 101.0, "kind": rl.DISPATCHED, "key": "k2", "slice": 3},
+        {"ts": 150.0, "kind": rl.DISPATCHED, "key": "k3", "slice": 1},
+        {"ts": 300.0, "kind": rl.DISPATCHED, "key": "k4", "slice": 3},
+    ]
+    assert serve_checker().check_no_dispatch_to_draining(
+        legal, ledger) == []
+
+
+# ------------------------------------------- autoscale campaigns (tier 1)
+
+
+def test_generate_autoscale_scenario_deterministic_and_covering():
+    a = chaos.generate_autoscale_scenario(42)
+    assert a == chaos.generate_autoscale_scenario(42)
+    assert a != chaos.generate_autoscale_scenario(43)
+    kinds = set()
+    for seed in range(40):
+        for event in chaos.generate_autoscale_scenario(seed).events:
+            kinds.add(event["kind"])
+    assert {"burst", "gateway-kill-mid-drain",
+            "slice-loss-mid-scale-up", "torn-demand",
+            "supervisor-kill-mid-scale"} <= kinds
+
+
+def test_autoscale_campaign_smoke_few_seeds(tmp_path):
+    """The tier-1 elasticity smoke: seeded campaigns — REAL supervisor
+    with the second controller, REAL gateway publishing demand, one
+    SimClock — converge with ZERO violations across conservation,
+    deadline honesty, and the scale invariants. Seed 1 composes the
+    gateway-kill-mid-drain primitive; seed 2 the provisioning failure
+    mid-scale-up. One diurnal period per seed keeps the smoke inside
+    the tier-1 wall budget — the full-length sweep is the chaos-marked
+    25-seed test and the committed BENCH_autoscale.json."""
+    import dataclasses as dc
+
+    for seed in (1, 2):
+        scenario = dc.replace(chaos.generate_autoscale_scenario(seed),
+                              duration_s=900.0)
+        out = chaos.run_autoscale_campaign(scenario,
+                                           tmp_path / f"seed-{seed}")
+        assert out["violations"] == [], (seed, out["events"],
+                                         out["violations"])
+        assert out["converged"] is True
+        assert out["expired"] == 0 or out["completed"] > 0
+        assert out["scales"]["started"] > 0  # the loop actually closed
+
+
+@pytest.mark.perf
+def test_autoscale_committed_baseline_still_green():
+    """The committed BENCH_autoscale.json must describe a passing run:
+    elastic cheaper than static inside the SLO, zero violations across
+    >= 25 campaigns AND the three named crash drills."""
+    import bench_provision
+
+    doc = json.loads(bench_provision.AUTOSCALE_BASELINE.read_text())
+    assert doc["passes"] is True
+    assert doc["campaigns"]["campaigns"] >= 25
+    assert doc["campaigns"]["violation_count"] == 0
+    assert doc["cost_savings_vs_static"] > 0
+    assert doc["elastic"]["p99_latency_s"] <= doc["slo_p99_s"]
+    assert doc["value"] is not None  # unattended scale-up MTTR
+    assert doc["value"] <= doc["mttr_budget_s"]
+    drills = doc["drills"]
+    assert drills["gateway_kill_mid_drain"]["redone_after_kill"] > 0
+    assert drills["slice_loss_mid_scale_up"]["scales"]["aborted"] >= 1
+    assert (drills["supervisor_kill_mid_scale"]["supervisor_restarts"]
+            >= 1)
+
+
+# --------------------------------------------- autoscale 25-seed (chaos)
+
+
+@pytest.mark.chaos
+def test_autoscale_twentyfive_seed_campaign(tmp_path):
+    """The full elasticity sweep: 25 seeded campaigns, zero scale/
+    request-plane violations, all converged — behind the chaos
+    marker (several minutes of wall clock)."""
+    failures = []
+    for seed in range(1, 26):
+        scenario = chaos.generate_autoscale_scenario(seed)
+        out = chaos.run_autoscale_campaign(scenario,
+                                           tmp_path / f"seed-{seed}")
+        if out["violations"] or not out["converged"]:
+            failures.append((seed, out["events"], out["violations"]))
+    assert failures == []

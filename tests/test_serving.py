@@ -1170,3 +1170,96 @@ def test_check_gate_covers_serve(tmp_path):
     assert not ok
     assert any("(serve)" in p for p in problems)
     assert any("(serve-chaos)" in p for p in problems)
+
+
+# ------------------------------------------- demand signal + bounded audits
+
+
+def test_gateway_publishes_demand_signal_on_poll_cadence(tmp_path):
+    """The gateway side of the autoscale loop: with a demand_path
+    wired, poll() atomically rewrites demand-signal.json at the policy
+    cadence with queue depth and per-slice in-flight; the
+    provision/autoscale reader parses it back verbatim."""
+    from tritonk8ssupervisor_tpu.provision import autoscale as as_mod
+
+    path = tmp_path / "demand-signal.json"
+    policy = gw.GatewayPolicy(max_seq_len=512,
+                              bucket_bounds=(64, 128, 256),
+                              prefill_chunk=64, slots_per_slice=2,
+                              demand_signal_every_s=5.0)
+    engines = {i: gw.ModeledEngine(slots=2, prefill_chunk=64)
+               for i in range(2)}
+    gateway = gw.Gateway(engines, None, policy=policy,
+                         demand_path=path)
+    for rid in range(3):
+        assert gateway.submit(gw.Request(rid=rid, prompt_len=32,
+                                         max_new_tokens=8), 1.0).ok
+    gateway.workers[0].step(1.0)  # claims into slots
+    gateway.publish_demand(1.5, force=True)
+    got = as_mod.read_demand_signal(path)
+    assert got is not None
+    assert got.updated == 1.5
+    assert got.queue_depth == gateway.queue_depth()
+    assert got.inflight[0] == len(gateway.workers[0].inflight)
+    assert got.inflight_on([0, 1]) >= 1
+    # inside the cadence nothing rewrites; past it poll() republishes
+    gateway.poll(3.0, force=True)
+    assert as_mod.read_demand_signal(path).updated == 1.5
+    gateway.poll(7.0, force=True)
+    assert as_mod.read_demand_signal(path).updated == 7.0
+
+
+def test_demand_signal_counts_recent_pressure_sheds(tmp_path):
+    """recent_sheds is the DELTA of load-pressure refusals since the
+    last publish — 400-class unservables are not demand."""
+    from tritonk8ssupervisor_tpu.provision import autoscale as as_mod
+
+    path = tmp_path / "demand-signal.json"
+    gateway = gw.Gateway(
+        {0: gw.ModeledEngine(slots=2, prefill_chunk=64)}, None,
+        policy=gw.GatewayPolicy(max_seq_len=512,
+                                bucket_bounds=(64,), prefill_chunk=64,
+                                queue_budget=2,
+                                demand_signal_every_s=5.0),
+        demand_path=path,
+    )
+    for rid in range(5):  # budget 2: three overload sheds
+        gateway.submit(gw.Request(rid=rid, prompt_len=32,
+                                  max_new_tokens=8), 1.0)
+    gateway.submit(gw.Request(rid=9, prompt_len=4096,
+                              max_new_tokens=8), 1.0)  # unservable
+    gateway.publish_demand(6.0, force=True)
+    assert as_mod.read_demand_signal(path).recent_sheds == 3
+    gateway.publish_demand(12.0, force=True)
+    assert as_mod.read_demand_signal(path).recent_sheds == 0  # delta
+
+
+def test_gateway_audit_trails_stay_flat_over_10k_requests():
+    """Satellite pin: the in-memory audit trails (depth samples, shed
+    and expiry audits, admission list) are BOUNDED by
+    policy.audit_retention with insertion-ordered eviction — 10k
+    requests leave them capped while the registry's counters stay
+    exact."""
+    gateway = gw.Gateway(
+        {0: gw.ModeledEngine(slots=2, prefill_chunk=64)}, None,
+        policy=gw.GatewayPolicy(max_seq_len=512, bucket_bounds=(64,),
+                                prefill_chunk=64, queue_budget=8,
+                                audit_retention=64),
+    )
+    for rid in range(10_000):
+        gateway.submit(gw.Request(rid=rid, prompt_len=32,
+                                  max_new_tokens=8), float(rid))
+    m = gateway.metrics
+    assert len(m.rejected) <= 64
+    assert len(m.accepted) <= 64
+    assert len(m.depth_samples) <= 64
+    assert len(m.expired) <= 64
+    # eviction is insertion-ordered: the newest audits survive
+    assert m.rejected[-1]["rid"] == 9_999
+    # the registry never loses a count to the cap
+    report = gateway.report()
+    assert report["submitted"] == 10_000
+    assert report["rejected"]["overload"] == 10_000 - 8
+    # retention=0 keeps the old unbounded semantics (the sim benches)
+    unbounded = gw.GatewayMetrics(retention=0)
+    assert unbounded.rejected.maxlen is None

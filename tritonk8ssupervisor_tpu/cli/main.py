@@ -223,6 +223,29 @@ def build_parser() -> argparse.ArgumentParser:
         "(env TK8S_SUPERVISE_COMPACT)",
     )
     parser.add_argument(
+        "--autoscale", action="store_true",
+        help="supervise: enable the demand-driven autoscaler — fold "
+        "the serving gateway's demand-signal.json into a desired slice "
+        "count (hysteresis + cooldown + scale-thrash breaker; "
+        "TK8S_AUTOSCALE_* env knobs) and execute it: scale-up through "
+        "the warm incremental-provision path, scale-down via "
+        "drain-then-teardown with the request journal proving no "
+        "accepted request is lost (docs/failure-modes.md, "
+        "'Elastic capacity')",
+    )
+    parser.add_argument(
+        "--min-slices", type=int, default=None, metavar="N",
+        help="supervise --autoscale: never drain below N slices "
+        "(default 1; env TK8S_AUTOSCALE_MIN_SLICES) — pin it when a "
+        "workload needs a capacity floor regardless of demand",
+    )
+    parser.add_argument(
+        "--max-slices", type=int, default=None, metavar="N",
+        help="supervise --autoscale: never provision past N slices "
+        "(default: the config's num_slices envelope; "
+        "env TK8S_AUTOSCALE_MAX_SLICES) — pin it to cap spend",
+    )
+    parser.add_argument(
         "--json", action="store_true",
         help="status: print the raw fleet-status JSON document instead "
         "of the human summary",
@@ -660,6 +683,20 @@ def supervise_cmd(args, paths: state.RunPaths, prompter: Prompter) -> int:
         ssh_user = discovery.ssh_username()
     from tritonk8ssupervisor_tpu import obs as obs_mod
 
+    autoscaler = None
+    if args.autoscale:
+        from tritonk8ssupervisor_tpu.provision import (
+            autoscale as autoscale_mod,
+        )
+
+        autoscale_policy = autoscale_mod.AutoscalePolicy.from_env()
+        if args.min_slices is not None:
+            autoscale_policy.min_slices = max(1, args.min_slices)
+        if args.max_slices is not None:
+            autoscale_policy.max_slices = max(1, args.max_slices)
+        autoscaler = autoscale_mod.Autoscaler(
+            autoscale_policy, envelope=config.num_slices
+        )
     sup = supervisor_mod.Supervisor(
         config, paths, prompter,
         run=run, run_quiet=run_quiet,
@@ -667,6 +704,7 @@ def supervise_cmd(args, paths: state.RunPaths, prompter: Prompter) -> int:
         ssh_key=str(ssh_key), ssh_user=ssh_user,
         timer=timer,
         readiness_timeout=args.readiness_timeout,
+        autoscaler=autoscaler,
         # tick/diagnose/heal-wave spans + the /metrics-shaped registry,
         # snapshotted to metrics.json every tick (docs/observability.md)
         telemetry=obs_mod.Telemetry.for_run(
@@ -817,6 +855,25 @@ def status_cmd(args, paths: state.RunPaths, prompter: Prompter) -> int:
                    if open_domains else "")
                 + (f"; outage active: {', '.join(active)}"
                    if active else "")
+            )
+        autoscale = doc.get("autoscale") or {}
+        if autoscale.get("enabled"):
+            last = autoscale.get("last_decision") or {}
+            breaker_as = autoscale.get("breaker") or {}
+            cooldown = autoscale.get("cooldown_remaining_s")
+            in_progress = autoscale.get("in_progress")
+            prompter.say(
+                f"autoscale: desired {autoscale.get('desired')} / "
+                f"actual {autoscale.get('actual')}"
+                + (f", scaling {in_progress.get('direction')} "
+                   f"{in_progress.get('slices')}"
+                   if in_progress else "")
+                + (f", last {last.get('direction')} "
+                   f"{last.get('from_count')}->{last.get('to_count')} "
+                   f"({last.get('reason')})" if last else "")
+                + f", breaker {breaker_as.get('state', 'closed')}"
+                + (f", cooldown {cooldown:.0f}s"
+                   if cooldown else "")
             )
         membership = doc.get("membership", {})
         if membership:
@@ -1034,6 +1091,10 @@ def serve_cmd(args, paths: state.RunPaths, prompter: Prompter) -> int:
         reqlog=reqlog_mod.RequestLog(paths.request_log,
                                      echo=lambda line: prompter.say(line)),
         telemetry=telemetry,
+        # the autoscaler's input: queue depth, completion rate, recent
+        # p99/sheds, per-slice in-flight — atomically rewritten on the
+        # poll cadence (provision/autoscale.py reads it back)
+        demand_path=paths.demand_signal,
     )
     # crash-resume: a restarted gateway folds its request journal —
     # incomplete work re-admitted front-of-queue, completed idempotency

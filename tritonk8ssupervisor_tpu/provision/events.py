@@ -78,6 +78,21 @@ DOMAIN_BREAKER_HALF_OPEN = "domain-breaker-half-open"  # canary gate
 DOMAIN_BREAKER_CLOSE = "domain-breaker-close"  # canary landed: gate lifts
 DOMAIN_RECOVERED = "domain-recovered"  # every slice healthy: episode over
 HEAL_DEFERRED = "heal-deferred"  # quota-parked listing page: postponed
+# Autoscale vocabulary (provision/autoscale.py): the demand-driven
+# second controller's flight record. A SCALE_START without a matching
+# SCALE_DONE/SCALE_ABORT is the mid-scale crash signature — a restarted
+# supervisor RESUMES that scale (re-runs the idempotent warm provision,
+# or continues the drain with its original deadline) instead of
+# starting a new one, so a kill can never double-provision a slice or
+# orphan a half-drained one.
+SCALE_DECISION = "scale-decision"  # confirmed desired-count change
+SCALE_START = "scale-start"  # execution began (up: provision; down: drain)
+SCALE_DONE = "scale-done"  # capacity changed; `active` is the new set
+SCALE_ABORT = "scale-abort"  # execution failed / drain aborted
+SCALE_HELD = "scale-held"  # decision confirmed but the breaker holds
+SCALE_BREAKER_OPEN = "scale-breaker-open"  # thrash breaker tripped
+SCALE_BREAKER_HALF_OPEN = "scale-breaker-half-open"  # one probe action
+SCALE_BREAKER_CLOSE = "scale-breaker-close"  # clean scale: gate lifts
 
 # Slice states the membership fold reasons about — mirrors
 # provision/heal.py's vocabulary (imported lazily there to avoid the
@@ -355,6 +370,27 @@ class LedgerView:
     breaker_reopen_at: float | None = None
     breaker_trips: int = 0
     breaker_failures: list = dataclasses.field(default_factory=list)  # ts
+    # ---- autoscale fold (provision/autoscale.py) ----
+    # `autoscale_active` is None on pre-autoscale ledgers (every
+    # configured slice is active); once any scale record lands it is
+    # the authoritative active-slice list. `open_scale` is a
+    # SCALE_START without a DONE/ABORT — the mid-scale crash signature.
+    autoscale_enabled: bool = False
+    autoscale_desired: int | None = None
+    autoscale_active: list | None = None
+    last_scale_decision: dict | None = None
+    open_scale: dict | None = None
+    scale_decisions: int = 0
+    scales_started: int = 0
+    scales_done: int = 0
+    scales_aborted: int = 0
+    scales_held: int = 0
+    scale_cooldown_until: float | None = None
+    scale_breaker_state: str = "closed"
+    scale_breaker_since: float | None = None
+    scale_breaker_reopen_at: float | None = None
+    scale_breaker_trips: int = 0
+    scale_breaker_failures: list = dataclasses.field(default_factory=list)
     open_heals: list = dataclasses.field(default_factory=list)  # records
     # heal-start id -> record, until a done/failed closes it (the list
     # above is kept in sync — it is the public face, this is the index)
@@ -412,6 +448,27 @@ def snapshot_fields(view: LedgerView) -> dict:
         "breaker_reopen_at": view.breaker_reopen_at,
         "breaker_trips": view.breaker_trips,
         "breaker_failures": list(view.breaker_failures),
+        # the autoscale fold: desired/active capacity, the open scale
+        # (mid-scale crash signature — it must survive compaction the
+        # same way orphaned heal-starts do), thrash-breaker state
+        "autoscale_enabled": view.autoscale_enabled,
+        "autoscale_desired": view.autoscale_desired,
+        "autoscale_active": (list(view.autoscale_active)
+                             if view.autoscale_active is not None
+                             else None),
+        "last_scale_decision": view.last_scale_decision,
+        "open_scale": view.open_scale,
+        "scale_decisions": view.scale_decisions,
+        "scales_started": view.scales_started,
+        "scales_done": view.scales_done,
+        "scales_aborted": view.scales_aborted,
+        "scales_held": view.scales_held,
+        "scale_cooldown_until": view.scale_cooldown_until,
+        "scale_breaker_state": view.scale_breaker_state,
+        "scale_breaker_since": view.scale_breaker_since,
+        "scale_breaker_reopen_at": view.scale_breaker_reopen_at,
+        "scale_breaker_trips": view.scale_breaker_trips,
+        "scale_breaker_failures": list(view.scale_breaker_failures),
         # orphaned heal-starts (the crash signature) survive the compact
         "pending_heals": {str(k): v for k, v in view.pending_heals.items()},
         "mttr_samples": list(view.mttr_samples),
@@ -473,6 +530,27 @@ def _apply_snapshot(view: LedgerView, record: dict) -> None:
     view.breaker_reopen_at = record.get("breaker_reopen_at")
     view.breaker_trips = record.get("breaker_trips", 0)
     view.breaker_failures = list(record.get("breaker_failures") or [])
+    view.autoscale_enabled = bool(record.get("autoscale_enabled", False))
+    view.autoscale_desired = record.get("autoscale_desired")
+    active = record.get("autoscale_active")
+    view.autoscale_active = (
+        sorted(int(i) for i in active) if active is not None else None
+    )
+    view.last_scale_decision = record.get("last_scale_decision")
+    view.open_scale = record.get("open_scale")
+    view.scale_decisions = record.get("scale_decisions", 0)
+    view.scales_started = record.get("scales_started", 0)
+    view.scales_done = record.get("scales_done", 0)
+    view.scales_aborted = record.get("scales_aborted", 0)
+    view.scales_held = record.get("scales_held", 0)
+    view.scale_cooldown_until = record.get("scale_cooldown_until")
+    view.scale_breaker_state = record.get("scale_breaker_state", "closed")
+    view.scale_breaker_since = record.get("scale_breaker_since")
+    view.scale_breaker_reopen_at = record.get("scale_breaker_reopen_at")
+    view.scale_breaker_trips = record.get("scale_breaker_trips", 0)
+    view.scale_breaker_failures = list(
+        record.get("scale_breaker_failures") or []
+    )
     view.pending_heals = dict(record.get("pending_heals") or {})
     view.open_heals = list(view.pending_heals.values())
     view.mttr_samples = list(record.get("mttr_samples") or [])
@@ -524,6 +602,12 @@ def apply(view: LedgerView, record: dict) -> LedgerView:
     if kind == SUPERVISOR_START:
         view.started = ts
         view.stopped = None
+        if record.get("autoscale"):
+            view.autoscale_enabled = True
+            if record.get("active") is not None:
+                view.autoscale_active = sorted(
+                    int(i) for i in record["active"]
+                )
     elif kind == SUPERVISOR_STOP:
         view.stopped = ts
     elif kind == TICK:
@@ -627,6 +711,85 @@ def apply(view: LedgerView, record: dict) -> LedgerView:
         view.breaker_since = ts
         view.breaker_reopen_at = None
         view.breaker_failures = []
+    elif kind == SCALE_DECISION:
+        view.autoscale_enabled = True
+        view.scale_decisions += 1
+        view.autoscale_desired = record.get("to_count")
+        view.last_scale_decision = {
+            "ts": ts,
+            "direction": record.get("direction"),
+            "from_count": record.get("from_count"),
+            "to_count": record.get("to_count"),
+            "reason": str(record.get("reason", ""))[:200],
+            "windows": record.get("windows"),
+        }
+    elif kind == SCALE_START:
+        view.autoscale_enabled = True
+        view.scales_started += 1
+        view.open_scale = record
+        if record.get("cooldown_until") is not None:
+            view.scale_cooldown_until = record["cooldown_until"]
+        if record.get("direction") == "down":
+            # draining-for-scale-down: the Router stops pulling (the
+            # membership.draining list carries these), but the slices
+            # stay ACTIVE (and billed) until SCALE_DONE removes them
+            for index in record.get("slices", []):
+                sv = view.slice_view(int(index))
+                _note_state(view, sv, _DRAINING)
+                sv.detail = "scale-down drain"
+                sv.since = ts
+    elif kind == SCALE_DONE:
+        view.autoscale_enabled = True
+        view.scales_done += 1
+        view.open_scale = None
+        if record.get("active") is not None:
+            view.autoscale_active = sorted(
+                int(i) for i in record["active"]
+            )
+        if record.get("direction") == "down":
+            for index in record.get("slices", []):
+                view.slices.pop(int(index), None)
+        else:
+            for index in record.get("slices", []):
+                sv = view.slice_view(int(index))
+                sv.state = _HEALTHY
+                sv.detail = "scaled up"
+                sv.since = ts
+        # capacity changed hands: the serving set is different, so the
+        # membership generation bumps exactly once per executed scale —
+        # the gateway requeues a removed slice's stragglers on it, and
+        # the elastic trainer re-forms over the new world
+        view.membership_generation += 1
+    elif kind == SCALE_ABORT:
+        view.autoscale_enabled = True
+        view.scales_aborted += 1
+        view.open_scale = None
+        # aborts are the thrash breaker's failure evidence (windowed,
+        # restored into the breaker on resume like heal failures)
+        view.scale_breaker_failures.append(ts)
+        if record.get("direction") == "down":
+            # the drain is called off: the slices never left service
+            for index in record.get("slices", []):
+                sv = view.slice_view(int(index))
+                _note_state(view, sv, _HEALTHY)
+                sv.detail = "scale-down aborted"
+                sv.since = ts
+    elif kind == SCALE_HELD:
+        view.autoscale_enabled = True
+        view.scales_held += 1
+    elif kind == SCALE_BREAKER_OPEN:
+        view.scale_breaker_state = "open"
+        view.scale_breaker_since = ts
+        view.scale_breaker_reopen_at = record.get("reopen_at")
+        view.scale_breaker_trips += 1
+    elif kind == SCALE_BREAKER_HALF_OPEN:
+        view.scale_breaker_state = "half-open"
+        view.scale_breaker_since = ts
+    elif kind == SCALE_BREAKER_CLOSE:
+        view.scale_breaker_state = "closed"
+        view.scale_breaker_since = ts
+        view.scale_breaker_reopen_at = None
+        view.scale_breaker_failures = []
     return view
 
 
@@ -789,6 +952,48 @@ def fleet_status(
                 "outage_active": dv.outage_active,
             }
             for dv in sorted(view.domains.values(), key=lambda d: d.name)
+        },
+        # Elastic-capacity block (provision/autoscale.py): desired vs
+        # actual slice count, the last confirmed decision with its
+        # reason, the scale in flight (mid-scale crash signature), the
+        # thrash-breaker state, and the cooldown remaining — what
+        # `./setup.sh status` renders and the runbook
+        # (docs/failure-modes.md "Elastic capacity") reads back.
+        "autoscale": {
+            "enabled": view.autoscale_enabled,
+            "desired": view.autoscale_desired,
+            "actual": (len(view.autoscale_active)
+                       if view.autoscale_active is not None
+                       else len(view.slices) or None),
+            "active": view.autoscale_active,
+            "last_decision": view.last_scale_decision,
+            "in_progress": (
+                {
+                    "id": view.open_scale.get("id"),
+                    "direction": view.open_scale.get("direction"),
+                    "slices": view.open_scale.get("slices"),
+                    "drain_deadline": view.open_scale.get(
+                        "drain_deadline"),
+                }
+                if view.open_scale is not None else None
+            ),
+            "cooldown_until": view.scale_cooldown_until,
+            "cooldown_remaining_s": (
+                round(max(0.0, view.scale_cooldown_until - now), 3)
+                if view.scale_cooldown_until is not None else None
+            ),
+            "breaker": {
+                "state": view.scale_breaker_state,
+                "reopen_at": view.scale_breaker_reopen_at,
+                "trips": view.scale_breaker_trips,
+            },
+            "scales": {
+                "decisions": view.scale_decisions,
+                "started": view.scales_started,
+                "done": view.scales_done,
+                "aborted": view.scales_aborted,
+                "held": view.scales_held,
+            },
         },
         "mttr_s": {
             "count": len(mttr),

@@ -221,6 +221,16 @@ class RunPaths:
         return self.root / "serve-requests.jsonl"
 
     @property
+    def demand_signal(self) -> Path:
+        # the serving gateway's atomically rewritten demand signal
+        # (provision/autoscale.py): queue depth, observed completion
+        # rate, recent p99/sheds, per-slice in-flight — what the
+        # supervisor's autoscaler folds into a desired slice count.
+        # Torn-read tolerant like fleet-status.json; scrubbed by
+        # teardown with the other contract files
+        return self.root / "demand-signal.json"
+
+    @property
     def span_log(self) -> Path:
         # the unified telemetry plane's span ledger (obs/trace.py):
         # request-keyed serving spans (admission -> queue-wait ->

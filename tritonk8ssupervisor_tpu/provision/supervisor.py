@@ -68,6 +68,7 @@ from typing import Callable
 
 from tritonk8ssupervisor_tpu import obs as obs_mod
 from tritonk8ssupervisor_tpu.config.schema import ClusterConfig, ConfigError
+from tritonk8ssupervisor_tpu.provision import autoscale as autoscale_mod
 from tritonk8ssupervisor_tpu.provision import events as events_mod
 from tritonk8ssupervisor_tpu.provision import heal as heal_mod
 from tritonk8ssupervisor_tpu.provision import readiness
@@ -470,6 +471,10 @@ class Supervisor:
         heal_fn=heal_mod.heal,
         hooks=None,
         telemetry: "obs_mod.Telemetry | None" = None,
+        autoscaler: "autoscale_mod.Autoscaler | None" = None,
+        demand_path=None,
+        scale_up_fn=None,
+        scale_down_fn=None,
     ) -> None:
         if config.mode != "tpu-vm":
             raise ConfigError(
@@ -538,6 +543,30 @@ class Supervisor:
         # flap/incident bookkeeping share one re-entrant lock
         self._mutex = threading.RLock()
         self._ledger_records = 0  # appended + replayed, for auto-compact
+        # ---- demand-driven autoscaling (provision/autoscale.py) ----
+        # The second controller in the reconcile loop. `_active` is the
+        # slice set the fleet currently RUNS (diagnosis, heal, and
+        # status all scope to it); with no autoscaler it is every
+        # configured slice forever — byte-identical pre-autoscale
+        # behavior. `_scale_open` mirrors the ledger's open SCALE_START
+        # (the mid-scale crash signature restore() resumes from).
+        self.autoscaler = autoscaler
+        self._demand_path = (Path(demand_path) if demand_path is not None
+                             else paths.demand_signal)
+        self._scale_up_fn = scale_up_fn or self._default_scale_up
+        self._scale_down_fn = scale_down_fn or self._default_scale_down
+        self._active: set = set(range(config.num_slices))
+        self._scale_drain: set = set()  # slices draining for scale-down
+        self._scale_open: dict | None = None
+        self._scale_seq = 0
+        self._drain_wait_logged = False
+        self.scale_breaker: CircuitBreaker | None = None
+        if autoscaler is not None:
+            ap = autoscaler.policy
+            self.scale_breaker = CircuitBreaker(
+                ap.breaker_threshold, ap.breaker_window_s,
+                retry.Cooldown(ap.cooldown_s, ap.cooldown_cap_s, rng=rng),
+            )
         # ---- telemetry plane (obs/) ----
         # The registry is always real (the status telemetry block reads
         # it); spans and metrics.json snapshots flow when supervise_cmd
@@ -572,6 +601,19 @@ class Supervisor:
         self._c_outages = reg.counter(
             "supervisor_domain_outages_total",
             "correlated-failure classifications")
+        self._c_scale = reg.counter(
+            "supervisor_autoscale_decisions_total",
+            "autoscale decision lifecycle by direction and result "
+            "(decision/start/done/abort/held)")
+        self._g_desired = reg.gauge(
+            "supervisor_slices_desired",
+            "the autoscaler's confirmed desired slice count")
+        self._g_active = reg.gauge(
+            "supervisor_slices_active",
+            "slices currently active (serving + draining-for-scale)")
+        self._g_scale_breaker = reg.gauge(
+            "supervisor_scale_breaker_state",
+            "scale-thrash breaker: 0 closed / 1 half-open / 2 open")
         self._last_tick_s: float | None = None
 
     # ----------------------------------------------------------- plumbing
@@ -653,6 +695,35 @@ class Supervisor:
             self._tracer.event("domain-outage", ts,
                                domain=record.get("domain", ""),
                                slices=record.get("slices"))
+        elif kind == events_mod.SCALE_DECISION:
+            self._c_scale.inc(direction=record.get("direction", ""),
+                              result="decision")
+            self._g_desired.set(float(record.get("to_count") or 0))
+            self._tracer.event("scale-decision", ts,
+                               direction=record.get("direction"),
+                               from_count=record.get("from_count"),
+                               to_count=record.get("to_count"),
+                               reason=record.get("reason"))
+        elif kind == events_mod.SCALE_START:
+            self._c_scale.inc(direction=record.get("direction", ""),
+                              result="start")
+        elif kind == events_mod.SCALE_DONE:
+            self._c_scale.inc(direction=record.get("direction", ""),
+                              result="done")
+            self._g_active.set(float(len(record.get("active") or [])))
+        elif kind == events_mod.SCALE_ABORT:
+            self._c_scale.inc(direction=record.get("direction", ""),
+                              result="abort")
+        elif kind == events_mod.SCALE_HELD:
+            self._c_scale.inc(direction=record.get("direction", ""),
+                              result="held")
+        elif kind in (events_mod.SCALE_BREAKER_OPEN,
+                      events_mod.SCALE_BREAKER_HALF_OPEN,
+                      events_mod.SCALE_BREAKER_CLOSE):
+            state = {"open": OPEN, "half-open": HALF_OPEN,
+                     "close": CLOSED}[kind.rsplit("-", 1)[-1]]
+            self._g_scale_breaker.set(self._BREAKER_LEVEL[state])
+            self._tracer.event("scale-breaker", ts, state=state)
         elif kind in (events_mod.BREAKER_OPEN,
                       events_mod.BREAKER_HALF_OPEN,
                       events_mod.BREAKER_CLOSE,
@@ -727,6 +798,57 @@ class Supervisor:
                 br.state = HALF_OPEN
             if dv.outage_active:
                 self._outage_active[name] = True
+        # ---- autoscale resume: active set, open scale, breaker,
+        # cooldown. An open SCALE_START is the mid-scale crash
+        # signature: the restart RESUMES that scale (idempotent warm
+        # re-provision, or the drain with its original deadline)
+        # instead of deciding a new one — no double-provision, no
+        # orphaned half-drained slice.
+        if view.autoscale_active is not None:
+            self._active = set(view.autoscale_active)
+        if view.open_scale is not None and self.autoscaler is None:
+            self.say(
+                "WARNING: the ledger holds an unfinished scale "
+                f"({view.open_scale.get('direction')} of slice(s) "
+                f"{view.open_scale.get('slices')}) but this supervisor "
+                "runs without --autoscale; restart with --autoscale to "
+                "finish it, or repair by hand (./setup.sh heal / "
+                "teardown)"
+            )
+        if view.open_scale is not None and self.autoscaler is not None:
+            self._scale_open = dict(view.open_scale)
+            if self._scale_open.get("direction") == autoscale_mod.DOWN:
+                self._scale_drain = {
+                    int(i) for i in self._scale_open.get("slices", [])
+                }
+            self.say(
+                "resuming after a crash mid-scale "
+                f"({self._scale_open.get('direction')} of slice(s) "
+                f"{', '.join(str(i) for i in self._scale_open.get('slices', []))}): "
+                "finishing that scale before any new decision"
+            )
+        if self.autoscaler is not None:
+            if view.scale_cooldown_until is not None:
+                self.autoscaler.cooldown_until = view.scale_cooldown_until
+            br = self.scale_breaker
+            br.failures = collections.deque(view.scale_breaker_failures)
+            br.trips = view.scale_breaker_trips
+            if view.scale_breaker_state == OPEN:
+                br.state = OPEN
+                br.reopen_at = (view.scale_breaker_reopen_at
+                                if view.scale_breaker_reopen_at is not None
+                                else view.last_ts)
+            elif view.scale_breaker_state == HALF_OPEN:
+                # killed mid-probe-action: resume OPEN, never a second
+                # probe while the first one's outcome is unknown (the
+                # global-breaker crash pin, applied to scaling)
+                if view.open_scale is not None:
+                    br.state = OPEN
+                    br.reopen_at = (view.scale_breaker_reopen_at
+                                    if view.scale_breaker_reopen_at
+                                    is not None else view.last_ts)
+                else:
+                    br.state = HALF_OPEN
         self._view = view
         if view.open_heals:
             slices = sorted(
@@ -751,19 +873,28 @@ class Supervisor:
         `sweep_slices`-per-tick round-robin rotation that bounds how
         long a listing-invisible drift (a drain file on a READY node)
         can stay unseen. At `num_slices <= sweep_slices` every slice is
-        swept every tick — small fleets keep the PR-5 behavior exactly."""
-        n = self.config.num_slices
+        swept every tick — small fleets keep the PR-5 behavior exactly.
+
+        Scoped to the ACTIVE slice set: a slice the autoscaler tore
+        down is not missing, it is gone on purpose — diagnosing it
+        would heal it straight back; a slice draining for scale-down is
+        the supervisor's own doing and equally exempt. With no
+        autoscaler every configured slice is active forever."""
+        candidates = sorted(self._active - self._scale_drain)
+        if not candidates:
+            return []
+        n = len(candidates)
         listing_sig: dict[int, str] | None = None
         try:
             states = self.snapshot.states()
             listing_sig = {
                 i: states.get(f"{self.config.node_prefix}-{i}", "")
-                for i in range(n)
+                for i in candidates
             }
         except Exception:  # noqa: BLE001 - listing down: SSH still decides
             pass  # keep the previous signatures; the sweep still rotates
         dirty: set[int] = set()
-        for i in range(n):
+        for i in candidates:
             cached = self._health_cache.get(i)
             if cached is None or cached.state != heal_mod.HEALTHY:
                 dirty.add(i)
@@ -771,7 +902,7 @@ class Supervisor:
                   and listing_sig[i] != self._listing_sig.get(i, "")):
                 dirty.add(i)
         for _ in range(min(max(1, self.policy.sweep_slices), n)):
-            dirty.add(self._sweep_cursor % n)
+            dirty.add(candidates[self._sweep_cursor % n])
             self._sweep_cursor = (self._sweep_cursor + 1) % n
         if listing_sig is not None:
             self._listing_sig = listing_sig
@@ -880,6 +1011,12 @@ class Supervisor:
                     "unhealthy; awaiting confirmation "
                     f"(flap threshold {self.policy.flap_threshold})"
                 )
+        # the second controller: demand signal -> desired slice count
+        # -> scale execution, AFTER heal reconcile (repairs first —
+        # scaling a broken fleet is how thrash starts) and BEFORE the
+        # publish, so this tick's status already carries the verdict
+        if self.autoscaler is not None:
+            summary["autoscale"] = self._autoscale(now)
         # tick telemetry BEFORE the publish, so the metrics snapshot
         # written next to fleet-status.json already includes this tick
         done = self._clock()
@@ -1321,6 +1458,314 @@ class Supervisor:
                 "1 snapshot (restart-resume state preserved)"
             )
 
+    # ---------------------------------------------------------- autoscale
+
+    def _default_scale_up(self, slices: list[int]) -> None:
+        """Scale-up executor: the existing warm incremental-provision
+        path. A scaled-down slice reads `missing` to the heal
+        machinery, and a slice-scoped heal IS its re-provision —
+        terraform `-replace=` scoped to exactly these slices, ansible
+        `--limit`, scoped readiness — which the PR-4 content-addressed
+        converge cache makes a ~30 s warm no-op for unchanged roles."""
+        self._heal_fn(
+            self.config, self.paths, self.prompter,
+            run=self._run, run_quiet=self._run_quiet,
+            ssh_key=self._ssh_key, ssh_user=self._ssh_user,
+            max_degraded=0,
+            readiness_timeout=self._readiness_timeout,
+            sleep=self._sleep, clock=self._clock,
+            only_slices=sorted(slices),
+        )
+
+    def _default_scale_down(self, slices: list[int]) -> None:
+        """Scale-down executor: teardown scoped to exactly the drained
+        slices (terraform destroy -target=...), never the deployment."""
+        from tritonk8ssupervisor_tpu.provision import terraform as tf_mod
+
+        tf_mod.destroy_slices(self.config, self.paths, sorted(slices),
+                              run=self._run)
+
+    def _scale_breaker_allow(self, now: float) -> bool:
+        br = self.scale_breaker
+        was_open = br.state == OPEN
+        allowed = br.allow(now)
+        if allowed and was_open and br.state == HALF_OPEN:
+            self._record(events_mod.SCALE_BREAKER_HALF_OPEN)
+            self.say("  scale breaker half-open: one probe scale action")
+        return allowed
+
+    def _scale_failure(self, now: float) -> None:
+        br = self.scale_breaker
+        if br.record_failure(now):
+            self._record(events_mod.SCALE_BREAKER_OPEN,
+                         failures=len(br.failures),
+                         reopen_at=br.reopen_at, trip=br.trips)
+            self.say(
+                f"  scale-thrash breaker OPEN (trip {br.trips}: "
+                f"{len(br.failures)} failed/aborted scale action(s)); "
+                f"no scaling until t={br.reopen_at:.0f}"
+            )
+
+    def _scale_success(self, now: float) -> None:
+        if self.scale_breaker.record_success(now):
+            self._record(events_mod.SCALE_BREAKER_CLOSE)
+            self.say("  scale-thrash breaker closed (scale landed)")
+
+    def _autoscale(self, now: float) -> dict:
+        """One autoscale window: finish any scale already in flight
+        (an open SCALE_START — possibly inherited from a crash — is
+        ALWAYS resumed before any new decision, so capacity changes are
+        strictly serialised), else fold the demand signal through the
+        hysteresis and execute a confirmed decision behind the
+        thrash breaker."""
+        out: dict = {"decision": None, "action": None}
+        if self._scale_open is not None:
+            self._progress_open_scale(now, out)
+            self._g_active.set(float(len(self._active)))
+            return out
+        signal = autoscale_mod.read_demand_signal(self._demand_path)
+        decision = self.autoscaler.observe(signal, len(self._active), now)
+        self._g_active.set(float(len(self._active)))
+        if decision is None:
+            return out
+        out["decision"] = dataclasses.asdict(decision)
+        self._record(
+            events_mod.SCALE_DECISION,
+            direction=decision.direction,
+            from_count=decision.from_count,
+            to_count=decision.to_count,
+            reason=decision.reason[:200],
+            windows=decision.windows,
+            signal_age_s=decision.signal_age_s,
+            queue_depth=signal.queue_depth,
+            recent_sheds=signal.recent_sheds,
+            p99_s=signal.p99_s,
+        )
+        self.say(
+            f"  autoscale: scale {decision.direction} "
+            f"{decision.from_count} -> {decision.to_count} "
+            f"({decision.reason}; confirmed {decision.windows} window(s))"
+        )
+        if not self._scale_breaker_allow(now):
+            self._record(events_mod.SCALE_HELD,
+                         direction=decision.direction,
+                         reopen_at=self.scale_breaker.reopen_at)
+            self.say(
+                f"  scale-thrash breaker OPEN: decision held "
+                f"(retry at t={self.scale_breaker.reopen_at:.0f})"
+            )
+            out["action"] = "held"
+            return out
+        if decision.direction == autoscale_mod.UP:
+            out["action"] = self._begin_scale_up(decision, now)
+        else:
+            out["action"] = self._begin_scale_down(decision, now)
+        return out
+
+    def _begin_scale_up(self, decision, now: float) -> str | None:
+        want = decision.to_count - decision.from_count
+        slices = sorted(
+            set(range(self.config.num_slices)) - self._active
+        )[:want]
+        if not slices:
+            return None  # envelope exhausted: nothing left to provision
+        cooldown_until = self.autoscaler.note_action(now)
+        self._scale_seq += 1
+        scale_id = f"scale-{int(now)}-{self._scale_seq}"
+        # the SCALE_START is fsync'd BEFORE any provisioning runs: a
+        # kill anywhere inside leaves the open scale on the ledger, and
+        # the restart resumes THIS scale instead of minting another
+        self._scale_open = self._record(
+            events_mod.SCALE_START, id=scale_id,
+            direction=autoscale_mod.UP, slices=slices,
+            cooldown_until=cooldown_until,
+        )
+        self.say(
+            f"  scale-up: provisioning slice(s) "
+            f"{', '.join(str(i) for i in slices)} via the warm "
+            "incremental path"
+        )
+        return self._execute_scale_up(now)
+
+    def _execute_scale_up(self, now: float) -> str:
+        """Run (or, after a crash, RE-run — the warm path is
+        idempotent) the open scale-up's provisioning."""
+        record = self._scale_open
+        slices = sorted(int(i) for i in record.get("slices", []))
+        started = self._clock()
+        try:
+            self._scale_up_fn(slices)
+        except Exception as e:  # noqa: BLE001 - BaseException (SIGKILL
+            # stand-in) must sail through UNrecorded: the open
+            # SCALE_START is the crash signature resume reads.
+            done = self._clock()
+            self._tracer.emit("scale-wave", started, done,
+                              id=record.get("id"), direction="up",
+                              slices=slices, ok=False)
+            self._record(events_mod.SCALE_ABORT, id=record.get("id"),
+                         direction=autoscale_mod.UP, slices=slices,
+                         seconds=round(done - started, 3),
+                         error=str(e)[:500])
+            self.say(
+                f"  scale-up of slice(s) "
+                f"{', '.join(str(i) for i in slices)} FAILED: {e}"
+            )
+            self._scale_open = None
+            self._scale_failure(done)
+            return "aborted"
+        done = self._clock()
+        self._tracer.emit("scale-wave", started, done,
+                          id=record.get("id"), direction="up",
+                          slices=slices, ok=True)
+        self._active.update(slices)
+        for i in slices:
+            # fresh capacity must earn fresh verdicts: no stale
+            # bookkeeping from the slice's previous life
+            self._health_cache.pop(i, None)
+            self._last_states.pop(i, None)
+            self._incidents.pop(i, None)
+            self.flaps.streaks.pop(i, None)
+        self._record(events_mod.SCALE_DONE, id=record.get("id"),
+                     direction=autoscale_mod.UP, slices=slices,
+                     seconds=round(done - started, 3),
+                     active=sorted(self._active))
+        self._scale_open = None
+        self._scale_success(done)
+        self.autoscaler.note_done()
+        self.say(
+            f"  scale-up complete: slice(s) "
+            f"{', '.join(str(i) for i in slices)} serving "
+            f"({len(self._active)} active)"
+        )
+        return "scaled-up"
+
+    def _begin_scale_down(self, decision, now: float) -> str:
+        count = max(1, decision.from_count - decision.to_count)
+        # drain the highest-index active slices: deterministic, and the
+        # low indices hold the coordinator/anchor roles
+        slices = sorted(sorted(self._active, reverse=True)[:count])
+        cooldown_until = self.autoscaler.note_action(now)
+        self._scale_seq += 1
+        scale_id = f"scale-{int(now)}-{self._scale_seq}"
+        deadline = now + self.autoscaler.policy.drain_timeout_s
+        self._scale_open = self._record(
+            events_mod.SCALE_START, id=scale_id,
+            direction=autoscale_mod.DOWN, slices=slices,
+            drain_deadline=deadline, cooldown_until=cooldown_until,
+        )
+        self._scale_drain = set(slices)
+        self._drain_wait_logged = False
+        self.say(
+            f"  scale-down: draining slice(s) "
+            f"{', '.join(str(i) for i in slices)} — the Router stops "
+            f"pulling; teardown when in-flight settles "
+            f"(deadline t={deadline:.0f})"
+        )
+        return "draining"
+
+    def _progress_open_scale(self, now: float, out: dict) -> None:
+        record = self._scale_open
+        if record.get("direction") == autoscale_mod.UP:
+            out["action"] = self._execute_scale_up(now)
+            return
+        slices = sorted(int(i) for i in record.get("slices", []))
+        signal = autoscale_mod.read_demand_signal(self._demand_path)
+        fresh = self.autoscaler.fresh(signal, now)
+        serving = max(1, len(self._active) - len(slices))
+        surge = (self.autoscaler.up_reason(signal, serving)
+                 if fresh else None)
+        if surge is not None:
+            # a burst landed DURING the scale-down: aborting the drain
+            # is cheap (the slices never left service) and honest —
+            # finishing the teardown just to re-provision next window
+            # is the thrash the breaker exists to stop, so the abort
+            # also counts as its failure evidence.
+            self._record(events_mod.SCALE_ABORT, id=record.get("id"),
+                         direction=autoscale_mod.DOWN, slices=slices,
+                         reason=f"demand rose mid-drain: {surge}"[:200])
+            self.say(
+                f"  scale-down ABORTED: demand rose mid-drain ({surge});"
+                f" slice(s) {', '.join(str(i) for i in slices)} return "
+                "to service"
+            )
+            self._scale_open = None
+            self._scale_drain.clear()
+            self._drain_wait_logged = False
+            self._scale_failure(now)
+            out["action"] = "drain-aborted"
+            return
+        settled = fresh and signal.inflight_on(slices) == 0
+        deadline = record.get("drain_deadline")
+        if not settled and (deadline is None or now < deadline):
+            if not self._drain_wait_logged:
+                inflight = (signal.inflight_on(slices)
+                            if fresh else "unknown")
+                self.say(
+                    f"  scale-down: waiting for slice(s) "
+                    f"{', '.join(str(i) for i in slices)} to drain "
+                    f"({inflight} in flight)"
+                )
+                self._drain_wait_logged = True
+            out["action"] = "draining"
+            return
+        stragglers = signal.inflight_on(slices) if fresh else None
+        out["action"] = self._finalize_scale_down(record, slices,
+                                                  stragglers, now)
+
+    def _finalize_scale_down(self, record: dict, slices: list[int],
+                             stragglers, now: float) -> str:
+        started = self._clock()
+        try:
+            self._scale_down_fn(slices)
+        except Exception as e:  # noqa: BLE001 - same crash discipline
+            done = self._clock()
+            self._tracer.emit("scale-wave", started, done,
+                              id=record.get("id"), direction="down",
+                              slices=slices, ok=False)
+            self._record(events_mod.SCALE_ABORT, id=record.get("id"),
+                         direction=autoscale_mod.DOWN, slices=slices,
+                         seconds=round(done - started, 3),
+                         error=str(e)[:500])
+            self.say(
+                f"  scale-down teardown of slice(s) "
+                f"{', '.join(str(i) for i in slices)} FAILED: {e}"
+            )
+            self._scale_open = None
+            self._scale_drain.clear()
+            self._drain_wait_logged = False
+            self._scale_failure(done)
+            return "aborted"
+        done = self._clock()
+        self._tracer.emit("scale-wave", started, done,
+                          id=record.get("id"), direction="down",
+                          slices=slices, ok=True)
+        self._active.difference_update(slices)
+        for i in slices:
+            self._health_cache.pop(i, None)
+            self._last_states.pop(i, None)
+            self._incidents.pop(i, None)
+            self.flaps.streaks.pop(i, None)
+            self._suppress_logged.discard(i)
+            self._defer_logged.discard(i)
+        self._record(events_mod.SCALE_DONE, id=record.get("id"),
+                     direction=autoscale_mod.DOWN, slices=slices,
+                     seconds=round(done - started, 3),
+                     stragglers=stragglers,
+                     active=sorted(self._active))
+        self._scale_open = None
+        self._scale_drain.clear()
+        self._drain_wait_logged = False
+        self._scale_success(done)
+        self.autoscaler.note_done()
+        extra = (f"; {stragglers} straggler(s) requeue via the "
+                 "membership bump" if stragglers else "")
+        self.say(
+            f"  scale-down complete: slice(s) "
+            f"{', '.join(str(i) for i in slices)} torn down "
+            f"({len(self._active)} active{extra})"
+        )
+        return "scaled-down"
+
     # ------------------------------------------------------------- status
 
     def _publish(self, now: float) -> None:
@@ -1386,6 +1831,14 @@ class Supervisor:
             ) from e
         try:
             self.restore()
+            autoscale_fields = {}
+            if self.autoscaler is not None:
+                autoscale_fields = {
+                    "autoscale": True,
+                    "active": sorted(self._active),
+                    "min_slices": self.autoscaler.min_slices,
+                    "max_slices": self.autoscaler.max_slices,
+                }
             self._record(
                 events_mod.SUPERVISOR_START, pid=os.getpid(),
                 interval=self.policy.interval,
@@ -1395,6 +1848,7 @@ class Supervisor:
                 breaker_threshold=self.policy.breaker_threshold,
                 max_degraded=self.policy.max_degraded,
                 failure_domains=len(set(self._domains.values())),
+                **autoscale_fields,
             )
             self.say(
                 f"supervising {self.config.num_slices} slice(s) every "

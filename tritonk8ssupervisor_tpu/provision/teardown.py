@@ -98,6 +98,10 @@ def clean(
     journal_mod.Journal(paths.journal).scrub()
     paths.fleet_status.unlink(missing_ok=True)
     paths.job_ack.unlink(missing_ok=True)
+    # the gateway's demand signal is derived state like fleet-status:
+    # scrubbed with the contract files so a fresh run's autoscaler can
+    # never read a previous deployment's queue as evidence
+    paths.demand_signal.unlink(missing_ok=True)
     # telemetry artifacts scrub with the ledgers: the metrics snapshot
     # is derived state, and the span log is the telemetry plane's
     # flight record (obs/trace.py) — kept until the very end with the

@@ -225,6 +225,41 @@ def collect_outputs(
     )
 
 
+def slice_target_addresses(slice_indices: list[int]) -> list[str]:
+    """Terraform `-target=` addresses for the named slice instances —
+    the scale-down sibling of `slice_replace_addresses`: destroy ONLY
+    these slices, leaving every other slice's state entry untouched."""
+    return [f"-target=google_tpu_v2_vm.slice[{i}]"
+            for i in sorted(set(slice_indices))]
+
+
+def destroy_slices(
+    config: ClusterConfig,
+    paths: RunPaths,
+    slice_indices: list[int],
+    run: run_mod.RunFn = run_mod.run_streaming,
+) -> None:
+    """Scale-down-scoped teardown: destroy ONLY the named (drained)
+    slices of the tpu-vm module's count fan-out. The autoscaler's
+    drain-then-teardown path (provision/supervisor.py) calls this after
+    the request journal shows the slice's in-flight work settled —
+    never the whole-deployment `destroy`, which is teardown's job."""
+    if config.mode != "tpu-vm":
+        raise ConfigError(
+            "slice-scoped destroy is a tpu-vm operation; gke capacity "
+            "is the node pool autoscaler's job"
+        )
+    if not slice_indices:
+        raise ValueError("destroy_slices needs at least one slice index")
+    run(
+        ["terraform", "destroy", "-auto-approve", "-input=false",
+         "-no-color", "-lock-timeout=600s"]
+        + slice_target_addresses(slice_indices),
+        cwd=paths.terraform_module(config.mode),
+        env=terraform_env(paths),
+    )
+
+
 def destroy(
     config: ClusterConfig,
     paths: RunPaths,

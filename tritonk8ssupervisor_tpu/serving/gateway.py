@@ -75,8 +75,10 @@ Knobs and the BENCH_serve.json reading guide: docs/performance.md,
 from __future__ import annotations
 
 import dataclasses
+import json
 import time
 from collections import deque
+from pathlib import Path
 from typing import Any, Callable
 
 from tritonk8ssupervisor_tpu import obs as obs_mod
@@ -225,6 +227,16 @@ class GatewayPolicy:
     pages_per_slice: int | None = None
     # cross-request prefix/KV reuse (the shared-system-prompt lever)
     prefix_cache: bool = True
+    # long-running-server bound on the in-memory audit trails
+    # (GatewayMetrics.depth_samples and the shed/expiry/admission audit
+    # lists): past this many entries the oldest are evicted in
+    # insertion order — the registry's counters stay exact forever, the
+    # trails keep a bounded recent window (0 = unbounded)
+    audit_retention: int = 65536
+    # demand-signal publish cadence (provision/autoscale.py): with a
+    # demand_path wired, the gateway atomically rewrites
+    # demand-signal.json at most this often, piggybacked on poll()
+    demand_signal_every_s: float = 5.0
 
 
 @dataclasses.dataclass
@@ -449,15 +461,27 @@ class ModeledEngine:
 class GatewayMetrics:
     """What the benches and `status` read back: completions, refusals
     (with the queue depth that justified each — the "sheds only while
-    the budget demands it" audit trail), depth samples, and reroutes."""
+    the budget demands it" audit trail), depth samples, and reroutes.
 
-    def __init__(self) -> None:
+    The audit trails are BOUNDED (`retention`, insertion-ordered deque
+    eviction): on a long-running server every admission and every shed
+    used to append forever, so memory grew with requests-ever-served.
+    The exact lifetime counts live in the metrics registry (the single
+    source of truth report() reads); these lists are the recent-window
+    evidence — depth that justified a shed, where an expiry's time
+    went. The 10k-request flatness pin lives in tests/test_serving.py.
+    `retention=0` keeps the unbounded pre-cap semantics (virtual-clock
+    benches that scan the whole run's audit trail)."""
+
+    def __init__(self, retention: int = 0) -> None:
+        maxlen = int(retention) if retention and int(retention) > 0 \
+            else None
         self.completed: list[Request] = []
-        self.rejected: list[dict] = []
-        self.accepted: list[tuple] = []  # (ts, rid): admissions
-        self.depth_samples: list[tuple] = []  # (ts, depth)
-        self.expired: list[dict] = []  # terminal deadline audits
-        self.engine_failures: list[dict] = []  # EngineLoop crash audits
+        self.rejected: deque = deque(maxlen=maxlen)
+        self.accepted: deque = deque(maxlen=maxlen)  # (ts, rid)
+        self.depth_samples: deque = deque(maxlen=maxlen)  # (ts, depth)
+        self.expired: deque = deque(maxlen=maxlen)  # terminal audits
+        self.engine_failures: deque = deque(maxlen=maxlen)
         self.requeued = 0
         self.submitted = 0
         self.replayed = 0  # duplicates answered from the journal
@@ -601,6 +625,7 @@ class Gateway:
         echo: Callable[[str], None] = lambda line: None,
         reqlog: reqlog_mod.RequestLog | None = None,
         telemetry: "obs_mod.Telemetry | None" = None,
+        demand_path=None,
     ) -> None:
         self.policy = policy or GatewayPolicy()
         self.buckets = SequenceBuckets(self.policy.bucket_bounds)
@@ -674,10 +699,23 @@ class Gateway:
             for i, engine in engines.items()
         }
         self.queues: dict = {b: deque() for b in self.buckets.bounds}
-        self.metrics = GatewayMetrics()
+        self.metrics = GatewayMetrics(
+            retention=self.policy.audit_retention
+        )
         self.view: FleetView | None = None
         self._last_poll: float | None = None
         self._last_membership: tuple | None = None
+        # demand-signal publishing (provision/autoscale.py): with a
+        # path wired, poll() piggybacks an atomic demand-signal.json
+        # rewrite at the policy cadence — queue depth, observed
+        # completion rate, recent p99/sheds, per-slice in-flight — the
+        # supervisor's autoscaler input. None = not publishing (the
+        # pre-autoscale behavior, and every standalone drill's).
+        self._demand_path = (Path(demand_path)
+                             if demand_path is not None else None)
+        self._last_demand_pub: float | None = None
+        self._sheds_at_last_pub = 0
+        self._recent_latencies: deque = deque(maxlen=128)
         # idempotency-key index: key -> ("inflight", None) |
         # ("completed", result) | ("expired", None). Seeded by recover()
         # from the journal, kept live by submit/complete/expire.
@@ -705,6 +743,7 @@ class Gateway:
                 and now - self._last_poll < self.policy.poll_every_s):
             return self.view
         self._last_poll = now
+        self.publish_demand(now)
         if self._health is None:
             return None
         got = self._health.poll()
@@ -712,6 +751,75 @@ class Gateway:
             self.view = got
             self._reconcile_membership(now)
         return self.view
+
+    def recent_p99(self) -> float | None:
+        """p99 latency over the RECENT completion window (the demand
+        signal's SLO evidence) — the lifetime percentile the report
+        carries would never recover after one bad hour."""
+        window = sorted(self._recent_latencies)
+        if not window:
+            return None
+        idx = min(len(window) - 1,
+                  max(0, int(round(0.99 * (len(window) - 1)))))
+        return window[idx]
+
+    def _pressure_sheds(self) -> int:
+        """Lifetime count of load-pressure refusals (overload, breaker,
+        no capacity, deadline-unmeetable) from the registry — 400-class
+        unservables and duplicate refusals are not demand evidence."""
+        per_reason = self._c_rejected.per_label("reason")
+        return int(sum(
+            count for reason, count in per_reason.items()
+            if reason in (REJECT_OVERLOAD, REJECT_BREAKER,
+                          REJECT_NO_CAPACITY, REJECT_DEADLINE)
+        ))
+
+    def publish_demand(self, now: float, force: bool = False) -> bool:
+        """Atomically rewrite demand-signal.json (provision/autoscale
+        schema-of-record, docs/failure-modes.md "Elastic capacity"):
+        what the supervisor's autoscaler folds into a desired slice
+        count, and what its drain-then-teardown path watches to learn a
+        DRAINING slice's in-flight work has settled. Torn-read
+        tolerance is the READER's discipline; this side only promises
+        old-or-new, never a blend (temp + os.replace)."""
+        if self._demand_path is None:
+            return False
+        if (not force and self._last_demand_pub is not None
+                and now - self._last_demand_pub
+                < self.policy.demand_signal_every_s):
+            return False
+        self._last_demand_pub = now
+        sheds_total = self._pressure_sheds()
+        recent_sheds = max(0, sheds_total - self._sheds_at_last_pub)
+        self._sheds_at_last_pub = sheds_total
+        wait = self.estimated_queue_wait()
+        headroom = None
+        if self.policy.default_deadline_s is not None and wait is not None:
+            headroom = round(float(self.policy.default_deadline_s)
+                             - wait, 3)
+        doc = {
+            "v": 1,
+            "updated": now,
+            "queue_depth": self.queue_depth(),
+            "service_rate": self.service_rate(),
+            "p99_s": self.recent_p99(),
+            "recent_sheds": recent_sheds,
+            "deadline_headroom_s": headroom,
+            "inflight": {
+                str(i): len(w.inflight)
+                for i, w in sorted(self.workers.items())
+            },
+            "active_workers": sorted(
+                i for i, w in self.workers.items() if w.alive
+            ),
+        }
+        from tritonk8ssupervisor_tpu.provision.state import (
+            atomic_write_text,
+        )
+
+        atomic_write_text(self._demand_path,
+                          json.dumps(doc, sort_keys=True) + "\n")
+        return True
 
     def eligible_slices(self) -> list[int]:
         """Route-eligible slices among the workers this gateway runs.
@@ -1115,6 +1223,7 @@ class Gateway:
         self._c_tokens.inc(max(0, request.generated))
         latency = max(0.0, done - request.arrival)
         self._h_latency.observe(latency)
+        self._recent_latencies.append(latency)
         # the request's span set, emitted at terminal settle as ONE
         # batched write (never on the claim/step hot paths): queue
         # wait, prefill occupancy (dispatch -> first token), decode

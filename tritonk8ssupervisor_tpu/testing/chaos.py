@@ -98,24 +98,37 @@ class ChaosFleet:
     parallel heal waves drive it from several workers at once."""
 
     def __init__(self, root: Path, clock, config: ClusterConfig,
-                 heal_seconds: float = 120.0) -> None:
+                 heal_seconds: float = 120.0,
+                 teardown_seconds: float = 10.0) -> None:
         self.paths = RunPaths(Path(root))
         self.paths.terraform_module("tpu-vm").mkdir(parents=True,
                                                     exist_ok=True)
         self.config = config
         self.clock = clock
         self.heal_seconds = heal_seconds
+        self.teardown_seconds = teardown_seconds
         n = config.num_slices
         self.num_slices = n
         self.down: set = set()
         self.down_at: list = []  # (ts, slice)
+        # slices the autoscaler tore down ON PURPOSE (terraform destroy
+        # -target): absent from the listing like `down`, but the
+        # supervisor's active-set scoping means nothing diagnoses or
+        # heals them; a scale-up's scoped apply brings them back
+        self.removed: set = set()
         # heals into these slices do not stick until the given ts
         # (a truly dead compartment: replace "succeeds" but readiness
         # never does) — inf means never
         self.heal_refuses: dict = {}  # slice -> until ts
+        # the next N terraform applies FAIL (CommandError) — the
+        # slice-loss-mid-scale-up primitive: provisioning new capacity
+        # dies under the autoscaler, which must SCALE_ABORT and retry
+        # behind its cooldown/breaker instead of double-provisioning
+        self.apply_failures_remaining = 0
         self.quota_windows: list = []  # (start, until)
         self.flap_windows: dict = {}  # slice -> (start, until, period)
         self.applies: list = []
+        self.destroys: list = []  # scale-down teardown orders
         self._lock = threading.Lock()
         self.ips = {i: f"10.0.{i}.1" for i in range(n)}
         ClusterHosts(
@@ -162,11 +175,13 @@ class ChaosFleet:
                 self.down_at.remove((at, i))
 
     def down_now(self) -> set:
-        """The currently-down slice set at this virtual instant — what
-        the serve-chaos driver syncs its engine liveness against."""
+        """The currently-dead slice set at this virtual instant — what
+        the serve-chaos driver syncs its engine liveness against.
+        Includes slices the autoscaler tore down: their engines are
+        gone exactly like a preempted slice's, on purpose."""
         with self._lock:
             self._sync_locked()
-            return set(self.down)
+            return set(self.down) | set(self.removed)
 
     def _quota_throttled(self, now: float) -> bool:
         return any(start <= now < until
@@ -192,13 +207,34 @@ class ChaosFleet:
                         for a in args if str(a).startswith("-replace=")]
             with self._lock:
                 self.applies.append(replaced)
+                failing = self.apply_failures_remaining > 0
+                if failing:
+                    self.apply_failures_remaining -= 1
+            if failing:
+                # capacity died mid-provision (quota pulled, stockout):
+                # the apply burns time, then fails
+                self.clock.sleep(self.heal_seconds / 2.0)
+                raise CommandError(list(args), 1,
+                                   tail="Error: resource exhausted "
+                                        "mid-apply (scripted)")
             self.clock.sleep(self.heal_seconds)
             now = self.clock.time()
             with self._lock:
                 for i in replaced:
                     if now >= self.heal_refuses.get(i, float("-inf")):
                         self.down.discard(i)
+                        self.removed.discard(i)
                         self.ips[i] = f"10.9.{i}.{len(self.applies)}"
+        elif line.startswith("terraform destroy"):
+            targets = [int(str(a).split("[")[1].rstrip("]"))
+                       for a in args if str(a).startswith("-target=")]
+            with self._lock:
+                self.destroys.append(targets)
+            self.clock.sleep(self.teardown_seconds)
+            with self._lock:
+                for i in targets:
+                    self.removed.add(i)
+                    self.down.discard(i)
         return ""
 
     def run_quiet(self, args, cwd=None, **kwargs) -> str:
@@ -219,7 +255,8 @@ class ChaosFleet:
                     raise CommandError(list(args), 1, tail=QUOTA_OUTPUT)
                 return "\n".join(
                     f"{self.config.node_prefix}-{i}\tREADY"
-                    for i in range(self.num_slices) if i not in self.down
+                    for i in range(self.num_slices)
+                    if i not in self.down and i not in self.removed
                 )
             if args and args[0] == "ssh":
                 ip = args[-2]
@@ -228,7 +265,7 @@ class ChaosFleet:
                 )
                 if "cat" in args[-1]:
                     return ""  # no drain files in chaos scenarios
-                if index in self.down or (
+                if index in self.down or index in self.removed or (
                     index is not None and self._flapping(index, now)
                 ):
                     raise CommandError(list(args), 255)
@@ -1067,12 +1104,24 @@ class ServeInvariantChecker:
                        "recover-unrecoverable")
 
     def __init__(self, gw_policy, interval_s: float = 30.0,
-                 staleness_bound_s: float | None = None) -> None:
+                 staleness_bound_s: float | None = None,
+                 autoscale_policy=None,
+                 drain_grace_s: float | None = None) -> None:
         self.policy = gw_policy
         self.interval_s = float(interval_s)
         self.staleness_bound_s = (
             float(staleness_bound_s) if staleness_bound_s is not None
             else 6.0 * self.interval_s + float(gw_policy.poll_every_s)
+        )
+        # the autoscale contract (provision/autoscale.py): set when the
+        # campaign ran the second controller. drain_grace_s is the
+        # propagation window between a SCALE_START(down) landing on the
+        # ledger and the gateway's Router observing the draining list —
+        # one status publish (same tick) plus a poll interval.
+        self.autoscale_policy = autoscale_policy
+        self.drain_grace_s = (
+            float(drain_grace_s) if drain_grace_s is not None
+            else 2.0 * float(gw_policy.poll_every_s) + 1.0
         )
 
     def check(self, req_records: list, ledger_records: list = (),
@@ -1089,6 +1138,12 @@ class ServeInvariantChecker:
         if metrics is not None:
             violations += self.check_metrics_consistency(req_records,
                                                          metrics)
+        if self.autoscale_policy is not None and ledger_records:
+            violations += self.check_scale_confirmation(ledger_records)
+            violations += self.check_scale_breaker_gate(ledger_records)
+            violations += self.check_scale_serialised(ledger_records)
+            violations += self.check_no_dispatch_to_draining(
+                req_records, ledger_records)
         return violations
 
     # -- 1: request conservation -----------------------------------------
@@ -1331,6 +1386,151 @@ class ServeInvariantChecker:
         return violations
 
 
+    # -- 8: autoscale — confirmed windows on fresh evidence ----------------
+
+    def check_scale_confirmation(self, ledger_records: list) -> list:
+        """Every SCALE_DECISION must carry a confirming streak at least
+        as long as the policy demands for its direction, built on a
+        FRESH signal — a decision on one window (or on a stale
+        document) is the hysteresis contract broken."""
+        ap = self.autoscale_policy
+        violations: list = []
+        for idx, r in enumerate(ledger_records):
+            if r.get("kind") != events_mod.SCALE_DECISION:
+                continue
+            need = (ap.confirm_up if r.get("direction") == "up"
+                    else ap.confirm_down)
+            windows = r.get("windows") or 0
+            if windows < max(1, int(need)):
+                violations.append(
+                    f"scale-confirmation: {r.get('direction')} decision "
+                    f"at record {idx} confirmed by {windows} window(s), "
+                    f"policy demands {need}"
+                )
+            age = r.get("signal_age_s")
+            if age is None or age > ap.signal_max_age_s:
+                violations.append(
+                    f"scale-confirmation: decision at record {idx} "
+                    f"fired on a stale/unknown signal "
+                    f"(age {age!r}s, max {ap.signal_max_age_s:.0f}s)"
+                )
+        return violations
+
+    # -- 9: autoscale — no action while the thrash breaker holds -----------
+
+    def check_scale_breaker_gate(self, ledger_records: list) -> list:
+        violations: list = []
+        open_until: float | None = None
+        for idx, r in enumerate(ledger_records):
+            kind = r.get("kind")
+            if kind == events_mod.SCALE_BREAKER_OPEN:
+                open_until = r.get("reopen_at")
+                if open_until is None:
+                    open_until = float("inf")
+            elif kind in (events_mod.SCALE_BREAKER_HALF_OPEN,
+                          events_mod.SCALE_BREAKER_CLOSE):
+                open_until = None
+            elif kind == events_mod.SCALE_START:
+                ts = r.get("ts", 0.0)
+                if open_until is not None and ts < open_until:
+                    violations.append(
+                        f"scale-breaker: scale action at record {idx} "
+                        f"(t={ts:.0f}) while the thrash breaker holds "
+                        f"until t={open_until:.0f}"
+                    )
+        return violations
+
+    # -- 10: autoscale — serialised scales + cooldown spacing --------------
+
+    def check_scale_serialised(self, ledger_records: list) -> list:
+        """At most ONE scale in flight ever (a SCALE_START while an
+        earlier one later closes is a double-scale — the restart path
+        must RESUME an orphan, not mint a sibling), and consecutive
+        actions respect the recorded cooldown."""
+        violations: list = []
+        closed_at: dict = {}
+        for idx, r in enumerate(ledger_records):
+            if r.get("kind") in (events_mod.SCALE_DONE,
+                                 events_mod.SCALE_ABORT):
+                closed_at[r.get("id")] = idx
+        open_scale: tuple | None = None  # (idx, id)
+        cooldown_until: float | None = None
+        for idx, r in enumerate(ledger_records):
+            kind = r.get("kind")
+            if kind == events_mod.SCALE_START:
+                ts = r.get("ts", 0.0)
+                if (open_scale is not None
+                        and closed_at.get(open_scale[1], -1) > idx):
+                    violations.append(
+                        f"scale-serialised: scale {r.get('id')!r} "
+                        f"started at record {idx} while scale "
+                        f"{open_scale[1]!r} (record {open_scale[0]}) "
+                        "was still in flight"
+                    )
+                if (cooldown_until is not None
+                        and ts < cooldown_until - self._EPS):
+                    violations.append(
+                        f"scale-serialised: scale {r.get('id')!r} at "
+                        f"t={ts:.0f} (record {idx}) inside the previous "
+                        f"action's cooldown (until "
+                        f"t={cooldown_until:.0f})"
+                    )
+                open_scale = (idx, r.get("id"))
+                if r.get("cooldown_until") is not None:
+                    cooldown_until = r["cooldown_until"]
+            elif kind in (events_mod.SCALE_DONE, events_mod.SCALE_ABORT):
+                if open_scale is not None and open_scale[1] == r.get("id"):
+                    open_scale = None
+        return violations
+
+    # -- 11: autoscale — DRAINING slices receive zero dispatches -----------
+
+    def check_no_dispatch_to_draining(self, req_records: list,
+                                      ledger_records: list) -> list:
+        """From one propagation grace after a SCALE_START(down) lands
+        until its DONE/ABORT, the named slices may receive NO dispatch:
+        the Router saw the draining list and stopped pulling. A
+        dispatch inside the window means capacity was torn down under
+        live work on purpose."""
+        intervals: dict = {}  # slice -> list of (t0, t1)
+        open_downs: dict = {}  # id -> (ts, slices)
+        for r in ledger_records:
+            kind = r.get("kind")
+            if (kind == events_mod.SCALE_START
+                    and r.get("direction") == "down"):
+                open_downs[r.get("id")] = (
+                    r.get("ts", 0.0), [int(i) for i in r.get("slices", [])]
+                )
+            elif kind in (events_mod.SCALE_DONE, events_mod.SCALE_ABORT):
+                opened = open_downs.pop(r.get("id"), None)
+                if opened is not None:
+                    t0, slices = opened
+                    for i in slices:
+                        intervals.setdefault(i, []).append(
+                            (t0, r.get("ts", float("inf")))
+                        )
+        for rid, (t0, slices) in open_downs.items():
+            for i in slices:  # still draining when the campaign ended
+                intervals.setdefault(i, []).append((t0, float("inf")))
+        violations: list = []
+        grace = self.drain_grace_s
+        for idx, r in enumerate(req_records):
+            if r.get("kind") != reqlog_mod.DISPATCHED:
+                continue
+            index = r.get("slice")
+            if index is None:
+                continue
+            ts = r.get("ts", 0.0)
+            for t0, t1 in intervals.get(int(index), []):
+                if t0 + grace < ts <= t1:
+                    violations.append(
+                        f"dispatch-to-draining: slice {index} claimed "
+                        f"work at t={ts:.1f} (record {idx}) while "
+                        f"draining for scale-down since t={t0:.1f}"
+                    )
+        return violations
+
+
 def _static_status_doc(now: float, num_slices: int,
                        generation: int = 1) -> dict:
     """A healthy fleet-status document with the serving/membership
@@ -1556,3 +1756,541 @@ def run_gateway_kill_drill(
         "restart_to_first_token_s": restart_mttr,
         "violations": violations,
     }
+
+
+# ------------------------------------------------- autoscale (elasticity)
+
+
+def default_autoscale_policy(num_slices: int = 4):
+    """The campaign autoscale policy: thresholds sized to the modeled
+    engine's capacity (one 4-slot slice serves ~2-3 rps of the traffic
+    mix), confirmation windows short enough to exercise inside a
+    bounded sim, drains short enough to finish inside one."""
+    from tritonk8ssupervisor_tpu.provision import autoscale as as_mod
+
+    return as_mod.AutoscalePolicy(
+        min_slices=1, max_slices=num_slices,
+        up_queue_per_slice=6.0, down_queue_per_slice=2.0,
+        slo_p99_s=60.0, down_p99_margin=0.5,
+        confirm_up=2, confirm_down=3,
+        cooldown_s=60.0, cooldown_cap_s=600.0,
+        drain_timeout_s=120.0, signal_max_age_s=75.0,
+        breaker_threshold=3, breaker_window_s=3600.0,
+    )
+
+
+def _active_slice_seconds(ledger_records: list, initial: int,
+                          end_s: float) -> float:
+    """Integrate the active slice count over the run — the cost side of
+    cost-per-served-token. Capacity being PROVISIONED bills from its
+    SCALE_START (the machines exist the moment the apply runs);
+    capacity draining bills until its SCALE_DONE tears it down."""
+    total = 0.0
+    t_prev = 0.0
+    active = float(initial)
+    for r in ledger_records:
+        kind = r.get("kind")
+        delta = 0.0
+        if kind == events_mod.SCALE_START and r.get("direction") == "up":
+            delta = float(len(r.get("slices", [])))
+        elif (kind == events_mod.SCALE_ABORT
+              and r.get("direction") == "up"):
+            delta = -float(len(r.get("slices", [])))
+        elif (kind == events_mod.SCALE_DONE
+              and r.get("direction") == "down"):
+            delta = -float(len(r.get("slices", [])))
+        if delta == 0.0:
+            continue
+        ts = min(float(r.get("ts", 0.0)), end_s)
+        total += active * max(0.0, ts - t_prev)
+        t_prev = ts
+        active += delta
+    total += active * max(0.0, end_s - t_prev)
+    return total
+
+
+def _scale_summary(ledger_records: list) -> dict:
+    kinds = [r.get("kind") for r in ledger_records]
+    up_done = [r for r in ledger_records
+               if r.get("kind") == events_mod.SCALE_DONE
+               and r.get("direction") == "up"]
+    down_done = [r for r in ledger_records
+                 if r.get("kind") == events_mod.SCALE_DONE
+                 and r.get("direction") == "down"]
+    return {
+        "decisions": kinds.count(events_mod.SCALE_DECISION),
+        "started": kinds.count(events_mod.SCALE_START),
+        "done_up": len(up_done),
+        "done_down": len(down_done),
+        "aborted": kinds.count(events_mod.SCALE_ABORT),
+        "held": kinds.count(events_mod.SCALE_HELD),
+        "breaker_opens": kinds.count(events_mod.SCALE_BREAKER_OPEN),
+        "stragglers_requeued": sum(
+            int(r.get("stragglers") or 0) for r in down_done
+        ),
+    }
+
+
+def run_autoscale_drive(
+    workdir: Path,
+    num_slices: int = 4,
+    duration_s: float = 1500.0,
+    base_rps: float = 5.0,
+    diurnal_amplitude: float = 0.55,
+    diurnal_period_s: float = 900.0,
+    bursts: tuple = (),
+    deadline_s: float = 120.0,
+    seed: int = 11,
+    autoscale_policy=None,
+    policy: "sup_mod.SupervisePolicy | None" = None,
+    gw_policy=None,
+    heal_seconds: float = 30.0,
+    teardown_seconds: float = 10.0,
+    preempt: tuple = (),  # ((slice, at), ...) world faults
+    torn_status_at: tuple = (),
+    torn_demand_at: tuple = (),
+    gateway_kill_at: tuple = (),
+    kill_gateway_on_drain: bool = False,
+    fail_applies: int = 0,
+    supervisor_kill_on: str | None = None,  # "apply" / "destroy"
+    drain_grace_s: float = 1800.0,
+) -> dict:
+    """Drive the CLOSED gateway→supervisor loop on one SimClock: a REAL
+    Supervisor (with the second controller when `autoscale_policy` is
+    set — `None` is the static-fleet baseline arm) reconciles and
+    scales the scripted world, while a REAL Gateway serves the seeded
+    diurnal(+burst) open-loop stream and publishes demand-signal.json.
+    Faults compose: slice preemptions, torn status/demand copies,
+    gateway SIGKILLs (absolute times, or triggered the moment a
+    scale-down drain is observed), provisioning failures mid-scale-up,
+    and a supervisor SIGKILL on its own scale order. Afterwards the
+    ServeInvariantChecker folds BOTH ledgers with the scale invariants
+    armed; the result carries cost (active-slice-seconds per served
+    token) and the scale-up MTTR under the first burst."""
+    from tritonk8ssupervisor_tpu import obs as obs_lib
+    from tritonk8ssupervisor_tpu.provision import autoscale as as_mod
+    from tritonk8ssupervisor_tpu.provision.fleetview import FileHealthSource
+    from tritonk8ssupervisor_tpu.serving import gateway as gw_mod
+    from tritonk8ssupervisor_tpu.serving import traffic as traffic_mod
+
+    policy = policy or default_policy()
+    interval = policy.interval
+    clock = SimClock(stall_timeout=60.0)
+    config = sim_config(num_slices, failure_domains=0)
+    world = ChaosFleet(Path(workdir), clock, config,
+                       heal_seconds=heal_seconds,
+                       teardown_seconds=teardown_seconds)
+    world.apply_failures_remaining = max(0, int(fail_applies))
+    for index, at in preempt:
+        world.preempt(int(index), at=float(at))
+    torn_at = sorted(float(t) for t in torn_status_at)
+    torn_demand = sorted(float(t) for t in torn_demand_at)
+    kill_at = sorted(float(t) for t in gateway_kill_at)
+
+    run_fn = world.run
+    if supervisor_kill_on:
+        kill_plan = FaultPlan(
+            [FaultRule(match=f"terraform {supervisor_kill_on}",
+                       kill=True)],
+            echo=lambda line: None,
+        )
+        run_fn = kill_plan.wrap(world.run)
+
+    ledger = events_mod.EventLedger(world.paths.events, clock=clock.time,
+                                    echo=lambda line: None, fsync=False)
+    reqlog = reqlog_mod.RequestLog(world.paths.request_log,
+                                   clock=clock.time,
+                                   echo=lambda line: None, fsync=False)
+    span_log = obs_lib.SpanLog(world.paths.span_log, clock=clock.time,
+                               echo=lambda line: None, fsync=False)
+    registry = obs_lib.MetricsRegistry(clock=clock.time)
+    telemetry = obs_lib.Telemetry(
+        registry,
+        obs_lib.Tracer(span_log, plane=obs_lib.SERVING,
+                       clock=clock.time, incarnation=1),
+        snapshot_path=world.paths.metrics_snapshot,
+    )
+    sup_telemetry = obs_lib.Telemetry(
+        registry,
+        obs_lib.Tracer(span_log, plane=obs_lib.SUPERVISOR,
+                       clock=clock.time),
+    )
+    gw_policy = gw_policy or gw_mod.GatewayPolicy(
+        max_seq_len=512, slots_per_slice=4, prefill_chunk=64,
+        queue_budget=48, bucket_bounds=(64, 128, 256),
+        poll_every_s=2.0, default_deadline_s=deadline_s,
+        demand_signal_every_s=5.0,
+        # the raw record stream IS the evidence the invariant checkers
+        # fold — a long drive must not hit the long-running-server
+        # retention caps, whose whole point is dropping old keys
+        terminal_key_retention=0, journal_compact_records=0,
+        audit_retention=0,
+    )
+    cost = gw_mod.DecodeCostModel()
+    status_path = world.paths.fleet_status
+
+    stop = threading.Event()
+    sup_restarts = [0]
+    clock.launch()
+
+    def make_supervisor() -> "sup_mod.Supervisor":
+        autoscaler = None
+        if autoscale_policy is not None:
+            autoscaler = as_mod.Autoscaler(autoscale_policy, num_slices)
+        return sup_mod.Supervisor(
+            config, world.paths, _Quiet(),
+            run=run_fn, run_quiet=world.run_quiet, policy=policy,
+            ledger=ledger, clock=clock.time, sleep=clock.sleep,
+            rng=lambda: 0.0, readiness_timeout=60.0, hooks=clock,
+            telemetry=sup_telemetry, autoscaler=autoscaler,
+        )
+
+    def sup_body() -> None:
+        clock.begin()
+        try:
+            supervisor = make_supervisor()
+            supervisor.restore()
+            while not stop.is_set():
+                try:
+                    supervisor.tick()
+                except SupervisorKilled:
+                    # SIGKILL mid-scale: resume from the event ledger —
+                    # the open SCALE_START must be finished, never
+                    # restarted as a sibling (no double-provision)
+                    sup_restarts[0] += 1
+                    supervisor = make_supervisor()
+                    supervisor.restore()
+                    continue
+                if stop.is_set():
+                    break
+                clock.sleep(interval)
+        finally:
+            clock.release()
+
+    def make_gateway() -> "gw_mod.Gateway":
+        engines = {
+            i: gw_mod.ModeledEngine(slots=gw_policy.slots_per_slice,
+                                    prefill_chunk=gw_policy.prefill_chunk,
+                                    cost=cost)
+            for i in range(num_slices)
+        }
+        return gw_mod.Gateway(
+            engines, FileHealthSource(status_path),
+            policy=gw_policy, clock=clock.time, reqlog=reqlog,
+            telemetry=telemetry,
+            demand_path=world.paths.demand_signal,
+        )
+
+    model = traffic_mod.TrafficModel(
+        base_rps=base_rps, diurnal_amplitude=diurnal_amplitude,
+        diurnal_period_s=diurnal_period_s, bursts=tuple(bursts),
+        seed=seed, deadline_s=deadline_s, key_prefix=f"a{seed}",
+    )
+    arrivals = traffic_mod.generate_arrivals(model, duration_s)
+    hard_stop = duration_s + drain_grace_s
+
+    def autoscale_in_progress() -> dict | None:
+        try:
+            doc = json.loads(status_path.read_text())
+        except (OSError, ValueError):
+            return None
+        block = doc.get("autoscale") if isinstance(doc, dict) else None
+        return block.get("in_progress") if isinstance(block, dict) \
+            else None
+
+    thread = threading.Thread(target=sup_body, daemon=True)
+    thread.start()
+    gateway = make_gateway()
+    gateway.recover(0.0)
+    kills = 0
+    redone = 0
+    drain_kill_done = False
+    drains_seen = 0
+    draining_before = False
+    last_status_read = -1e9
+    i_arr = 0
+    next_step: dict = {i: None for i in gateway.workers}
+    quiet = False
+    clock.launch()
+    clock.begin()
+    try:
+        while True:
+            now = clock.time()
+            while torn_at and torn_at[0] <= now:
+                torn_at.pop(0)
+                _tear_file(status_path)
+            while torn_demand and torn_demand[0] <= now:
+                torn_demand.pop(0)
+                _tear_file(world.paths.demand_signal)
+            if (autoscale_policy is not None
+                    and now - last_status_read >= gw_policy.poll_every_s):
+                last_status_read = now
+                in_progress = autoscale_in_progress()
+                draining = (in_progress is not None
+                            and in_progress.get("direction") == "down")
+                if draining and not draining_before:
+                    drains_seen += 1
+                draining_before = draining
+                if draining and kill_gateway_on_drain \
+                        and not drain_kill_done:
+                    # THE gateway-kill-mid-drain moment: every queued
+                    # and in-flight request in memory is gone while the
+                    # supervisor is mid-way through a drain; the
+                    # journal resumes the work, the drain still settles
+                    drain_kill_done = True
+                    kill_at.insert(0, now)
+            if kill_at and kill_at[0] <= now:
+                kill_at.pop(0)
+                kills += 1
+                telemetry.bump_incarnation()
+                gateway = make_gateway()
+                recovered = gateway.recover(now)
+                redone += recovered["redone"]
+                next_step = {i: None for i in gateway.workers}
+            gateway.poll(now)
+            gateway.expire_queued(now)
+            down = world.down_now()
+            for i, worker in gateway.workers.items():
+                if i in down and worker.alive:
+                    worker.fail()
+                    next_step[i] = None
+                elif i not in down and not worker.alive:
+                    worker.revive()
+                    next_step[i] = now
+            while i_arr < len(arrivals) and arrivals[i_arr].arrival <= now:
+                gateway.submit(arrivals[i_arr], now)
+                i_arr += 1
+            for i in sorted(gateway.workers):
+                if next_step[i] is not None and next_step[i] <= now:
+                    dt = gateway.workers[i].step(now)
+                    next_step[i] = None if dt is None else now + dt
+            for i, worker in gateway.workers.items():
+                if (next_step[i] is None and worker.alive
+                        and (worker.inflight or (
+                            gateway.queue_depth()
+                            and gateway.slice_mode(i) == gw_mod.SERVE))):
+                    next_step[i] = now
+            quiet = (i_arr >= len(arrivals) and not kill_at
+                     and gateway.queue_depth() == 0
+                     and all(w.idle()
+                             for w in gateway.workers.values()))
+            if quiet and autoscale_policy is not None:
+                # let a scale already in flight finish (an abandoned
+                # drain would read as an orphaned SCALE_START)
+                quiet = autoscale_in_progress() is None
+            if quiet or now >= hard_stop:
+                break
+            candidates = [t for t in next_step.values() if t is not None]
+            if i_arr < len(arrivals):
+                candidates.append(arrivals[i_arr].arrival)
+            if kill_at:
+                candidates.append(kill_at[0])
+            if torn_at:
+                candidates.append(torn_at[0])
+            if torn_demand:
+                candidates.append(torn_demand[0])
+            candidates.append(now + 2.0 * gw_policy.poll_every_s)
+            t_next = min(candidates)
+            if t_next > now:
+                clock.sleep(t_next - now)
+    finally:
+        stop.set()
+        clock.release()
+    thread.join(timeout=120)
+
+    req_records = reqlog.replay()
+    led_records = ledger.replay()
+    end_s = clock.time()
+    gateway.update_gauges()
+    metrics_snapshot = telemetry.write_snapshot() or registry.snapshot()
+    checker = ServeInvariantChecker(
+        gw_policy, interval_s=interval,
+        staleness_bound_s=2.0 * max(heal_seconds, teardown_seconds)
+        + 4.0 * interval + gw_policy.poll_every_s,
+        autoscale_policy=autoscale_policy,
+    )
+    violations = checker.check(req_records, led_records,
+                               metrics=metrics_snapshot)
+    if not quiet:
+        violations.append(
+            f"convergence: request plane not quiescent by "
+            f"t={hard_stop:.0f}s (seed {seed})"
+        )
+    view = reqlog_mod.fold(req_records)
+    latencies = sorted(
+        r["latency_s"] for r in req_records
+        if r.get("kind") == reqlog_mod.COMPLETED
+        and r.get("latency_s") is not None
+    )
+
+    def pct(q: float):
+        if not latencies:
+            return None
+        idx = min(len(latencies) - 1,
+                  max(0, int(round(q * (len(latencies) - 1)))))
+        return round(latencies[idx], 3)
+
+    from tritonk8ssupervisor_tpu.obs import metrics as metrics_mod
+
+    tokens = int(metrics_mod.counter_total(
+        metrics_snapshot, "serving_tokens_generated_total"))
+    slice_seconds = _active_slice_seconds(led_records, num_slices, end_s)
+    first_burst = min((b[0] for b in bursts), default=None)
+    scale_up_mttr = None
+    if first_burst is not None:
+        ups = [r.get("ts", 0.0) for r in led_records
+               if r.get("kind") == events_mod.SCALE_DONE
+               and r.get("direction") == "up"
+               and r.get("ts", 0.0) >= first_burst]
+        if ups:
+            scale_up_mttr = round(min(ups) - first_burst, 3)
+    return {
+        "seed": seed,
+        "autoscale": autoscale_policy is not None,
+        "num_slices": num_slices,
+        "duration_s": duration_s,
+        "end_s": round(end_s, 3),
+        "offered": len(arrivals),
+        "accepted": sum(1 for kv in view.keys.values()
+                        if kv.accepts > 0),
+        "completed": sum(kv.completions for kv in view.keys.values()),
+        "expired": sum(kv.expiries for kv in view.keys.values()),
+        "requeues": sum(kv.requeues for kv in view.keys.values()),
+        "sheds": view.sheds,
+        "tokens": tokens,
+        "p50_latency_s": pct(0.50),
+        "p99_latency_s": pct(0.99),
+        "slice_seconds": round(slice_seconds, 1),
+        "slice_hours_per_1k_tokens": (
+            round(slice_seconds / 3600.0 / (tokens / 1000.0), 6)
+            if tokens else None
+        ),
+        "scale_up_mttr_s": scale_up_mttr,
+        "scales": _scale_summary(led_records),
+        "gateway_kills": kills,
+        "redone_after_kill": redone,
+        "supervisor_restarts": sup_restarts[0],
+        "drains_observed": drains_seen,
+        "violations": violations,
+        "converged": quiet,
+    }
+
+
+@dataclasses.dataclass
+class AutoscaleScenario:
+    """One seeded composition of diurnal(+burst) traffic and the
+    elasticity fault primitives. Every scenario is convergeable: bursts
+    end, torn files are rewritten by the next publish, kills resume
+    from the ledgers."""
+
+    seed: int
+    num_slices: int
+    duration_s: float
+    base_rps: float
+    diurnal_amplitude: float
+    diurnal_period_s: float
+    bursts: tuple
+    deadline_s: float
+    events: list
+
+
+AUTOSCALE_PRIMITIVES = (
+    "burst", "gateway-kill-mid-drain", "slice-loss-mid-scale-up",
+    "torn-demand", "torn-status", "slice-outage",
+    "supervisor-kill-mid-scale",
+)
+
+
+def generate_autoscale_scenario(seed: int,
+                                num_slices: int = 4) -> AutoscaleScenario:
+    """Deterministic elasticity scenario from `seed`: a diurnal trace
+    whose trough takes the fleet down and whose recovery (usually
+    sharpened by a burst landing IN the trough) forces it back up,
+    composed with up to two fault primitives — the gateway SIGKILL
+    mid-drain and the provisioning failure mid-scale-up being the two
+    the acceptance criteria name."""
+    rng = random.Random(int(seed))
+    period = 900.0
+    duration = 1200.0 + 150.0 * rng.randrange(0, 3)
+    base = 4.5 + 0.5 * rng.randrange(0, 3)
+    amplitude = 0.5 + 0.05 * rng.randrange(0, 3)
+    events: list = []
+    bursts: list = []
+    if rng.random() < 0.8:
+        # the burst lands in the diurnal trough (sin < 0 after
+        # period/2), where the fleet has scaled down — the honest
+        # scale-up-MTTR shape, and the drain-abort trigger
+        at = 0.55 * period + 30.0 * rng.randrange(0, 8)
+        bursts.append((at, 120.0 + 60.0 * rng.randrange(0, 2),
+                       2.5 + 0.5 * rng.randrange(0, 2)))
+        events.append({"kind": "burst", "at": at})
+    used: set = set()
+    for _ in range(rng.randrange(0, 3)):
+        kind = rng.choice(AUTOSCALE_PRIMITIVES[1:])
+        if kind in used:
+            continue
+        used.add(kind)
+        if kind == "gateway-kill-mid-drain":
+            events.append({"kind": kind})
+        elif kind == "slice-loss-mid-scale-up":
+            events.append({"kind": kind, "fail_applies": 1})
+        elif kind == "torn-demand":
+            events.append({"kind": kind,
+                           "at": 120.0 + 60.0 * rng.randrange(0, 8)})
+        elif kind == "torn-status":
+            events.append({"kind": kind,
+                           "at": 120.0 + 60.0 * rng.randrange(0, 8)})
+        elif kind == "slice-outage":
+            events.append({"kind": kind,
+                           "slice": rng.randrange(num_slices),
+                           "at": 90.0 + 60.0 * rng.randrange(0, 5)})
+        elif kind == "supervisor-kill-mid-scale":
+            events.append({"kind": kind, "on": "destroy"})
+    return AutoscaleScenario(
+        seed=int(seed), num_slices=num_slices, duration_s=duration,
+        base_rps=base, diurnal_amplitude=amplitude,
+        diurnal_period_s=period, bursts=tuple(bursts),
+        deadline_s=120.0, events=events,
+    )
+
+
+def run_autoscale_campaign(scenario: AutoscaleScenario,
+                           workdir: Path) -> dict:
+    """One seeded elasticity campaign: the scenario's traffic and
+    faults through `run_autoscale_drive` with the default campaign
+    policies. The verdict carries the checker's violations (scale
+    invariants armed) plus the scale/kill bookkeeping."""
+    kwargs: dict = dict(
+        num_slices=scenario.num_slices,
+        duration_s=scenario.duration_s,
+        base_rps=scenario.base_rps,
+        diurnal_amplitude=scenario.diurnal_amplitude,
+        diurnal_period_s=scenario.diurnal_period_s,
+        bursts=scenario.bursts,
+        deadline_s=scenario.deadline_s,
+        seed=scenario.seed,
+        autoscale_policy=default_autoscale_policy(scenario.num_slices),
+    )
+    preempt: list = []
+    torn_status: list = []
+    torn_demand: list = []
+    for event in scenario.events:
+        kind = event["kind"]
+        if kind == "gateway-kill-mid-drain":
+            kwargs["kill_gateway_on_drain"] = True
+        elif kind == "slice-loss-mid-scale-up":
+            kwargs["fail_applies"] = event.get("fail_applies", 1)
+        elif kind == "torn-demand":
+            torn_demand.append(event["at"])
+        elif kind == "torn-status":
+            torn_status.append(event["at"])
+        elif kind == "slice-outage":
+            preempt.append((event["slice"], event["at"]))
+        elif kind == "supervisor-kill-mid-scale":
+            kwargs["supervisor_kill_on"] = event.get("on", "destroy")
+    kwargs["preempt"] = tuple(preempt)
+    kwargs["torn_status_at"] = tuple(torn_status)
+    kwargs["torn_demand_at"] = tuple(torn_demand)
+    out = run_autoscale_drive(Path(workdir), **kwargs)
+    out["events"] = [e["kind"] for e in scenario.events]
+    return out
