@@ -56,6 +56,7 @@ Usage::
     python bench_provision.py --chaos [--campaigns 25] [--out BENCH_chaos.json]
     python bench_provision.py --serve [--out BENCH_serve.json]
     python bench_provision.py --autoscale [--campaigns 25] [--out BENCH_autoscale.json]
+    python bench_provision.py --allocator [--campaigns 25] [--out BENCH_allocator.json]
     python bench_provision.py --obs [--out BENCH_obs.json]
     python bench_provision.py --check [--baseline BENCH_provision.json]
 
@@ -2248,6 +2249,229 @@ def run_autoscale_benchmark(campaigns: int = 25) -> dict:
     }
 
 
+# ------------------------------------------ co-scheduling (one fleet)
+
+
+COSCHEDULE_TRAFFIC = dict(
+    # three diurnal periods STARTING IN THE TROUGH (phase 0.75 — the
+    # run opens with training holding the fleet), peaks that need ~2
+    # serving slices, troughs that need one, and a 2.2x burst riding
+    # the FIRST PEAK — the moment a static half-fleet drowns and the
+    # co-scheduled fleet must preempt training to marshal everything
+    duration_s=3600.0, base_rps=1.5, diurnal_amplitude=0.85,
+    diurnal_phase=0.75, diurnal_period_s=1200.0,
+    bursts=((600.0, 270.0, 2.2),), seed=13,
+)
+
+# The unattended preemption-MTTR budget (burst onset -> ROLE_CHANGED
+# to serving on the ledger), derived from the campaign policy:
+# pressure builds within ~1 tick, confirmation needs 2 fresh windows
+# (2 x 30 s), the PREEMPT_NOTICE opens the checkpoint window, the
+# trainer acks within one poll interval (5 s), the ack folds on the
+# next tick (30 s), and the role flips the same tick — ~150 s worst
+# case, with slack for a hand-back abort first. Same budget-anchored
+# gating rationale as AUTOSCALE_MTTR_BUDGET_S.
+COSCHEDULE_MTTR_BUDGET_S = 300.0
+
+# The training side of the static comparison: two slices stepping at
+# the VirtualTrainer's rate for the whole run, no preemptions, no
+# resumes — what a dedicated half-fleet banks.
+COSCHEDULE_TRAINER_RATE = 0.5  # steps per slice-second
+COSCHEDULE_CHECKPOINT_EVERY = 60  # steps per durable checkpoint
+
+
+def run_coschedule_cost_drives(workdir: Path,
+                               duration_s: float | None = None
+                               ) -> tuple[dict, dict, float]:
+    """The one-fleet-vs-two-half-fleets A/B: the SAME diurnal+burst
+    stream served by a co-scheduled 4-slice fleet (the allocator hands
+    troughs to training and preempts on the surge) and by a static
+    2-slice serving half, next to a static 2-slice training half that
+    banks `rate * 2 * duration` steps uninterrupted. Returns
+    (coscheduled, static_serve, static_train_steps) — the co-scheduled
+    fleet must beat the halves on BOTH goodput and training steps."""
+    from tritonk8ssupervisor_tpu.testing import chaos
+
+    traffic = dict(COSCHEDULE_TRAFFIC)
+    if duration_s is not None:
+        traffic["duration_s"] = float(duration_s)
+    cosched = chaos.run_coschedule_drive(
+        Path(workdir) / "cosched", num_slices=4,
+        alloc_policy=chaos.default_alloc_policy(4),
+        trainer_rate=COSCHEDULE_TRAINER_RATE,
+        checkpoint_every=COSCHEDULE_CHECKPOINT_EVERY, **traffic,
+    )
+    static_serve = chaos.run_coschedule_drive(
+        Path(workdir) / "static-serve", num_slices=2,
+        alloc_policy=None, **traffic,
+    )
+    static_train_steps = (COSCHEDULE_TRAINER_RATE * 2
+                          * traffic["duration_s"])
+    return cosched, static_serve, static_train_steps
+
+
+def run_allocator_benchmark(campaigns: int = 25) -> dict:
+    """The train/serve co-scheduling acceptance datapoint
+    (BENCH_allocator.json):
+
+    - **one fleet vs two half-fleets**: the diurnal+burst trace served
+      co-scheduled (4 elastic slices) vs split static (2 serve + 2
+      train) — the ONE fleet must complete MORE requests AND bank MORE
+      training steps (steps/day is the same comparison scaled);
+    - **preemption MTTR**: burst onset -> ROLE_CHANGED(serving) on the
+      ledger, unattended, within the policy-derived budget;
+    - **preemption cost**: every trainer resume loses <= one checkpoint
+      interval of steps (the drain-notice flush makes the acked path
+      ~0; the periodic checkpoint bounds the forced path);
+    - **the three named drills**: supervisor SIGKILL between
+      PREEMPT_NOTICE and ROLE_CHANGED (restart resumes the SAME
+      handover id — the serialised-handover invariant would name a
+      sibling), a trainer that never acks (bounded wait -> FORCED
+      preemption, loss still bounded), and a tenant flood against the
+      WFQ admission queue (the flooding tenant is clamped near its
+      weight share; the base tenants keep completing);
+    - **N seeded co-scheduling campaigns** (testing/chaos.py
+      `generate_coschedule_scenario`): every one folded through the
+      ServeInvariantChecker with the allocation invariants armed —
+      role exclusivity, handover protocol (ack before role change,
+      forced only past the deadline), confirmed fresh windows, zero
+      dispatches to TRAINING slices, request conservation throughout.
+      Zero violations is the bar.
+    """
+    from tritonk8ssupervisor_tpu.testing import chaos
+
+    policy = chaos.default_alloc_policy(4)
+    results: list = []
+    violations: list = []
+    with tempfile.TemporaryDirectory(prefix="tk8s-alloc-") as tmp:
+        root = Path(tmp)
+        cosched, static_serve, static_train_steps = \
+            run_coschedule_cost_drives(root)
+        kill = chaos.run_coschedule_drive(
+            root / "kill-mid-handover", num_slices=4,
+            alloc_policy=policy, kill_on_notice=1,
+            trainer_rate=COSCHEDULE_TRAINER_RATE,
+            checkpoint_every=COSCHEDULE_CHECKPOINT_EVERY,
+            **COSCHEDULE_TRAFFIC,
+        )
+        noack = chaos.run_coschedule_drive(
+            root / "never-ack", num_slices=4,
+            alloc_policy=policy, trainer_ack=False,
+            trainer_rate=COSCHEDULE_TRAINER_RATE,
+            checkpoint_every=COSCHEDULE_CHECKPOINT_EVERY,
+            **COSCHEDULE_TRAFFIC,
+        )
+        flood = chaos.run_coschedule_drive(
+            root / "tenant-flood", num_slices=4,
+            alloc_policy=policy,
+            tenants={"base": 3.0, "flood": 1.0},
+            flood={"tenant": "flood", "at": 500.0,
+                   "duration": 180.0, "rps": 6.0},
+            trainer_rate=COSCHEDULE_TRAINER_RATE,
+            checkpoint_every=COSCHEDULE_CHECKPOINT_EVERY,
+            **COSCHEDULE_TRAFFIC,
+        )
+        for seed in range(1, campaigns + 1):
+            scenario = chaos.generate_coschedule_scenario(seed)
+            out = chaos.run_coschedule_campaign(scenario,
+                                                root / f"seed-{seed}")
+            results.append(out)
+            violations += [f"seed {seed}: {v}"
+                           for v in out["violations"]]
+    for label, drill in (("cosched", cosched),
+                         ("static-serve", static_serve),
+                         ("kill-mid-handover", kill),
+                         ("never-ack", noack),
+                         ("tenant-flood", flood)):
+        violations += [f"{label}: {v}" for v in drill["violations"]]
+    converged = sum(1 for r in results if r["converged"])
+    primitives: dict = {}
+    for r in results:
+        for kind in r["events"]:
+            primitives[kind] = primitives.get(kind, 0) + 1
+    day = 86400.0
+    duration = COSCHEDULE_TRAFFIC["duration_s"]
+    cosched_steps = cosched["training"]["steps"]
+    max_resume_loss = max(
+        (r["steps_lost"] for r in cosched["training"]["resumes"]),
+        default=0,
+    )
+    passes = bool(
+        not violations
+        and converged == len(results)
+        and cosched["completed"] > static_serve["completed"]
+        and cosched_steps > static_train_steps
+        and cosched["preempt_mttr_s"] is not None
+        and cosched["preempt_mttr_s"] <= COSCHEDULE_MTTR_BUDGET_S
+        and max_resume_loss <= COSCHEDULE_CHECKPOINT_EVERY
+        and cosched["handovers"]["preemptions"] > 0
+        and cosched["handovers"]["handbacks"] > 0
+        and kill["supervisor_restarts"] >= 1 and kill["converged"]
+        and noack["handovers"]["forced"] >= 1 and noack["converged"]
+        and flood["converged"]
+    )
+    return {
+        "benchmark": "allocator",
+        "metric": "preempt_mttr_s",
+        "unit": ("s (burst onset -> ROLE_CHANGED to serving, "
+                 "unattended; plus goodput + training steps on ONE "
+                 "co-scheduled fleet vs two static half-fleets under "
+                 "the diurnal+burst trace, three crash/fairness "
+                 "drills, and N seeded co-scheduling campaigns with "
+                 "zero allocation-invariant violations)"),
+        "value": cosched["preempt_mttr_s"],
+        "mttr_budget_s": COSCHEDULE_MTTR_BUDGET_S,
+        "checkpoint_every_steps": COSCHEDULE_CHECKPOINT_EVERY,
+        "max_resume_steps_lost": max_resume_loss,
+        "goodput": {
+            "coscheduled_completed": cosched["completed"],
+            "static_serve_completed": static_serve["completed"],
+            "margin": cosched["completed"] - static_serve["completed"],
+        },
+        "training": {
+            "coscheduled_steps": cosched_steps,
+            "static_train_steps": static_train_steps,
+            "coscheduled_steps_per_day": round(
+                cosched_steps / duration * day, 1),
+            "static_steps_per_day": round(
+                static_train_steps / duration * day, 1),
+            "steps_lost": cosched["training"]["steps_lost"],
+            "resumes": len(cosched["training"]["resumes"]),
+        },
+        "coscheduled": cosched,
+        "static_serve": static_serve,
+        "static_train_steps": static_train_steps,
+        "drills": {
+            "supervisor_kill_mid_handover": kill,
+            "never_acking_trainer": noack,
+            "tenant_flood": flood,
+        },
+        "campaigns": {
+            "campaigns": len(results),
+            "converged": converged,
+            "violation_count": len(violations),
+            "violations": violations[:50],
+            "primitives": dict(sorted(primitives.items())),
+            "accepted": sum(r["accepted"] for r in results),
+            "completed": sum(r["completed"] for r in results),
+            "expired": sum(r["expired"] for r in results),
+            "sheds": sum(r["sheds"] for r in results),
+            "handovers": sum(r["handovers"]["notices"]
+                             for r in results),
+            "preemptions": sum(r["handovers"]["preemptions"]
+                               for r in results),
+            "forced": sum(r["handovers"]["forced"] for r in results),
+            "training_steps": sum(r["training"]["steps"]
+                                  for r in results),
+            "training_steps_lost": sum(r["training"]["steps_lost"]
+                                       for r in results),
+            "supervisor_restarts": sum(r["supervisor_restarts"]
+                                       for r in results),
+        },
+        "passes": passes,
+    }
+
+
 # ----------------------------------------------- telemetry overhead gate
 
 
@@ -2554,6 +2778,8 @@ ENGINE_BASELINE = Path(__file__).resolve().parent / "BENCH_engine.json"
 OBS_BASELINE = Path(__file__).resolve().parent / "BENCH_obs.json"
 AUTOSCALE_BASELINE = (Path(__file__).resolve().parent
                       / "BENCH_autoscale.json")
+ALLOCATOR_BASELINE = (Path(__file__).resolve().parent
+                      / "BENCH_allocator.json")
 
 
 def run_check(
@@ -2568,6 +2794,7 @@ def run_check(
     engine_baseline: Path = ENGINE_BASELINE,
     obs_baseline: Path = OBS_BASELINE,
     autoscale_baseline: Path = AUTOSCALE_BASELINE,
+    allocator_baseline: Path = ALLOCATOR_BASELINE,
 ) -> tuple[bool, list[str], dict]:
     """Re-simulate against the committed BENCH_provision.json,
     BENCH_supervise.json, BENCH_elastic.json, and BENCH_fleetscale.json:
@@ -2875,6 +3102,73 @@ def run_check(
                     AUTOSCALE_MTTR_BUDGET_S),
                 current_el["scale_up_mttr_s"])
 
+    allocator_baseline = Path(allocator_baseline)
+    if not allocator_baseline.exists():
+        problems.append(f"baseline {allocator_baseline} missing "
+                        "(allocator)")
+    else:
+        # committed evidence first (25+ campaigns + the three drills
+        # are an explicit `--allocator` run), then RE-RUN the
+        # one-fleet-vs-halves pair — where a policy, handover, or WFQ
+        # regression would land silently. The pair is deterministic
+        # (virtual clock, pinned rng), so "co-scheduled beats both
+        # halves" re-verifies exactly.
+        committed_al = json.loads(allocator_baseline.read_text())
+        if not committed_al.get("passes"):
+            problems.append(
+                "committed BENCH_allocator.json does not pass (one "
+                "fleet beats both static halves, preemption within "
+                "budget, zero allocation-invariant violations)"
+            )
+        if committed_al.get("campaigns", {}).get("violation_count", 1):
+            problems.append(
+                "committed BENCH_allocator.json records allocation-"
+                "invariant violations"
+            )
+        with tempfile.TemporaryDirectory(
+            prefix="tk8s-alloc-check-"
+        ) as tmp:
+            cur_co, cur_st, cur_train = run_coschedule_cost_drives(
+                Path(tmp)
+            )
+        current["allocator"] = {"coscheduled": cur_co,
+                                "static_serve": cur_st,
+                                "static_train_steps": cur_train}
+        for violation in cur_co["violations"] + cur_st["violations"]:
+            problems.append(f"allocation invariant violated: "
+                            f"{violation}")
+        if cur_co["completed"] <= cur_st["completed"]:
+            problems.append(
+                f"co-scheduled goodput no longer beats the static "
+                f"serving half-fleet ({cur_co['completed']} vs "
+                f"{cur_st['completed']} completed)"
+            )
+        if cur_co["training"]["steps"] <= cur_train:
+            problems.append(
+                f"co-scheduled training no longer beats the static "
+                f"training half-fleet ({cur_co['training']['steps']} "
+                f"vs {cur_train:.0f} steps)"
+            )
+        max_loss = max(
+            (r["steps_lost"] for r in cur_co["training"]["resumes"]),
+            default=0,
+        )
+        if max_loss > COSCHEDULE_CHECKPOINT_EVERY:
+            problems.append(
+                f"a preemption cost {max_loss} training steps — over "
+                f"one checkpoint interval "
+                f"({COSCHEDULE_CHECKPOINT_EVERY})"
+            )
+        if cur_co["preempt_mttr_s"] is None:
+            problems.append(
+                "co-scheduled drive recorded no unattended preemption "
+                "under the burst"
+            )
+        compare("co-scheduling preemption MTTR (vs policy budget)",
+                max(committed_al.get("value") or 0.0,
+                    COSCHEDULE_MTTR_BUDGET_S),
+                cur_co["preempt_mttr_s"])
+
     obs_baseline = Path(obs_baseline)
     if not obs_baseline.exists():
         problems.append(f"baseline {obs_baseline} missing (obs)")
@@ -2961,6 +3255,19 @@ def main(argv: list[str] | None = None) -> int:
                         "up / supervisor-kill-mid-scale drills, and N "
                         "seeded elasticity campaigns checked against "
                         "the scale invariants (BENCH_autoscale.json)")
+    parser.add_argument("--allocator", action="store_true",
+                        help="run the train/serve co-scheduling "
+                        "drills: the diurnal+burst trace on ONE "
+                        "4-slice fleet (allocator lends troughs to "
+                        "training, preempts on the surge through the "
+                        "notice/ack/role-change protocol) vs two "
+                        "static half-fleets — goodput AND training "
+                        "steps must both win — plus the supervisor-"
+                        "kill-mid-handover / never-acking-trainer / "
+                        "tenant-flood drills and N seeded "
+                        "co-scheduling campaigns checked against the "
+                        "allocation + WFQ invariants "
+                        "(BENCH_allocator.json)")
     parser.add_argument("--obs", action="store_true",
                         help="run the telemetry-overhead drills: the "
                         "gateway claim path and the REAL engine step "
@@ -3007,6 +3314,8 @@ def main(argv: list[str] | None = None) -> int:
         result = run_serve_chaos_benchmark(campaigns=max(1, args.campaigns))
     elif args.autoscale:
         result = run_autoscale_benchmark(campaigns=max(1, args.campaigns))
+    elif args.allocator:
+        result = run_allocator_benchmark(campaigns=max(1, args.campaigns))
     elif args.obs:
         result = run_obs_overhead_benchmark()
     elif args.warm:
@@ -3147,6 +3456,36 @@ def main(argv: list[str] | None = None) -> int:
             f"sup-kill restarts "
             f"{drills['supervisor_kill_mid_scale']['supervisor_restarts']}"
             f"; {sweep['campaigns']} campaigns: {sweep['converged']} "
+            f"converged, {sweep['violation_count']} violation(s) -> "
+            f"passes={result['passes']}",
+            file=sys.stderr,
+        )
+        return 0 if result["passes"] else 1
+    if args.allocator:
+        good = result["goodput"]
+        train = result["training"]
+        sweep = result["campaigns"]
+        drills = result["drills"]
+        print(
+            f"\nco-scheduling (simulated, diurnal+burst): ONE 4-slice "
+            f"fleet completed {good['coscheduled_completed']} vs the "
+            f"2-slice static half's {good['static_serve_completed']} "
+            f"(+{good['margin']}), banked "
+            f"{train['coscheduled_steps']} training steps vs the "
+            f"static half's {train['static_train_steps']:.0f} "
+            f"({train['coscheduled_steps_per_day']:.0f} vs "
+            f"{train['static_steps_per_day']:.0f} steps/day); "
+            f"preemption MTTR {result['value']:.0f}s (budget "
+            f"{result['mttr_budget_s']:.0f}s), worst resume lost "
+            f"{result['max_resume_steps_lost']} step(s) (<= "
+            f"{result['checkpoint_every_steps']}/interval); drills: "
+            f"kill-mid-handover restarts "
+            f"{drills['supervisor_kill_mid_handover']['supervisor_restarts']}"
+            f", never-ack forced "
+            f"{drills['never_acking_trainer']['handovers']['forced']}, "
+            f"tenant-flood sheds "
+            f"{drills['tenant_flood']['sheds']}; "
+            f"{sweep['campaigns']} campaigns: {sweep['converged']} "
             f"converged, {sweep['violation_count']} violation(s) -> "
             f"passes={result['passes']}",
             file=sys.stderr,

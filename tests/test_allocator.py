@@ -1,0 +1,616 @@
+"""Train/serve co-scheduling (provision/allocator.py + the supervisor's
+third controller): the role fold's hysteresis/staleness/cold-start
+guards, the ledger fold of the preemption protocol (notice -> ack ->
+role change, with compact round-trip and pre-allocation compatibility),
+and supervisor-level drills — lend-on-idle, preempt-with-ack, the ack
+landing exactly at the bounded-wait deadline, the never-acking trainer
+forced past it, SIGKILL between PREEMPT_NOTICE and ROLE_CHANGED
+resuming the SAME handover, and the one-demand-read-per-tick pin."""
+
+import json
+
+import pytest
+
+from tritonk8ssupervisor_tpu.provision import allocator as al_mod
+from tritonk8ssupervisor_tpu.provision import autoscale as as_mod
+from tritonk8ssupervisor_tpu.provision import events as ev
+from tritonk8ssupervisor_tpu.provision import retry
+from tritonk8ssupervisor_tpu.provision import supervisor as sup_mod
+from tritonk8ssupervisor_tpu.provision.state import atomic_write_text
+from tritonk8ssupervisor_tpu.testing import chaos
+from tritonk8ssupervisor_tpu.testing.faults import SupervisorKilled
+from tritonk8ssupervisor_tpu.testing.simclock import SimClock
+
+
+def demand_doc(now, queue_depth=0, inflight=None, sheds=0, p99=None,
+               rate=2.0):
+    return {
+        "v": 1, "updated": now, "queue_depth": queue_depth,
+        "service_rate": rate, "p99_s": p99, "recent_sheds": sheds,
+        "deadline_headroom_s": None,
+        "inflight": {str(k): v for k, v in (inflight or {}).items()},
+        "active_workers": [],
+    }
+
+
+def write_demand(path, now, **kwargs):
+    atomic_write_text(path, json.dumps(demand_doc(now, **kwargs)))
+
+
+def signal(now, **kwargs):
+    return as_mod.parse_demand_signal(demand_doc(now, **kwargs))
+
+
+def make_allocator(envelope=4, **overrides):
+    policy = al_mod.AllocatorPolicy(
+        min_serving=1, min_training=0, train_slices=0,
+        up_queue_per_slice=6.0, slo_p99_s=60.0,
+        idle_queue_per_slice=2.0, idle_p99_margin=0.5,
+        confirm_to_serving=2, confirm_to_training=3,
+        cooldown_s=60.0, cooldown_cap_s=600.0,
+        ack_timeout_s=60.0, drain_timeout_s=120.0,
+        idle_inflight_per_slice=3.0, signal_max_age_s=90.0,
+    )
+    for key, value in overrides.items():
+        setattr(policy, key, value)
+    return al_mod.Allocator(
+        policy, envelope,
+        cooldown=retry.Cooldown(policy.cooldown_s,
+                                policy.cooldown_cap_s,
+                                rng=lambda: 0.0),
+    )
+
+
+# ----------------------------------------------------------- role fold
+
+
+def test_preempt_needs_consecutive_confirmation():
+    alloc = make_allocator()
+    busy = lambda t: signal(t, queue_depth=60)  # noqa: E731
+    assert alloc.observe(busy(0.0), 2, 2, now=0.0) is None  # window 1
+    got = alloc.observe(busy(30.0), 2, 2, now=30.0)  # window 2: fires
+    assert got is not None
+    assert got.direction == al_mod.TO_SERVING
+    assert got.windows == 2
+    assert got.count == 2  # backlog-sized, capped at the training set
+
+
+def test_nothing_to_preempt_past_the_training_floor():
+    alloc = make_allocator(min_training=1)
+    busy = lambda t: signal(t, queue_depth=60)  # noqa: E731
+    alloc.observe(busy(0.0), 3, 1, now=0.0)
+    # training holds exactly the floor: pressure noted, no decision
+    assert alloc.observe(busy(30.0), 3, 1, now=30.0) is None
+
+
+def test_lend_needs_more_evidence_and_respects_min_serving():
+    alloc = make_allocator()
+    idle = lambda t: signal(t, queue_depth=0)  # noqa: E731
+    assert alloc.observe(idle(0.0), 2, 2, now=0.0) is None
+    assert alloc.observe(idle(30.0), 2, 2, now=30.0) is None
+    got = alloc.observe(idle(60.0), 2, 2, now=60.0)  # 3rd window fires
+    assert got is not None and got.direction == al_mod.TO_TRAINING
+    # at the serving floor, idleness never lends the last slice away
+    alloc2 = make_allocator()
+    for t in (0.0, 30.0, 60.0, 90.0):
+        assert alloc2.observe(idle(t), 1, 3, now=t) is None
+
+
+def test_cold_start_never_lends():
+    """An empty queue with NO observed completions (service_rate None)
+    is a cold start, not idleness — lending on it hands slices away
+    right as the first ramp arrives."""
+    alloc = make_allocator()
+    cold = lambda t: signal(t, queue_depth=0, rate=None)  # noqa: E731
+    for t in (0.0, 30.0, 60.0, 90.0, 120.0):
+        assert alloc.observe(cold(t), 3, 1, now=t) is None
+    assert alloc.train_streak == 0
+
+
+def test_stale_or_torn_signal_resets_streaks():
+    alloc = make_allocator()
+    busy = signal(100.0, queue_depth=60)
+    alloc.observe(busy, 2, 2, now=100.0)
+    assert alloc.serve_streak == 1
+    assert alloc.observe(busy, 2, 2, now=300.0) is None  # stale
+    assert alloc.serve_streak == 0
+    alloc.observe(signal(310.0, queue_depth=60), 2, 2, now=310.0)
+    assert alloc.observe(None, 2, 2, now=340.0) is None  # torn
+    assert alloc.serve_streak == 0
+
+
+def test_cooldown_holds_without_destroying_the_streak():
+    alloc = make_allocator()
+    busy = lambda t: signal(t, queue_depth=60)  # noqa: E731
+    alloc.observe(busy(0.0), 2, 2, now=0.0)
+    assert alloc.observe(busy(30.0), 2, 2, now=30.0) is not None
+    alloc.note_action(30.0)  # cooldown until 90
+    alloc.observe(busy(60.0), 2, 2, now=60.0)
+    assert alloc.observe(busy(80.0), 2, 2, now=80.0) is None  # held
+    got = alloc.observe(busy(100.0), 2, 2, now=100.0)  # lapsed: fires
+    assert got is not None and got.direction == al_mod.TO_SERVING
+
+
+def test_lend_count_sized_by_queue_and_inflight():
+    alloc = make_allocator(confirm_to_training=1)
+    # queue 0 but 9 streams in flight: lending past 3 remaining would
+    # exceed 3 streams/slice — k stays at 1
+    busy_inflight = signal(0.0, queue_depth=0,
+                           inflight={0: 3, 1: 3, 2: 3})
+    got = alloc.observe(busy_inflight, 4, 0, now=0.0)
+    assert got is not None and got.count == 1
+    # genuinely idle: lend down to the serving floor in ONE handover
+    # (three one-at-a-time lends would cost the trainer three resumes)
+    alloc2 = make_allocator(confirm_to_training=1)
+    got2 = alloc2.observe(signal(0.0, queue_depth=0), 4, 0, now=0.0)
+    assert got2 is not None and got2.count == 3
+
+
+def test_initial_training_assignment_and_env_policy(monkeypatch):
+    alloc = make_allocator(train_slices=2)
+    assert alloc.initial_training([0, 1, 2, 3]) == [2, 3]
+    # capped so serving keeps its floor
+    alloc2 = make_allocator(train_slices=4, min_serving=2)
+    assert alloc2.initial_training([0, 1, 2, 3]) == [2, 3]
+    assert make_allocator(train_slices=0).initial_training(
+        [0, 1, 2, 3]) == []
+    monkeypatch.setenv("TK8S_ALLOC_TRAIN_SLICES", "3")
+    monkeypatch.setenv("TK8S_ALLOC_ACK_TIMEOUT", "45")
+    policy = al_mod.AllocatorPolicy.from_env()
+    assert policy.train_slices == 3
+    assert policy.ack_timeout_s == 45.0
+
+
+# -------------------------------------------------------- ledger fold
+
+
+def _rec(kind, ts, **fields):
+    return {"v": 1, "ts": ts, "kind": kind, **fields}
+
+
+def test_fold_notice_ack_role_change_updates_roles_and_generation():
+    view = ev.fold([
+        _rec(ev.ROLE_CHANGED, 0.0, id="alloc-initial", slices=[2, 3],
+             role="training", initial=True),
+        _rec(ev.PREEMPT_NOTICE, 60.0, id="h-1", direction="to-serving",
+             slices=[2, 3], ack_deadline=120.0),
+    ])
+    assert view.roles == {2: "transitioning", 3: "transitioning"}
+    assert view.open_handover["id"] == "h-1"
+    gen_mid = view.membership_generation
+    view = ev.fold([
+        _rec(ev.ROLE_CHANGED, 0.0, id="alloc-initial", slices=[2, 3],
+             role="training", initial=True),
+        _rec(ev.PREEMPT_NOTICE, 60.0, id="h-1", direction="to-serving",
+             slices=[2, 3], ack_deadline=120.0),
+        _rec(ev.PREEMPT_ACK, 90.0, id="h-1", slices=[2, 3],
+             forced=False),
+        _rec(ev.ROLE_CHANGED, 90.0, id="h-1", slices=[2, 3],
+             role="serving"),
+    ])
+    assert view.roles == {2: "serving", 3: "serving"}
+    assert view.open_handover is None
+    assert view.preempt_acks == 1 and view.forced_preemptions == 0
+    # notice holds the generation; the ROLE_CHANGED bumps exactly once
+    assert view.membership_generation == gen_mid + 1
+
+
+def test_fold_aborted_handback_does_not_bump_generation():
+    """An aborted hand-back never moved any membership: the slices
+    never left serving (nothing to reap) and the trainer's world never
+    changed (nothing to re-form) — bumping would charge the trainer a
+    full teardown/rejoin for a handover that never happened."""
+    base = [
+        _rec(ev.PREEMPT_NOTICE, 60.0, id="h-1", direction="to-training",
+             slices=[3], drain_deadline=180.0),
+    ]
+    before = ev.fold(base).membership_generation
+    view = ev.fold(base + [
+        _rec(ev.ROLE_CHANGED, 90.0, id="h-1", slices=[3],
+             role="serving", aborted=True),
+    ])
+    assert view.membership_generation == before
+    assert view.roles == {3: "serving"}
+    assert view.open_handover is None
+
+
+def test_fleet_status_allocation_block_and_routing():
+    """TRAINING slices leave serving.eligible; TRANSITIONING slices
+    read as draining to BOTH consumers (the Router finishes in-flight
+    and pulls nothing; the trainer opens its checkpoint window)."""
+    records = [
+        _rec(ev.TICK, 0.0, tick=1,
+             states={"0": "healthy", "1": "healthy", "2": "healthy",
+                     "3": "healthy"}),
+        _rec(ev.ROLE_CHANGED, 1.0, id="alloc-initial", slices=[2, 3],
+             role="training", initial=True),
+        _rec(ev.PREEMPT_NOTICE, 60.0, id="h-1", direction="to-serving",
+             slices=[3], ack_deadline=120.0),
+    ]
+    doc = ev.fleet_status(ev.fold(records), 70.0)
+    assert doc["serving"]["eligible"] == [0, 1]
+    assert doc["membership"]["draining"] == [3]
+    alloc = doc["allocation"]
+    assert alloc["enabled"] is True
+    assert alloc["training"] == [2]
+    assert alloc["transitioning"] == [3]
+    assert alloc["roles"] == {"serving": 2, "training": 1,
+                              "transitioning": 1}
+    assert alloc["in_progress"]["id"] == "h-1"
+    assert alloc["in_progress"]["acked"] is False
+
+
+def test_pre_allocation_ledgers_fold_unchanged():
+    records = [
+        _rec(ev.SUPERVISOR_START, 0.0, pid=1),
+        _rec(ev.TICK, 1.0, tick=1, states={"0": "healthy",
+                                           "1": "healthy"}),
+    ]
+    doc = ev.fleet_status(ev.fold(records), 2.0)
+    assert doc["serving"]["eligible"] == [0, 1]
+    assert doc["allocation"]["enabled"] is False
+    assert doc["allocation"]["training"] == []
+    assert doc["allocation"]["in_progress"] is None
+
+
+def test_alloc_fold_survives_compaction(tmp_path):
+    """The open handover is the mid-handover crash signature — it must
+    survive compact() the way orphaned heal-starts do, and the role
+    map with it."""
+    ledger = ev.EventLedger(tmp_path / "events.jsonl",
+                            echo=lambda line: None)
+    ledger.append(ev.ROLE_CHANGED, id="alloc-initial", slices=[2, 3],
+                  role="training", initial=True)
+    ledger.append(ev.ALLOC_DECISION, direction="to-serving", count=2,
+                  reason="shedding", windows=2, signal_age_s=1.0)
+    ledger.append(ev.PREEMPT_NOTICE, id="h-9", direction="to-serving",
+                  slices=[2, 3], ack_deadline=500.0)
+    before = ev.fold(ledger.replay())
+    ledger.compact()
+    after = ev.fold(ledger.replay())
+    assert after.roles == before.roles
+    assert after.open_handover["id"] == "h-9"
+    assert after.alloc_decisions == before.alloc_decisions == 1
+    assert after.preempt_notices == before.preempt_notices == 1
+    assert after.last_alloc_decision == before.last_alloc_decision
+    assert (after.membership_generation
+            == before.membership_generation)
+    # and later records still fold on top
+    ledger.append(ev.ROLE_CHANGED, id="h-9", slices=[2, 3],
+                  role="serving")
+    final = ev.fold(ledger.replay())
+    assert final.roles == {2: "serving", 3: "serving"}
+    assert final.open_handover is None
+
+
+# -------------------------------------------------- supervisor drills
+
+
+def make_alloc_world(tmp_path, num_slices=4, alloc_overrides=None,
+                     ledger=None):
+    clock = SimClock()
+    config = chaos.sim_config(num_slices)
+    world = chaos.ChaosFleet(tmp_path, clock, config,
+                             heal_seconds=30.0)
+    overrides = dict(train_slices=2, confirm_to_serving=2,
+                     confirm_to_training=3, cooldown_s=30.0,
+                     ack_timeout_s=60.0, drain_timeout_s=120.0)
+    overrides.update(alloc_overrides or {})
+    allocator = make_allocator(envelope=num_slices, **overrides)
+    supervisor = sup_mod.Supervisor(
+        config, world.paths, chaos._Quiet(),
+        run=world.run, run_quiet=world.run_quiet,
+        policy=chaos.default_policy(),
+        ledger=ledger if ledger is not None else ev.EventLedger(
+            world.paths.events, clock=clock.time,
+            echo=lambda line: None),
+        clock=clock.time, sleep=clock.sleep, rng=lambda: 0.0,
+        readiness_timeout=60.0, hooks=clock, allocator=allocator,
+    )
+    return world, supervisor, clock
+
+
+def tick_n(supervisor, clock, world, n, interval=30.0, demand=None):
+    for _ in range(n):
+        if demand is not None:
+            write_demand(world.paths.demand_signal, clock.time(),
+                         **demand)
+        supervisor.tick()
+        clock.sleep(interval)
+
+
+def write_ack(world, clock, phase, generation, step=100):
+    atomic_write_text(world.paths.job_ack, json.dumps({
+        "v": 1, "ts": clock.time(), "phase": phase,
+        "generation": generation, "step": step, "world": 2,
+        "slices": [], "reason": "drain notice",
+    }))
+
+
+def test_supervisor_lends_idle_slices_to_training(tmp_path):
+    world, supervisor, clock = make_alloc_world(
+        tmp_path, alloc_overrides=dict(train_slices=0))
+    clock.begin()
+    try:
+        supervisor.restore()
+        tick_n(supervisor, clock, world, 4,
+               demand=dict(queue_depth=0, rate=2.0))
+    finally:
+        clock.release()
+    records = supervisor.ledger.replay()
+    notices = [r for r in records if r["kind"] == ev.PREEMPT_NOTICE]
+    changed = [r for r in records if r["kind"] == ev.ROLE_CHANGED]
+    assert notices and notices[0]["direction"] == "to-training"
+    assert changed and changed[-1]["role"] == "training"
+    doc = supervisor.status_doc(clock.time())
+    assert doc["allocation"]["training"] == changed[-1]["slices"]
+    for i in changed[-1]["slices"]:
+        assert i not in doc["serving"]["eligible"]
+
+
+def test_preemption_protocol_notice_ack_role_change(tmp_path):
+    world, supervisor, clock = make_alloc_world(tmp_path)
+    clock.begin()
+    try:
+        supervisor.restore()
+        # surge: confirmed after 2 windows -> PREEMPT_NOTICE opens the
+        # checkpoint window; the trainer acks; the roles flip
+        tick_n(supervisor, clock, world, 2,
+               demand=dict(queue_depth=60))
+        doc = supervisor.status_doc(clock.time())
+        assert doc["allocation"]["in_progress"]["direction"] \
+            == "to-serving"
+        # the preempting slices sit in draining: the trainer's notice
+        assert doc["membership"]["draining"] == [2, 3]
+        gen = doc["membership"]["generation"]
+        write_ack(world, clock, "notified", gen)
+        tick_n(supervisor, clock, world, 1,
+               demand=dict(queue_depth=60))
+    finally:
+        clock.release()
+    records = supervisor.ledger.replay()
+    acks = [r for r in records if r["kind"] == ev.PREEMPT_ACK]
+    changed = [r for r in records if r["kind"] == ev.ROLE_CHANGED
+               and not r.get("initial")]
+    assert acks and acks[0]["forced"] is False
+    assert changed and changed[0]["role"] == "serving"
+    assert changed[0]["slices"] == [2, 3]
+    doc = supervisor.status_doc(clock.time())
+    assert doc["serving"]["eligible"] == [0, 1, 2, 3]
+    assert doc["allocation"]["in_progress"] is None
+    assert doc["membership"]["generation"] > gen
+
+
+def test_ack_exactly_at_deadline_is_not_forced(tmp_path):
+    """Satellite pin: the ack is consulted BEFORE the deadline check,
+    so a trainer acking exactly AT the bounded-wait deadline is an
+    acknowledged preemption, never a forced one."""
+    world, supervisor, clock = make_alloc_world(
+        tmp_path, alloc_overrides=dict(ack_timeout_s=60.0))
+    clock.begin()
+    try:
+        supervisor.restore()
+        tick_n(supervisor, clock, world, 2,
+               demand=dict(queue_depth=60))  # notice at t=30
+        doc = supervisor.status_doc(clock.time())
+        deadline = doc["allocation"]["in_progress"]["ack_deadline"]
+        # wait (no ack) until the tick landing EXACTLY at the deadline
+        while clock.time() < deadline:
+            tick_n(supervisor, clock, world, 1,
+                   demand=dict(queue_depth=60))
+            if clock.time() >= deadline:
+                break
+        assert clock.time() == deadline
+        write_ack(world, clock, "notified",
+                  doc["membership"]["generation"])
+        tick_n(supervisor, clock, world, 1,
+               demand=dict(queue_depth=60))
+    finally:
+        clock.release()
+    acks = [r for r in supervisor.ledger.replay()
+            if r["kind"] == ev.PREEMPT_ACK]
+    assert acks and acks[0]["forced"] is False
+    assert acks[0]["ts"] == deadline
+
+
+def test_never_acking_trainer_is_forced_only_past_deadline(tmp_path):
+    world, supervisor, clock = make_alloc_world(
+        tmp_path, alloc_overrides=dict(ack_timeout_s=60.0))
+    clock.begin()
+    try:
+        supervisor.restore()
+        tick_n(supervisor, clock, world, 6,
+               demand=dict(queue_depth=60))
+    finally:
+        clock.release()
+    records = supervisor.ledger.replay()
+    notices = [r for r in records if r["kind"] == ev.PREEMPT_NOTICE]
+    acks = [r for r in records if r["kind"] == ev.PREEMPT_ACK]
+    changed = [r for r in records if r["kind"] == ev.ROLE_CHANGED
+               and not r.get("initial")]
+    assert acks and acks[0]["forced"] is True
+    assert acks[0]["ts"] >= notices[0]["ack_deadline"]
+    assert changed and changed[0]["role"] == "serving"
+
+
+def test_sigkill_mid_handover_resumes_same_id(tmp_path):
+    """Satellite pin: killed between PREEMPT_NOTICE and ROLE_CHANGED,
+    the restarted supervisor RESUMES the open handover under its
+    ORIGINAL id — never a sibling notice, never a double-assigned
+    slice."""
+    clock = SimClock()
+    config = chaos.sim_config(4)
+    world = chaos.ChaosFleet(tmp_path, clock, config, heal_seconds=30.0)
+    ledger = chaos.KillOnKindLedger(
+        world.paths.events, clock=clock.time, echo=lambda line: None,
+        kill_kind=ev.PREEMPT_NOTICE, kill_after=1,
+    )
+
+    def make_supervisor():
+        return sup_mod.Supervisor(
+            config, world.paths, chaos._Quiet(),
+            run=world.run, run_quiet=world.run_quiet,
+            policy=chaos.default_policy(),
+            ledger=ledger, clock=clock.time, sleep=clock.sleep,
+            rng=lambda: 0.0, readiness_timeout=60.0, hooks=clock,
+            allocator=make_allocator(envelope=4, train_slices=2,
+                                     ack_timeout_s=60.0,
+                                     cooldown_s=30.0),
+        )
+
+    supervisor = make_supervisor()
+    clock.begin()
+    try:
+        supervisor.restore()
+        killed = False
+        for _ in range(3):
+            write_demand(world.paths.demand_signal, clock.time(),
+                         queue_depth=60)
+            try:
+                supervisor.tick()
+            except SupervisorKilled:
+                killed = True
+                break
+            clock.sleep(30.0)
+        assert killed, "the scripted kill on PREEMPT_NOTICE never fired"
+        # restart: resume from the ledger, then ack and finish
+        supervisor = make_supervisor()
+        view = supervisor.restore()
+        assert view.open_handover is not None
+        assert "resuming after a crash mid-handover" \
+            in supervisor.prompter.text()
+        write_ack(world, clock, "notified", view.membership_generation)
+        tick_n(supervisor, clock, world, 2,
+               demand=dict(queue_depth=60))
+    finally:
+        clock.release()
+    records = supervisor.ledger.replay()
+    notices = [r for r in records if r["kind"] == ev.PREEMPT_NOTICE]
+    changed = [r for r in records if r["kind"] == ev.ROLE_CHANGED
+               and not r.get("initial")]
+    assert len(notices) == 1, "restart minted a sibling handover"
+    assert changed and changed[0]["id"] == notices[0]["id"]
+    from tritonk8ssupervisor_tpu.serving.gateway import GatewayPolicy
+
+    checker = chaos.ServeInvariantChecker(
+        GatewayPolicy(), alloc_policy=supervisor.allocator.policy,
+    )
+    assert checker.check_handover_protocol(records) == []
+    assert checker.check_role_exclusivity(records) == []
+
+
+def test_demand_signal_read_once_per_tick(tmp_path, monkeypatch):
+    """Satellite pin: the autoscaler and the allocator act on ONE
+    shared demand snapshot per tick — two independent reads could land
+    either side of an atomic rewrite and the two controllers would act
+    on different windows."""
+    clock = SimClock()
+    config = chaos.sim_config(4)
+    world = chaos.ChaosFleet(tmp_path, clock, config, heal_seconds=30.0)
+    autoscaler = as_mod.Autoscaler(
+        as_mod.AutoscalePolicy(min_slices=1, max_slices=4),
+        envelope=4,
+        cooldown=retry.Cooldown(60.0, 600.0, rng=lambda: 0.0),
+    )
+    supervisor = sup_mod.Supervisor(
+        config, world.paths, chaos._Quiet(),
+        run=world.run, run_quiet=world.run_quiet,
+        policy=chaos.default_policy(),
+        ledger=ev.EventLedger(world.paths.events, clock=clock.time,
+                              echo=lambda line: None),
+        clock=clock.time, sleep=clock.sleep, rng=lambda: 0.0,
+        readiness_timeout=60.0, hooks=clock,
+        autoscaler=autoscaler,
+        allocator=make_allocator(envelope=4, train_slices=1),
+    )
+    reads = []
+    real_read = as_mod.read_demand_signal
+
+    def counting_read(path):
+        reads.append(str(path))
+        return real_read(path)
+
+    monkeypatch.setattr(
+        sup_mod.autoscale_mod, "read_demand_signal", counting_read
+    )
+    clock.begin()
+    try:
+        supervisor.restore()
+        for _ in range(3):
+            write_demand(world.paths.demand_signal, clock.time(),
+                         queue_depth=5)
+            before = len(reads)
+            supervisor.tick()
+            assert len(reads) - before == 1, (
+                "tick read the demand signal more than once "
+                "(torn-read race between the two controllers)"
+            )
+            clock.sleep(30.0)
+    finally:
+        clock.release()
+
+
+def test_roles_survive_restart(tmp_path):
+    world, supervisor, clock = make_alloc_world(tmp_path)
+    clock.begin()
+    try:
+        supervisor.restore()
+        tick_n(supervisor, clock, world, 1,
+               demand=dict(queue_depth=5))
+        doc = supervisor.status_doc(clock.time())
+        assert doc["allocation"]["training"] == [2, 3]
+        # a fresh supervisor over the same ledger restores the role
+        # split and does NOT re-seed (no second initial assignment)
+        world2, supervisor2, _ = make_alloc_world(tmp_path)
+        supervisor2.ledger = supervisor.ledger
+        view = supervisor2.restore()
+        assert view.roles == {2: "training", 3: "training"}
+        assert supervisor2._roles_seeded is True
+    finally:
+        clock.release()
+
+
+# ------------------------------------------------ tier-1 campaign smoke
+
+
+def test_coschedule_campaign_smoke(tmp_path):
+    """Tier-1 few-seed co-scheduling smoke: seeded campaigns (surge
+    preemption + a supervisor kill mid-handover among them) fold
+    violation-free through the allocation + WFQ invariants."""
+    for seed in (1, 4):
+        scenario = chaos.generate_coschedule_scenario(seed)
+        out = chaos.run_coschedule_campaign(scenario,
+                                            tmp_path / f"s{seed}")
+        assert out["violations"] == []
+        assert out["converged"] is True
+        assert out["handovers"]["notices"] > 0
+        assert out["training"]["steps"] > 0
+
+
+@pytest.mark.perf
+def test_committed_allocator_bench_passes():
+    """Structural pin on the committed BENCH_allocator.json: the
+    co-scheduled fleet beats BOTH static halves, preemption stayed
+    within budget and one checkpoint interval, and the campaigns were
+    violation-free."""
+    from pathlib import Path
+
+    doc = json.loads(
+        (Path(__file__).resolve().parent.parent
+         / "BENCH_allocator.json").read_text()
+    )
+    assert doc["passes"] is True
+    assert doc["campaigns"]["violation_count"] == 0
+    assert doc["campaigns"]["campaigns"] >= 25
+    good = doc["goodput"]
+    assert good["coscheduled_completed"] > good["static_serve_completed"]
+    train = doc["training"]
+    assert train["coscheduled_steps"] > train["static_train_steps"]
+    assert train["coscheduled_steps_per_day"] \
+        > train["static_steps_per_day"]
+    assert doc["value"] <= doc["mttr_budget_s"]
+    assert doc["max_resume_steps_lost"] <= doc["checkpoint_every_steps"]
+    drills = doc["drills"]
+    assert drills["supervisor_kill_mid_handover"][
+        "supervisor_restarts"] >= 1
+    assert drills["never_acking_trainer"]["handovers"]["forced"] >= 1

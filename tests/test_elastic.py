@@ -580,3 +580,65 @@ def test_two_process_sigkill_drill(tmp_path):
         assert ev.DEGRADED_ACK in recorded, recorded
     finally:
         localcluster.kill_cluster(procs)
+
+
+def test_drain_notice_during_emergency_checkpoint_single_flush(tmp_path):
+    """Co-scheduling edge (tests the PREEMPT_NOTICE window): a drain
+    notice lands in the SAME poll window as a membership bump — the
+    trainer is already inside its emergency-checkpoint path when the
+    draining list appears. The reconfigure's own boundary flush wins:
+    ONE save at the boundary step, no second drain flush, no steps
+    lost, and the ack sequence is notified -> resumed."""
+    clock = FakeClock()
+    # the poll at step 5 carries BOTH the drain notice and the bump:
+    # generation moved AND the slice is draining (the supervisor's
+    # PREEMPT_NOTICE publishes exactly this shape mid-handover)
+    health = elastic.ScriptedHealthSource(
+        [view(1, updated=1.0)] * 5
+        + [view(2, draining=(3,), updated=2.0),
+           view(2, updated=3.0)]
+    )
+    ckpt = FakeCkpt()
+    trainer, calls, _ = make_trainer(
+        tmp_path, health,
+        policy=elastic.ElasticPolicy(checkpoint_every=100),
+        ckpt=ckpt, clock=clock,
+    )
+    report = trainer.run(8)
+    assert report["final_step"] == 8
+    # the generation bump took the reconfigure path: state was intact,
+    # so the boundary flush (wait=True) covered the drain notice too —
+    # exactly one save at the boundary, zero steps lost
+    assert report["steps_lost"] == 0
+    assert report["drain_flushes"] == 0  # reconfigure superseded it
+    at_step = report["resumes"][0]["at_step"]
+    boundary_saves = [s for s in ckpt.saves if s == (at_step, True)]
+    assert len(boundary_saves) == 1
+    assert len(report["resumes"]) == 1
+    ack = read_ack(tmp_path)
+    assert ack["phase"] == "resumed" and ack["generation"] == 2
+
+
+def test_drain_notice_then_bump_next_poll_costs_zero_steps(tmp_path):
+    """The sequenced form of the same edge: notice first (flush at the
+    window), the bump one poll later — the flush already covered the
+    progress, so the resume loses zero steps even though the trainer
+    kept stepping after the flush and the reconfigure re-flushes at
+    the boundary."""
+    clock = FakeClock()
+    health = elastic.ScriptedHealthSource(
+        [view(1, updated=1.0)] * 4
+        + [view(1, draining=(3,), updated=2.0)]   # notice at step 4
+        + [view(2, updated=3.0)]                  # bump at step 5
+    )
+    ckpt = FakeCkpt()
+    trainer, calls, _ = make_trainer(
+        tmp_path, health,
+        policy=elastic.ElasticPolicy(checkpoint_every=100),
+        ckpt=ckpt, clock=clock,
+    )
+    report = trainer.run(8)
+    assert report["final_step"] == 8
+    assert report["drain_flushes"] == 1
+    assert report["steps_lost"] == 0
+    assert len(report["resumes"]) == 1

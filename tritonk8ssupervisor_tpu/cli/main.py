@@ -246,6 +246,27 @@ def build_parser() -> argparse.ArgumentParser:
         "env TK8S_AUTOSCALE_MAX_SLICES) — pin it to cap spend",
     )
     parser.add_argument(
+        "--allocate", action="store_true",
+        help="supervise: enable train/serve co-scheduling — the third "
+        "controller folds the gateway's demand signal into per-slice "
+        "roles (SERVING / TRAINING / TRANSITIONING): idle troughs lend "
+        "slices to elastic training, a queue surge preempts them back "
+        "through the ledger-recorded PREEMPT_NOTICE -> job-ack -> "
+        "ROLE_CHANGED protocol (TK8S_ALLOC_* env knobs; "
+        "docs/failure-modes.md, 'Fleet allocation & preemption')",
+    )
+    parser.add_argument(
+        "--train-slices", type=int, default=None, metavar="N",
+        help="supervise --allocate: the N highest-index slices start "
+        "as the training world (default 0 — training only gets what "
+        "idle troughs lend it; env TK8S_ALLOC_TRAIN_SLICES)",
+    )
+    parser.add_argument(
+        "--min-serving", type=int, default=None, metavar="N",
+        help="supervise --allocate: never lend serving below N slices "
+        "(default 1; env TK8S_ALLOC_MIN_SERVING)",
+    )
+    parser.add_argument(
         "--json", action="store_true",
         help="status: print the raw fleet-status JSON document instead "
         "of the human summary",
@@ -323,6 +344,14 @@ def build_parser() -> argparse.ArgumentParser:
         help="serve: prompt tokens advanced per step boundary (default "
         "32) — one bounded chunk rides along each decode step so long "
         "prompts never stall decoding peers",
+    )
+    parser.add_argument(
+        "--tenant-weights", type=str, default="", metavar="T=W,...",
+        help="serve: per-tenant WFQ weights, e.g. 'interactive=3,"
+        "batch=1' — claim order becomes weighted fair queueing across "
+        "tenants (a flooding tenant is clamped near its weight share "
+        "of the queue budget); empty = one homogeneous stream "
+        "(docs/failure-modes.md, 'WFQ weight semantics')",
     )
     parser.add_argument(
         "--queue-budget", type=int, default=64, metavar="N",
@@ -697,6 +726,20 @@ def supervise_cmd(args, paths: state.RunPaths, prompter: Prompter) -> int:
         autoscaler = autoscale_mod.Autoscaler(
             autoscale_policy, envelope=config.num_slices
         )
+    allocator = None
+    if args.allocate:
+        from tritonk8ssupervisor_tpu.provision import (
+            allocator as allocator_mod,
+        )
+
+        alloc_policy = allocator_mod.AllocatorPolicy.from_env()
+        if args.train_slices is not None:
+            alloc_policy.train_slices = max(0, args.train_slices)
+        if args.min_serving is not None:
+            alloc_policy.min_serving = max(1, args.min_serving)
+        allocator = allocator_mod.Allocator(
+            alloc_policy, envelope=config.num_slices
+        )
     sup = supervisor_mod.Supervisor(
         config, paths, prompter,
         run=run, run_quiet=run_quiet,
@@ -705,6 +748,7 @@ def supervise_cmd(args, paths: state.RunPaths, prompter: Prompter) -> int:
         timer=timer,
         readiness_timeout=args.readiness_timeout,
         autoscaler=autoscaler,
+        allocator=allocator,
         # tick/diagnose/heal-wave spans + the /metrics-shaped registry,
         # snapshotted to metrics.json every tick (docs/observability.md)
         telemetry=obs_mod.Telemetry.for_run(
@@ -875,6 +919,29 @@ def status_cmd(args, paths: state.RunPaths, prompter: Prompter) -> int:
                 + (f", cooldown {cooldown:.0f}s"
                    if cooldown else "")
             )
+        allocation = doc.get("allocation") or {}
+        if allocation.get("enabled"):
+            roles = allocation.get("roles") or {}
+            last = allocation.get("last_decision") or {}
+            in_progress = allocation.get("in_progress")
+            handovers = allocation.get("handovers") or {}
+            prompter.say(
+                f"allocation: {roles.get('serving', 0)} serving / "
+                f"{roles.get('training', 0)} training"
+                + (f" / {roles.get('transitioning', 0)} transitioning"
+                   if roles.get("transitioning") else "")
+                + (f" (training slices "
+                   f"{allocation.get('training')})"
+                   if allocation.get("training") else "")
+                + (f", handover {in_progress.get('direction')} "
+                   f"{in_progress.get('slices')}"
+                   f"{' acked' if in_progress.get('acked') else ''}"
+                   if in_progress else "")
+                + (f", last {last.get('direction')} x{last.get('count')}"
+                   f" ({last.get('reason')})" if last else "")
+                + (f", {handovers.get('forced', 0)} forced"
+                   if handovers.get("forced") else "")
+            )
         membership = doc.get("membership", {})
         if membership:
             prompter.say(
@@ -1011,6 +1078,36 @@ def train_cmd(args, paths: state.RunPaths, prompter: Prompter) -> int:
     return 0
 
 
+def _parse_tenant_weights(raw: str) -> dict | None:
+    """'interactive=3,batch=1' -> {'interactive': 3.0, 'batch': 1.0};
+    empty/blank -> None (WFQ off). A malformed entry is a usage error,
+    not a silently-dropped tenant."""
+    raw = (raw or "").strip()
+    if not raw:
+        return None
+    weights: dict = {}
+    for part in raw.split(","):
+        name, sep, value = part.partition("=")
+        if not sep or not name.strip():
+            raise SystemExit(
+                f"--tenant-weights: expected TENANT=WEIGHT, got {part!r}"
+            )
+        try:
+            weight = float(value)
+        except ValueError:
+            raise SystemExit(
+                f"--tenant-weights: weight for {name.strip()!r} is not "
+                f"a number: {value!r}"
+            ) from None
+        if weight <= 0:
+            raise SystemExit(
+                f"--tenant-weights: weight for {name.strip()!r} must "
+                f"be positive, got {weight}"
+            )
+        weights[name.strip()] = weight
+    return weights
+
+
 def serve_cmd(args, paths: state.RunPaths, prompter: Prompter) -> int:
     """`./setup.sh serve` — the continuous-batching inference gateway
     (serving/gateway.py) over the real KV-cache decode stack
@@ -1060,6 +1157,7 @@ def serve_cmd(args, paths: state.RunPaths, prompter: Prompter) -> int:
         page_size=max(1, args.kv_page_size),
         pages_per_slice=(args.kv_pages if args.kv_pages > 0 else None),
         prefix_cache=not args.no_prefix_cache,
+        tenant_weights=_parse_tenant_weights(args.tenant_weights),
     )
     # the telemetry plane (obs/): spans fsync'd to the workdir's span
     # log (they survive a SIGKILL exactly like the request journal),

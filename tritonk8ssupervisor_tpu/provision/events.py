@@ -93,6 +93,26 @@ SCALE_HELD = "scale-held"  # decision confirmed but the breaker holds
 SCALE_BREAKER_OPEN = "scale-breaker-open"  # thrash breaker tripped
 SCALE_BREAKER_HALF_OPEN = "scale-breaker-half-open"  # one probe action
 SCALE_BREAKER_CLOSE = "scale-breaker-close"  # clean scale: gate lifts
+# Allocation vocabulary (provision/allocator.py): the train/serve
+# co-scheduling third controller's flight record. A PREEMPT_NOTICE
+# without a matching ROLE_CHANGED is the mid-handover crash signature —
+# a restarted supervisor RESUMES that handover under its original id,
+# so a kill can never double-assign a slice to both roles or orphan a
+# half-preempted trainer. PREEMPT_ACK closes the bounded wait for the
+# trainer's job-ack (forced=true past the ack deadline); ROLE_CHANGED
+# flips the named slices' role and bumps the membership generation
+# exactly once (the gateway requeues stragglers on it, the elastic
+# trainer re-forms at the new world size).
+ALLOC_DECISION = "alloc-decision"  # confirmed role reassignment
+PREEMPT_NOTICE = "preempt-notice"  # handover open: slices TRANSITIONING
+PREEMPT_ACK = "preempt-ack"  # trainer acked (or forced past deadline)
+ROLE_CHANGED = "role-changed"  # handover closed: roles flipped
+
+# Role vocabulary shared with provision/allocator.py (string literals
+# here to avoid the module cycle; tests pin the two stay in sync).
+_ROLE_SERVING = "serving"
+_ROLE_TRAINING = "training"
+_ROLE_TRANSITIONING = "transitioning"
 
 # Slice states the membership fold reasons about — mirrors
 # provision/heal.py's vocabulary (imported lazily there to avoid the
@@ -391,6 +411,21 @@ class LedgerView:
     scale_breaker_reopen_at: float | None = None
     scale_breaker_trips: int = 0
     scale_breaker_failures: list = dataclasses.field(default_factory=list)
+    # ---- allocation fold (provision/allocator.py) ----
+    # `roles` is the per-slice role map (absent slices are SERVING —
+    # pre-allocation ledgers fold to an empty map and byte-identical
+    # behavior). `open_handover` is a PREEMPT_NOTICE without a
+    # ROLE_CHANGED — the mid-handover crash signature.
+    alloc_enabled: bool = False
+    roles: dict = dataclasses.field(default_factory=dict)  # int -> role
+    open_handover: dict | None = None
+    last_alloc_decision: dict | None = None
+    alloc_decisions: int = 0
+    preempt_notices: int = 0
+    preempt_acks: int = 0
+    forced_preemptions: int = 0
+    role_changes: int = 0
+    alloc_cooldown_until: float | None = None
     open_heals: list = dataclasses.field(default_factory=list)  # records
     # heal-start id -> record, until a done/failed closes it (the list
     # above is kept in sync — it is the public face, this is the index)
@@ -469,6 +504,19 @@ def snapshot_fields(view: LedgerView) -> dict:
         "scale_breaker_reopen_at": view.scale_breaker_reopen_at,
         "scale_breaker_trips": view.scale_breaker_trips,
         "scale_breaker_failures": list(view.scale_breaker_failures),
+        # the allocation fold: per-slice roles and the open handover
+        # (the mid-handover crash signature — it must survive
+        # compaction the same way orphaned heal-starts do)
+        "alloc_enabled": view.alloc_enabled,
+        "roles": {str(k): v for k, v in view.roles.items()},
+        "open_handover": view.open_handover,
+        "last_alloc_decision": view.last_alloc_decision,
+        "alloc_decisions": view.alloc_decisions,
+        "preempt_notices": view.preempt_notices,
+        "preempt_acks": view.preempt_acks,
+        "forced_preemptions": view.forced_preemptions,
+        "role_changes": view.role_changes,
+        "alloc_cooldown_until": view.alloc_cooldown_until,
         # orphaned heal-starts (the crash signature) survive the compact
         "pending_heals": {str(k): v for k, v in view.pending_heals.items()},
         "mttr_samples": list(view.mttr_samples),
@@ -551,6 +599,17 @@ def _apply_snapshot(view: LedgerView, record: dict) -> None:
     view.scale_breaker_failures = list(
         record.get("scale_breaker_failures") or []
     )
+    view.alloc_enabled = bool(record.get("alloc_enabled", False))
+    view.roles = {int(k): str(v)
+                  for k, v in (record.get("roles") or {}).items()}
+    view.open_handover = record.get("open_handover")
+    view.last_alloc_decision = record.get("last_alloc_decision")
+    view.alloc_decisions = record.get("alloc_decisions", 0)
+    view.preempt_notices = record.get("preempt_notices", 0)
+    view.preempt_acks = record.get("preempt_acks", 0)
+    view.forced_preemptions = record.get("forced_preemptions", 0)
+    view.role_changes = record.get("role_changes", 0)
+    view.alloc_cooldown_until = record.get("alloc_cooldown_until")
     view.pending_heals = dict(record.get("pending_heals") or {})
     view.open_heals = list(view.pending_heals.values())
     view.mttr_samples = list(record.get("mttr_samples") or [])
@@ -749,6 +808,7 @@ def apply(view: LedgerView, record: dict) -> LedgerView:
         if record.get("direction") == "down":
             for index in record.get("slices", []):
                 view.slices.pop(int(index), None)
+                view.roles.pop(int(index), None)  # torn down: no role
         else:
             for index in record.get("slices", []):
                 sv = view.slice_view(int(index))
@@ -790,6 +850,58 @@ def apply(view: LedgerView, record: dict) -> LedgerView:
         view.scale_breaker_since = ts
         view.scale_breaker_reopen_at = None
         view.scale_breaker_failures = []
+    elif kind == ALLOC_DECISION:
+        view.alloc_enabled = True
+        view.alloc_decisions += 1
+        view.last_alloc_decision = {
+            "ts": ts,
+            "direction": record.get("direction"),
+            "count": record.get("count"),
+            "reason": str(record.get("reason", ""))[:200],
+            "windows": record.get("windows"),
+        }
+    elif kind == PREEMPT_NOTICE:
+        view.alloc_enabled = True
+        view.preempt_notices += 1
+        view.open_handover = record
+        if record.get("cooldown_until") is not None:
+            view.alloc_cooldown_until = record["cooldown_until"]
+        # both directions park the slices TRANSITIONING: the published
+        # status carries them in membership.draining, so the side that
+        # must let go drains — the trainer's checkpoint window
+        # (to-serving) or the Router's finish-in-flight (to-training)
+        for index in record.get("slices", []):
+            view.roles[int(index)] = _ROLE_TRANSITIONING
+    elif kind == PREEMPT_ACK:
+        view.preempt_acks += 1
+        if record.get("forced"):
+            view.forced_preemptions += 1
+        if (view.open_handover is not None
+                and view.open_handover.get("id") == record.get("id")):
+            view.open_handover = dict(view.open_handover,
+                                      acked=True,
+                                      forced=bool(record.get("forced")))
+    elif kind == ROLE_CHANGED:
+        view.alloc_enabled = True
+        view.role_changes += 1
+        role = record.get("role", _ROLE_SERVING)
+        for index in record.get("slices", []):
+            view.roles[int(index)] = role
+        if (view.open_handover is not None
+                and view.open_handover.get("id") == record.get("id")):
+            view.open_handover = None
+        # the serving set changed hands: one generation bump per
+        # executed role change — the gateway requeues a reclaimed
+        # slice's stragglers on it, and the elastic trainer re-forms
+        # at the new world size. The initial role assignment bumps too
+        # (the trainer must form at the post-assignment world). An
+        # ABORTED hand-back deliberately does NOT bump: the slices
+        # never left the serving set (nothing to reap) and the
+        # trainer's world never changed (nothing to re-form) — bumping
+        # would charge the trainer a full teardown/rejoin for a
+        # handover that never happened.
+        if not record.get("aborted"):
+            view.membership_generation += 1
     return view
 
 
@@ -848,9 +960,23 @@ def fleet_status(
         verdict = "healthy"
     mttr = view.mttr_samples
     job_mttr = view.job_mttr_samples
+    # Allocation (provision/allocator.py): TRAINING slices are healthy
+    # but belong to the elastic trainer — never route-eligible;
+    # TRANSITIONING slices are mid-handover and read as DRAINING to
+    # both consumers (the Router finishes in-flight and pulls nothing,
+    # the trainer opens its drain-notice checkpoint window). Empty role
+    # map (pre-allocation ledgers) = every slice SERVING, byte-identical.
+    training_slices = sorted(
+        i for i, role in view.roles.items() if role == _ROLE_TRAINING
+    )
+    transitioning = sorted(
+        i for i, role in view.roles.items()
+        if role == _ROLE_TRANSITIONING
+    )
+    not_serving_roles = set(training_slices) | set(transitioning)
     draining = sorted(
-        sv.index for sv in view.slices.values()
-        if sv.state == heal_mod.DRAINING
+        {sv.index for sv in view.slices.values()
+         if sv.state == heal_mod.DRAINING} | set(transitioning)
     )
     doc = {
         "v": SCHEMA_VERSION,
@@ -897,6 +1023,7 @@ def fleet_status(
                 sv.index
                 for sv in sorted(view.slices.values(), key=lambda s: s.index)
                 if sv.state == heal_mod.HEALTHY
+                and sv.index not in not_serving_roles
             ],
             "avoid": {
                 str(sv.index): sv.state
@@ -993,6 +1120,50 @@ def fleet_status(
                 "done": view.scales_done,
                 "aborted": view.scales_aborted,
                 "held": view.scales_held,
+            },
+        },
+        # Co-scheduling block (provision/allocator.py): the per-slice
+        # role split, the handover in flight (the mid-handover crash
+        # signature), the last confirmed decision, and the protocol
+        # counters — what `./setup.sh status` renders and the runbook
+        # (docs/failure-modes.md "Fleet allocation & preemption")
+        # reads back. Bounded: role COUNTS for the fleet, explicit
+        # lists only for the non-serving roles.
+        "allocation": {
+            "enabled": view.alloc_enabled,
+            "roles": {
+                _ROLE_SERVING: max(
+                    0, len(view.slices) - len(not_serving_roles)
+                ) if view.slices else 0,
+                _ROLE_TRAINING: len(training_slices),
+                _ROLE_TRANSITIONING: len(transitioning),
+            },
+            "training": training_slices,
+            "transitioning": transitioning,
+            "last_decision": view.last_alloc_decision,
+            "in_progress": (
+                {
+                    "id": view.open_handover.get("id"),
+                    "direction": view.open_handover.get("direction"),
+                    "slices": view.open_handover.get("slices"),
+                    "ack_deadline": view.open_handover.get("ack_deadline"),
+                    "drain_deadline": view.open_handover.get(
+                        "drain_deadline"),
+                    "acked": bool(view.open_handover.get("acked")),
+                }
+                if view.open_handover is not None else None
+            ),
+            "cooldown_until": view.alloc_cooldown_until,
+            "cooldown_remaining_s": (
+                round(max(0.0, view.alloc_cooldown_until - now), 3)
+                if view.alloc_cooldown_until is not None else None
+            ),
+            "handovers": {
+                "decisions": view.alloc_decisions,
+                "notices": view.preempt_notices,
+                "acks": view.preempt_acks,
+                "forced": view.forced_preemptions,
+                "role_changes": view.role_changes,
             },
         },
         "mttr_s": {

@@ -68,6 +68,7 @@ from typing import Callable
 
 from tritonk8ssupervisor_tpu import obs as obs_mod
 from tritonk8ssupervisor_tpu.config.schema import ClusterConfig, ConfigError
+from tritonk8ssupervisor_tpu.provision import allocator as allocator_mod
 from tritonk8ssupervisor_tpu.provision import autoscale as autoscale_mod
 from tritonk8ssupervisor_tpu.provision import events as events_mod
 from tritonk8ssupervisor_tpu.provision import heal as heal_mod
@@ -475,6 +476,7 @@ class Supervisor:
         demand_path=None,
         scale_up_fn=None,
         scale_down_fn=None,
+        allocator: "allocator_mod.Allocator | None" = None,
     ) -> None:
         if config.mode != "tpu-vm":
             raise ConfigError(
@@ -567,6 +569,18 @@ class Supervisor:
                 ap.breaker_threshold, ap.breaker_window_s,
                 retry.Cooldown(ap.cooldown_s, ap.cooldown_cap_s, rng=rng),
             )
+        # ---- train/serve co-scheduling (provision/allocator.py) ----
+        # The third controller. Per-slice roles live in the folded
+        # LedgerView (self._view.roles — _record keeps it live, restore
+        # rebuilds it), so a restarted supervisor resumes the exact
+        # role split its ledger recorded; `_handover_open` mirrors the
+        # ledger's open PREEMPT_NOTICE (the mid-handover crash
+        # signature restore() resumes under the SAME id).
+        self.allocator = allocator
+        self._handover_seq = 0
+        self._ack_wait_logged = False
+        self._alloc_drain_logged = False
+        self._roles_seeded = False
         # ---- telemetry plane (obs/) ----
         # The registry is always real (the status telemetry block reads
         # it); spans and metrics.json snapshots flow when supervise_cmd
@@ -614,6 +628,16 @@ class Supervisor:
         self._g_scale_breaker = reg.gauge(
             "supervisor_scale_breaker_state",
             "scale-thrash breaker: 0 closed / 1 half-open / 2 open")
+        self._c_alloc = reg.counter(
+            "supervisor_alloc_events_total",
+            "co-scheduling protocol lifecycle by direction and result "
+            "(decision/notice/ack/forced/role-change)")
+        self._g_training = reg.gauge(
+            "supervisor_slices_training",
+            "slices currently assigned the TRAINING role")
+        self._g_transitioning = reg.gauge(
+            "supervisor_slices_transitioning",
+            "slices mid-handover between roles")
         self._last_tick_s: float | None = None
 
     # ----------------------------------------------------------- plumbing
@@ -717,6 +741,37 @@ class Supervisor:
         elif kind == events_mod.SCALE_HELD:
             self._c_scale.inc(direction=record.get("direction", ""),
                               result="held")
+        elif kind == events_mod.ALLOC_DECISION:
+            self._c_alloc.inc(direction=record.get("direction", ""),
+                              result="decision")
+            self._tracer.event("alloc-decision", ts,
+                               direction=record.get("direction"),
+                               count=record.get("count"),
+                               reason=record.get("reason"))
+        elif kind == events_mod.PREEMPT_NOTICE:
+            self._c_alloc.inc(direction=record.get("direction", ""),
+                              result="notice")
+            self._tracer.event("preempt-notice", ts,
+                               id=record.get("id"),
+                               direction=record.get("direction"),
+                               slices=record.get("slices"))
+        elif kind == events_mod.PREEMPT_ACK:
+            self._c_alloc.inc(
+                direction=record.get("direction", ""),
+                result="forced" if record.get("forced") else "ack")
+        elif kind == events_mod.ROLE_CHANGED:
+            self._c_alloc.inc(direction=record.get("direction", ""),
+                              result="role-change")
+            self._tracer.event("role-changed", ts, id=record.get("id"),
+                               role=record.get("role"),
+                               slices=record.get("slices"))
+            roles = self._view.roles
+            self._g_training.set(float(sum(
+                1 for r in roles.values()
+                if r == allocator_mod.TRAINING)))
+            self._g_transitioning.set(float(sum(
+                1 for r in roles.values()
+                if r == allocator_mod.TRANSITIONING)))
         elif kind in (events_mod.SCALE_BREAKER_OPEN,
                       events_mod.SCALE_BREAKER_HALF_OPEN,
                       events_mod.SCALE_BREAKER_CLOSE):
@@ -849,6 +904,32 @@ class Supervisor:
                                     is not None else view.last_ts)
                 else:
                     br.state = HALF_OPEN
+        # ---- allocation resume: roles live in the view itself; the
+        # open PREEMPT_NOTICE is the mid-handover crash signature — the
+        # restart RESUMES that handover under its original id (the
+        # notice was already delivered; re-issuing a sibling would
+        # double-open the trainer's checkpoint window and double-bump
+        # the generation at close).
+        if view.roles:
+            self._roles_seeded = True
+        if view.open_handover is not None and self.allocator is None:
+            self.say(
+                "WARNING: the ledger holds an unfinished role handover "
+                f"({view.open_handover.get('direction')} of slice(s) "
+                f"{view.open_handover.get('slices')}) but this "
+                "supervisor runs without --allocate; restart with "
+                "--allocate to finish it"
+            )
+        if view.open_handover is not None and self.allocator is not None:
+            self.say(
+                "resuming after a crash mid-handover "
+                f"({view.open_handover.get('direction')} of slice(s) "
+                f"{', '.join(str(i) for i in view.open_handover.get('slices', []))}): "
+                "finishing that handover before any new decision"
+            )
+        if self.allocator is not None \
+                and view.alloc_cooldown_until is not None:
+            self.allocator.cooldown_until = view.alloc_cooldown_until
         self._view = view
         if view.open_heals:
             slices = sorted(
@@ -1011,12 +1092,26 @@ class Supervisor:
                     "unhealthy; awaiting confirmation "
                     f"(flap threshold {self.policy.flap_threshold})"
                 )
+        # ONE demand-signal read per tick, shared by the second and
+        # third controllers: two independent reads could land either
+        # side of an atomic rewrite (a torn-read race) and the
+        # autoscaler and allocator would act on DIFFERENT snapshots of
+        # the same window — the single-read-per-tick pin lives in
+        # tests/test_allocator.py.
+        signal = None
+        if self.autoscaler is not None or self.allocator is not None:
+            signal = autoscale_mod.read_demand_signal(self._demand_path)
         # the second controller: demand signal -> desired slice count
         # -> scale execution, AFTER heal reconcile (repairs first —
         # scaling a broken fleet is how thrash starts) and BEFORE the
         # publish, so this tick's status already carries the verdict
         if self.autoscaler is not None:
-            summary["autoscale"] = self._autoscale(now)
+            summary["autoscale"] = self._autoscale(now, signal)
+        # the third controller: demand signal + training-job state ->
+        # per-slice role assignment, after heal (repairs first) and
+        # autoscale (capacity first, then who gets it)
+        if self.allocator is not None:
+            summary["allocation"] = self._allocate(now, signal)
         # tick telemetry BEFORE the publish, so the metrics snapshot
         # written next to fleet-status.json already includes this tick
         done = self._clock()
@@ -1511,19 +1606,19 @@ class Supervisor:
             self._record(events_mod.SCALE_BREAKER_CLOSE)
             self.say("  scale-thrash breaker closed (scale landed)")
 
-    def _autoscale(self, now: float) -> dict:
+    def _autoscale(self, now: float,
+                   signal: "autoscale_mod.DemandSignal | None") -> dict:
         """One autoscale window: finish any scale already in flight
         (an open SCALE_START — possibly inherited from a crash — is
         ALWAYS resumed before any new decision, so capacity changes are
         strictly serialised), else fold the demand signal through the
         hysteresis and execute a confirmed decision behind the
-        thrash breaker."""
+        thrash breaker. `signal` is the tick's ONE shared demand read."""
         out: dict = {"decision": None, "action": None}
         if self._scale_open is not None:
-            self._progress_open_scale(now, out)
+            self._progress_open_scale(now, out, signal)
             self._g_active.set(float(len(self._active)))
             return out
-        signal = autoscale_mod.read_demand_signal(self._demand_path)
         decision = self.autoscaler.observe(signal, len(self._active), now)
         self._g_active.set(float(len(self._active)))
         if decision is None:
@@ -1663,13 +1758,13 @@ class Supervisor:
         )
         return "draining"
 
-    def _progress_open_scale(self, now: float, out: dict) -> None:
+    def _progress_open_scale(self, now: float, out: dict,
+                             signal=None) -> None:
         record = self._scale_open
         if record.get("direction") == autoscale_mod.UP:
             out["action"] = self._execute_scale_up(now)
             return
         slices = sorted(int(i) for i in record.get("slices", []))
-        signal = autoscale_mod.read_demand_signal(self._demand_path)
         fresh = self.autoscaler.fresh(signal, now)
         serving = max(1, len(self._active) - len(slices))
         surge = (self.autoscaler.up_reason(signal, serving)
@@ -1766,6 +1861,235 @@ class Supervisor:
         )
         return "scaled-down"
 
+    # ----------------------------------------------------- co-scheduling
+
+    def _role_lists(self) -> tuple[list[int], list[int]]:
+        """(serving, training) slice lists from the folded role map,
+        scoped to the active set. Slices without a role entry are
+        SERVING (the pre-allocation default); slices draining for
+        scale-down are neither."""
+        roles = self._view.roles
+        candidates = sorted(self._active - self._scale_drain)
+        serving = [i for i in candidates
+                   if roles.get(i, allocator_mod.SERVING)
+                   == allocator_mod.SERVING]
+        training = [i for i in candidates
+                    if roles.get(i) == allocator_mod.TRAINING]
+        return serving, training
+
+    def _allocate(self, now: float,
+                  signal: "autoscale_mod.DemandSignal | None") -> dict:
+        """One co-scheduling window: seed the initial role split on the
+        first tick, finish any handover already in flight (an open
+        PREEMPT_NOTICE — possibly inherited from a crash — is ALWAYS
+        resumed before any new decision, under its original id), else
+        fold the demand signal into a confirmed role reassignment and
+        open the preemption protocol."""
+        out: dict = {"decision": None, "action": None}
+        if not self._roles_seeded:
+            self._roles_seeded = True
+            initial = self.allocator.initial_training(
+                sorted(self._active))
+            if initial:
+                self._record(
+                    events_mod.ROLE_CHANGED, id="alloc-initial",
+                    slices=initial, role=allocator_mod.TRAINING,
+                    initial=True,
+                )
+                self.say(
+                    f"  allocation: slice(s) "
+                    f"{', '.join(str(i) for i in initial)} start as the "
+                    "training world"
+                )
+        if self._view.open_handover is not None:
+            out["action"] = self._progress_handover(now, signal)
+            return out
+        serving, training = self._role_lists()
+        decision = self.allocator.observe(
+            signal, len(serving), len(training), now
+        )
+        if decision is None:
+            return out
+        out["decision"] = dataclasses.asdict(decision)
+        self._record(
+            events_mod.ALLOC_DECISION,
+            direction=decision.direction,
+            count=decision.count,
+            reason=decision.reason[:200],
+            windows=decision.windows,
+            signal_age_s=decision.signal_age_s,
+            queue_depth=signal.queue_depth,
+            recent_sheds=signal.recent_sheds,
+            p99_s=signal.p99_s,
+            serving=len(serving), training=len(training),
+        )
+        self.say(
+            f"  allocation: {decision.direction} x{decision.count} "
+            f"({decision.reason}; confirmed {decision.windows} window(s))"
+        )
+        cooldown_until = self.allocator.note_action(now)
+        self._handover_seq += 1
+        handover_id = f"handover-{int(now)}-{self._handover_seq}"
+        self._ack_wait_logged = False
+        self._alloc_drain_logged = False
+        if decision.direction == allocator_mod.TO_SERVING:
+            # reclaim the highest-index training slices; the PREEMPT
+            # NOTICE is fsync'd BEFORE anything else moves — a kill
+            # anywhere after leaves the open handover on the ledger
+            # and the restart resumes THIS one, never a sibling
+            slices = sorted(training)[len(training) - decision.count:]
+            deadline = now + self.allocator.policy.ack_timeout_s
+            self._record(
+                events_mod.PREEMPT_NOTICE, id=handover_id,
+                direction=decision.direction, slices=slices,
+                ack_deadline=deadline, cooldown_until=cooldown_until,
+            )
+            self.say(
+                f"  preempting training slice(s) "
+                f"{', '.join(str(i) for i in slices)}: drain-notice "
+                f"checkpoint window open (job-ack deadline "
+                f"t={deadline:.0f})"
+            )
+            out["action"] = "notified"
+        else:
+            # lend the highest-index serving slices (the low indices
+            # hold the coordinator/anchor roles); the Router drains
+            # them first — finish in-flight, pull nothing new
+            slices = sorted(sorted(serving, reverse=True)
+                            [:decision.count])
+            deadline = now + self.allocator.policy.drain_timeout_s
+            self._record(
+                events_mod.PREEMPT_NOTICE, id=handover_id,
+                direction=decision.direction, slices=slices,
+                drain_deadline=deadline, cooldown_until=cooldown_until,
+            )
+            self.say(
+                f"  lending slice(s) {', '.join(str(i) for i in slices)} "
+                f"to training: the Router drains first (deadline "
+                f"t={deadline:.0f})"
+            )
+            out["action"] = "draining"
+        return out
+
+    def _progress_handover(
+        self, now: float,
+        signal: "autoscale_mod.DemandSignal | None",
+    ) -> str:
+        """Advance the open handover one window. to-serving: wait for
+        the trainer's job-ack (bounded — past ack_deadline the
+        preemption is FORCED), then flip the roles; to-training: wait
+        for the Router's drain to settle (bounded — stragglers requeue
+        via the membership bump), abort if demand rose under it."""
+        rec = self._view.open_handover
+        slices = sorted(int(i) for i in rec.get("slices", []))
+        if rec.get("direction") == allocator_mod.TO_SERVING:
+            if not rec.get("acked"):
+                notice_ts = rec.get("ts", now)
+                job_ts = self._view.job_notified_ts
+                deadline = rec.get("ack_deadline")
+                # the ack is consulted BEFORE the deadline: an ack
+                # landing exactly AT the bounded-wait deadline is an
+                # acknowledged preemption, never a forced one
+                if job_ts is not None and job_ts >= notice_ts:
+                    self._record(
+                        events_mod.PREEMPT_ACK, id=rec.get("id"),
+                        direction=rec.get("direction"), slices=slices,
+                        forced=False,
+                        waited_s=round(now - notice_ts, 3),
+                    )
+                    self.say(
+                        "  trainer acknowledged the preemption "
+                        "(checkpoint window used)"
+                    )
+                elif deadline is not None and now >= deadline:
+                    self._record(
+                        events_mod.PREEMPT_ACK, id=rec.get("id"),
+                        direction=rec.get("direction"), slices=slices,
+                        forced=True,
+                        waited_s=round(now - notice_ts, 3),
+                    )
+                    self.say(
+                        f"  trainer did not ack within "
+                        f"{self.allocator.policy.ack_timeout_s:.0f}s: "
+                        "FORCED preemption (the last periodic "
+                        "checkpoint bounds the loss)"
+                    )
+                else:
+                    if not self._ack_wait_logged:
+                        self.say(
+                            f"  handover {rec.get('id')}: waiting for "
+                            f"the trainer's job-ack "
+                            f"(deadline t={deadline:.0f})"
+                        )
+                        self._ack_wait_logged = True
+                    return "awaiting-ack"
+            self._record(
+                events_mod.ROLE_CHANGED, id=rec.get("id"),
+                direction=rec.get("direction"), slices=slices,
+                role=allocator_mod.SERVING,
+            )
+            self.say(
+                f"  slice(s) {', '.join(str(i) for i in slices)} join "
+                "the serving set (membership generation bumped; the "
+                "trainer re-forms at the smaller world)"
+            )
+            self.allocator.note_done()
+            self._ack_wait_logged = False
+            return "to-serving"
+        # ---- to-training: the Router lets go first
+        serving, _training = self._role_lists()
+        fresh = self.allocator.fresh(signal, now)
+        surge = (self.allocator.preempt_reason(signal,
+                                               max(1, len(serving)))
+                 if fresh else None)
+        if surge is not None:
+            # demand rose under the hand-back: aborting is cheap (the
+            # slices never stopped serving in-flight work) and honest —
+            # finishing the handover just to preempt it next window is
+            # the thrash the cooldown exists to stop, so the abort
+            # skips note_done and the cooldown keeps its growth
+            self._record(
+                events_mod.ROLE_CHANGED, id=rec.get("id"),
+                direction=rec.get("direction"), slices=slices,
+                role=allocator_mod.SERVING, aborted=True,
+                reason=f"demand rose mid-drain: {surge}"[:200],
+            )
+            self.say(
+                f"  hand-back ABORTED: demand rose mid-drain ({surge}); "
+                f"slice(s) {', '.join(str(i) for i in slices)} return "
+                "to serving"
+            )
+            self._alloc_drain_logged = False
+            return "drain-aborted"
+        settled = fresh and signal.inflight_on(slices) == 0
+        deadline = rec.get("drain_deadline")
+        if not settled and (deadline is None or now < deadline):
+            if not self._alloc_drain_logged:
+                inflight = (signal.inflight_on(slices)
+                            if fresh else "unknown")
+                self.say(
+                    f"  hand-back: waiting for slice(s) "
+                    f"{', '.join(str(i) for i in slices)} to drain "
+                    f"({inflight} in flight)"
+                )
+                self._alloc_drain_logged = True
+            return "draining"
+        stragglers = signal.inflight_on(slices) if fresh else None
+        self._record(
+            events_mod.ROLE_CHANGED, id=rec.get("id"),
+            direction=rec.get("direction"), slices=slices,
+            role=allocator_mod.TRAINING, stragglers=stragglers,
+        )
+        extra = (f"; {stragglers} straggler(s) requeue via the "
+                 "membership bump" if stragglers else "")
+        self.say(
+            f"  slice(s) {', '.join(str(i) for i in slices)} handed to "
+            f"training (the elastic world grows{extra})"
+        )
+        self.allocator.note_done()
+        self._alloc_drain_logged = False
+        return "to-training"
+
     # ------------------------------------------------------------- status
 
     def _publish(self, now: float) -> None:
@@ -1839,6 +2163,12 @@ class Supervisor:
                     "min_slices": self.autoscaler.min_slices,
                     "max_slices": self.autoscaler.max_slices,
                 }
+            if self.allocator is not None:
+                autoscale_fields.update(
+                    allocate=True,
+                    min_serving=self.allocator.min_serving,
+                    train_slices=self.allocator.policy.train_slices,
+                )
             self._record(
                 events_mod.SUPERVISOR_START, pid=os.getpid(),
                 interval=self.policy.interval,

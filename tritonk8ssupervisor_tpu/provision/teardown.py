@@ -96,6 +96,10 @@ def clean(
     # flight record of what the fleet was and what ran, the last evidence
     # an interrupted clean would want preserved.
     journal_mod.Journal(paths.journal).scrub()
+    # fleet-status carries the allocation block (per-slice train/serve
+    # roles) and job-ack the trainer's preemption handshake — both are
+    # allocator state a fresh deployment must never inherit: a stale
+    # role map would route traffic around slices that no longer exist
     paths.fleet_status.unlink(missing_ok=True)
     paths.job_ack.unlink(missing_ok=True)
     # the gateway's demand signal is derived state like fleet-status:

@@ -101,6 +101,7 @@ REJECT_NO_CAPACITY = "no-slices"  # nothing route-eligible right now
 REJECT_NO_FLEET_VIEW = "no-fleet-view"  # cold start: no routed view yet
 REJECT_DEADLINE = "deadline-unmeetable"  # queue wait already past it
 REJECT_DUPLICATE = "duplicate-in-flight"  # key racing its own completion
+REJECT_TENANT = "tenant-overload"  # ONE tenant over its WFQ queue share
 
 # Worker modes derived from the routed view.
 SERVE = "serve"  # eligible: pull new work
@@ -134,6 +135,14 @@ class Request:
     # "Request lifecycle & exactly-once semantics")
     key: str | None = None  # client-supplied idempotency key
     deadline_s: float | None = None  # relative budget from arrival
+    # multi-tenant fairness (docs/failure-modes.md "Fleet allocation &
+    # preemption", WFQ semantics): which tenant's weight this request
+    # bills against (None = the default tenant), and its priority
+    # class — higher classes claim first; the oldest-head aging bound
+    # keeps a starved class from waiting forever
+    tenant: str | None = None
+    priority: int = 0
+    wfq_tag: float | None = None  # virtual finish time, set at admission
     # progress/attribution
     slice_index: int | None = None
     dispatched_at: float | None = None
@@ -237,6 +246,25 @@ class GatewayPolicy:
     # demand_path wired, the gateway atomically rewrites
     # demand-signal.json at most this often, piggybacked on poll()
     demand_signal_every_s: float = 5.0
+    # ---- multi-tenant fairness (per-tenant WFQ over the bucketed
+    # admission queue; docs/failure-modes.md "WFQ weight semantics").
+    # None = single homogeneous stream, claim order byte-identical to
+    # the pre-WFQ gateway. A dict of tenant -> weight enables
+    # virtual-time claim order: each accepted request is tagged
+    # finish = max(vtime, tenant_finish) + cost/weight, and claim()
+    # serves the smallest tag among per-(bucket, tenant, priority)
+    # queue heads — a flooding tenant's backlog cannot starve the rest.
+    tenant_weights: dict | None = None
+    # per-tenant SLO budget: one tenant may hold at most
+    # slack * weight-share of queue_budget queued requests; past it
+    # ONLY that tenant sheds (429 tenant-overload) while the others
+    # keep admitting (0 disables the per-tenant cap)
+    tenant_budget_slack: float = 1.5
+    # starvation bound on the claim order: a queued request older than
+    # this claims NEXT regardless of priority class or WFQ tag —
+    # priorities reorder the queue, they must never starve it
+    # (0 disables aging; the regression pin lives in test_serving.py)
+    claim_age_bound_s: float = 60.0
 
 
 @dataclasses.dataclass
@@ -729,6 +757,16 @@ class Gateway:
         # deadline-feasibility check models queue wait with
         self._completion_times: deque = deque(maxlen=64)
         self._noview_logged_at: float | None = None
+        # ---- per-tenant WFQ state (policy.tenant_weights) ----
+        # `_vtime` is the system virtual time (advanced to the claimed
+        # request's tag at dispatch); `_wfq_finish` is each tenant's
+        # last assigned finish tag. `_priority_seen` keeps the legacy
+        # head-only claim scan until a prioritized request actually
+        # arrives — homogeneous streams pay nothing for the feature.
+        self._wfq_enabled = bool(self.policy.tenant_weights)
+        self._wfq_finish: dict = {}
+        self._vtime = 0.0
+        self._priority_seen = False
 
     # -------------------------------------------------------------- routing
 
@@ -1001,6 +1039,14 @@ class Gateway:
         reason = self.shed_reason()
         if reason is None and not self.eligible_slices():
             reason = REJECT_NO_CAPACITY
+        if reason is None and self._wfq_enabled:
+            # per-tenant SLO budget: ONE tenant past its weight share
+            # of the queue sheds alone — a flood from one stream must
+            # not consume the whole queue_budget and starve the rest
+            cap = self._tenant_budget(request.tenant)
+            if cap is not None and self._tenant_depth(
+                    request.tenant) >= cap:
+                reason = REJECT_TENANT
         if reason is not None:
             return self._refuse(request, reason, now)
         if request.deadline_s is not None:
@@ -1015,6 +1061,24 @@ class Gateway:
                                     wait - float(request.deadline_s)),
                 )
         request.bucket = bound
+        if request.priority:
+            self._priority_seen = True
+        if self._wfq_enabled:
+            # start-time fair queueing: the tag is assigned ONCE at
+            # admission — start at max(system vtime, the tenant's last
+            # finish), advance by normalized cost. Within a tenant,
+            # tags are monotone (FIFO holds); across tenants, a light
+            # tenant's fresh request tags BELOW a flooding tenant's
+            # backlog and claims first.
+            tenant = request.tenant or "default"
+            weight = float(
+                (self.policy.tenant_weights or {}).get(tenant, 1.0)
+            ) or 1.0
+            start = max(self._vtime, self._wfq_finish.get(tenant, 0.0))
+            cost = (max(1, request.prompt_len)
+                    + max(1, request.max_new_tokens)) / weight
+            request.wfq_tag = start + cost
+            self._wfq_finish[tenant] = request.wfq_tag
         self.queues[bound].append(request)
         if request.key is not None:
             self._key_state[request.key] = ("inflight", None)
@@ -1026,6 +1090,10 @@ class Gateway:
                       rid=request.rid, prompt_len=request.prompt_len,
                       max_new_tokens=request.max_new_tokens,
                       deadline_s=request.deadline_s,
+                      **({"tenant": request.tenant}
+                         if request.tenant is not None else {}),
+                      **({"priority": request.priority}
+                         if request.priority else {}),
                       **({"tokens": [int(t) for t in request.tokens]}
                          if request.tokens is not None else {}))
         self.metrics.accepted.append((now, request.rid))
@@ -1074,15 +1142,81 @@ class Gateway:
 
     # ------------------------------------------------------------- dispatch
 
+    def _tenant_budget(self, tenant: str | None) -> int | None:
+        """One tenant's queued-request cap: slack * its weight share of
+        the queue budget (at least 1), or None when the per-tenant cap
+        is disabled. Unknown tenants weigh 1.0 like the default."""
+        weights = self.policy.tenant_weights or {}
+        slack = float(self.policy.tenant_budget_slack)
+        if not weights or slack <= 0:
+            return None
+        w = float(weights.get(tenant or "default", 1.0)) or 1.0
+        total = sum(float(x) or 1.0 for x in weights.values())
+        if (tenant or "default") not in weights:
+            total += w
+        share = w / max(w, total)
+        return max(1, int(share * self.policy.queue_budget * slack))
+
+    def _tenant_depth(self, tenant: str | None) -> int:
+        return sum(
+            1 for q in self.queues.values() for r in q
+            if (r.tenant or "default") == (tenant or "default")
+        )
+
+    def _pick_queued(self, now: float) -> tuple | None:
+        """The next request to claim: (queue, index, request). The
+        candidates are, per bucket, the FIRST queued request of each
+        (tenant, priority) class — FIFO holds within a class, while
+        across classes the order is priority first, then the WFQ
+        virtual-finish tag (arrival when WFQ is off). The STARVATION
+        BOUND overrides both: a candidate older than
+        `claim_age_bound_s` claims next no matter its class or tag —
+        priorities and weights reorder the queue, they may never
+        starve it (the aging regression pin lives in
+        tests/test_serving.py). Homogeneous streams (no tenants, no
+        priorities ever submitted) keep the original head-only
+        oldest-first scan, byte-identical."""
+        scan_classes = self._wfq_enabled or self._priority_seen
+        best = None  # (key, q, i, req)
+        oldest = None  # (arrival, q, i, req)
+        for q in self.queues.values():
+            if not q:
+                continue
+            seen: set = set()
+            for i, req in enumerate(q):
+                cls = (req.tenant, req.priority)
+                if cls in seen:
+                    continue
+                seen.add(cls)
+                if oldest is None or req.arrival < oldest[0]:
+                    oldest = (req.arrival, q, i, req)
+                tag = (req.wfq_tag if req.wfq_tag is not None
+                       else req.arrival)
+                key = (-int(req.priority), tag, req.arrival)
+                if best is None or key < best[0]:
+                    best = (key, q, i, req)
+                if not scan_classes:
+                    break  # heads only: the legacy oldest-first scan
+        if best is None:
+            return None
+        bound = float(self.policy.claim_age_bound_s)
+        if (scan_classes and bound > 0 and oldest is not None
+                and now - oldest[0] > bound
+                and oldest[3] is not best[3]):
+            return oldest[1], oldest[2], oldest[3]
+        return best[1], best[2], best[3]
+
     def claim(self, slice_index: int, now: float,
               fits: Callable | None = None) -> Request | None:
-        """One request for a free slot on `slice_index`, oldest-first
-        across buckets (bucketing batches compiled shapes, it must not
-        starve a sparse bucket), or None when every bucket is empty or
+        """One request for a free slot on `slice_index` — oldest-first
+        across buckets for a homogeneous stream (bucketing batches
+        compiled shapes, it must not starve a sparse bucket), and
+        priority-then-WFQ order when tenants/priority classes are in
+        play (`_pick_queued`) — or None when every bucket is empty or
         the slice may not take new work. Requests whose deadline has
         already passed are skipped-and-expired here instead of burning
         slot capacity on callers that gave up. `fits` is the engine's
-        page-capacity probe (can_join): when the OLDEST request cannot
+        page-capacity probe (can_join): when the chosen request cannot
         be cached right now, claim returns None and the request keeps
         its place — head-of-line blocking is the honest policy
         (skipping ahead would starve big prompts behind an endless
@@ -1090,21 +1224,23 @@ class Gateway:
         if self.slice_mode(slice_index) != SERVE:
             return None
         while True:
-            best: deque | None = None
-            for q in self.queues.values():
-                if q and (best is None or q[0].arrival < best[0].arrival):
-                    best = q
-            if best is None:
+            picked = self._pick_queued(now)
+            if picked is None:
                 return None
-            req = best[0]
+            best, index, req = picked
             deadline = self.deadline_at(req)
             if deadline is not None and now >= deadline:
-                best.popleft()
+                del best[index]
                 self.expire(req, "queue", now)
                 continue
             if fits is not None and not fits(req):
                 return None
-            best.popleft()
+            del best[index]
+            if req.wfq_tag is not None:
+                # the system virtual time advances to the claimed tag:
+                # an idle tenant's NEXT request starts from here, not
+                # from zero (no banked credit for sitting out)
+                self._vtime = max(self._vtime, req.wfq_tag)
             req.dispatched_at = now
             view = self.view
             self._journal(

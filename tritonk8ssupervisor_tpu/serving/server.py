@@ -200,6 +200,9 @@ def make_handler(gateway: Gateway, lock: threading.Lock,
                 deadline = None if deadline is None else float(deadline)
                 key = doc.get("idempotency_key")
                 key = None if key is None else str(key)
+                tenant = doc.get("tenant")
+                tenant = None if tenant is None else str(tenant)
+                priority = int(doc.get("priority", 0))
             except (KeyError, TypeError, ValueError) as e:
                 self._reply(400, {"error": f"bad request: {e}"})
                 return
@@ -208,6 +211,7 @@ def make_handler(gateway: Gateway, lock: threading.Lock,
                           prompt_len=int(tokens.size),
                           max_new_tokens=new, tokens=tokens,
                           deadline_s=deadline, key=key,
+                          tenant=tenant, priority=priority,
                           notify=lambda _r: done.set())
             with lock:
                 admission = gateway.submit(req, time.monotonic())

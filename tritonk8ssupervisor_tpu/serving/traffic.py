@@ -39,6 +39,9 @@ class TrafficModel:
     base_rps: float = 2.0  # mean arrivals/sec at the diurnal midline
     diurnal_amplitude: float = 0.25  # peak/trough swing around base
     diurnal_period_s: float = 900.0
+    diurnal_phase: float = 0.0  # fraction of a period t=0 starts at
+    # (0.75 starts in the trough — the co-scheduling benches use it so
+    # the run opens where training holds the fleet; 0.0 = legacy)
     bursts: tuple = ()  # (start_s, duration_s, rate_multiplier)
     prompt_lens: tuple = (32, 64, 128, 256)
     prompt_weights: tuple | None = None
@@ -58,11 +61,20 @@ class TrafficModel:
     # after the first request warms it.
     shared_prefix_len: int = 0
     shared_prefix_share: float = 0.0
+    # multi-tenant shape (the gateway's WFQ lever): every arrival of
+    # this model bills `tenant` at `priority`; mixed-tenant streams
+    # are built by merging several models' arrival lists (open-loop:
+    # each stream is a pure function of its own model, so merging
+    # keeps every stream bit-identical to running it alone). None/0 =
+    # the homogeneous pre-tenant stream, byte-identical.
+    tenant: str | None = None
+    priority: int = 0
 
     def rate(self, t: float) -> float:
         rate = self.base_rps * (
             1.0 + self.diurnal_amplitude
-            * math.sin(2.0 * math.pi * t / self.diurnal_period_s)
+            * math.sin(2.0 * math.pi * (t / self.diurnal_period_s
+                                        + self.diurnal_phase))
         )
         for start, duration, mult in self.bursts:
             if start <= t < start + duration:
@@ -115,6 +127,7 @@ def generate_arrivals(model: TrafficModel, duration_s: float,
                  if model.key_prefix is not None else None),
             prefix_len=prefix_len,
             prefix_id=(f"sys-{model.seed}" if prefix_len > 0 else None),
+            tenant=model.tenant, priority=int(model.priority),
         ))
         rid += 1
     return out

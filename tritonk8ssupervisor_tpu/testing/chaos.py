@@ -1106,7 +1106,8 @@ class ServeInvariantChecker:
     def __init__(self, gw_policy, interval_s: float = 30.0,
                  staleness_bound_s: float | None = None,
                  autoscale_policy=None,
-                 drain_grace_s: float | None = None) -> None:
+                 drain_grace_s: float | None = None,
+                 alloc_policy=None) -> None:
         self.policy = gw_policy
         self.interval_s = float(interval_s)
         self.staleness_bound_s = (
@@ -1123,6 +1124,11 @@ class ServeInvariantChecker:
             float(drain_grace_s) if drain_grace_s is not None
             else 2.0 * float(gw_policy.poll_every_s) + 1.0
         )
+        # the co-scheduling contract (provision/allocator.py): set when
+        # the campaign ran the third controller. The same propagation
+        # grace applies between a PREEMPT_NOTICE landing and the Router
+        # observing the role change.
+        self.alloc_policy = alloc_policy
 
     def check(self, req_records: list, ledger_records: list = (),
               metrics: dict | None = None) -> list:
@@ -1143,6 +1149,12 @@ class ServeInvariantChecker:
             violations += self.check_scale_breaker_gate(ledger_records)
             violations += self.check_scale_serialised(ledger_records)
             violations += self.check_no_dispatch_to_draining(
+                req_records, ledger_records)
+        if self.alloc_policy is not None and ledger_records:
+            violations += self.check_alloc_confirmation(ledger_records)
+            violations += self.check_handover_protocol(ledger_records)
+            violations += self.check_role_exclusivity(ledger_records)
+            violations += self.check_no_dispatch_to_training(
                 req_records, ledger_records)
         return violations
 
@@ -1529,6 +1541,240 @@ class ServeInvariantChecker:
                         f"draining for scale-down since t={t0:.1f}"
                     )
         return violations
+
+    # -- 12: allocation — confirmed windows on fresh evidence --------------
+
+    def check_alloc_confirmation(self, ledger_records: list) -> list:
+        """Every ALLOC_DECISION must carry a confirming streak at least
+        as long as the policy demands for its direction, on a FRESH
+        signal — the hysteresis contract, applied to role changes."""
+        ap = self.alloc_policy
+        violations: list = []
+        for idx, r in enumerate(ledger_records):
+            if r.get("kind") != events_mod.ALLOC_DECISION:
+                continue
+            need = (ap.confirm_to_serving
+                    if r.get("direction") == "to-serving"
+                    else ap.confirm_to_training)
+            windows = r.get("windows") or 0
+            if windows < max(1, int(need)):
+                violations.append(
+                    f"alloc-confirmation: {r.get('direction')} decision "
+                    f"at record {idx} confirmed by {windows} window(s), "
+                    f"policy demands {need}"
+                )
+            age = r.get("signal_age_s")
+            if age is None or age > ap.signal_max_age_s:
+                violations.append(
+                    f"alloc-confirmation: decision at record {idx} "
+                    f"fired on a stale/unknown signal "
+                    f"(age {age!r}s, max {ap.signal_max_age_s:.0f}s)"
+                )
+        return violations
+
+    # -- 13: allocation — the preemption protocol is a protocol ------------
+
+    def check_handover_protocol(self, ledger_records: list) -> list:
+        """At most ONE handover open at a time (a PREEMPT_NOTICE while
+        an earlier one later closes is a double-handover — the restart
+        path must RESUME an orphan, not mint a sibling); every
+        to-serving ROLE_CHANGED must be preceded by a PREEMPT_ACK for
+        its handover id; and a FORCED ack may land only at/after the
+        notice's recorded ack deadline — forcing early is a kill, not
+        a bounded wait."""
+        violations: list = []
+        closed_at: dict = {}
+        for idx, r in enumerate(ledger_records):
+            if r.get("kind") == events_mod.ROLE_CHANGED \
+                    and r.get("id") not in (None, "alloc-initial"):
+                closed_at[r.get("id")] = idx
+        open_handover: tuple | None = None  # (idx, id, record)
+        acked: dict = {}  # handover id -> ack record idx
+        for idx, r in enumerate(ledger_records):
+            kind = r.get("kind")
+            if kind == events_mod.PREEMPT_NOTICE:
+                if (open_handover is not None
+                        and closed_at.get(open_handover[1], -1) > idx):
+                    violations.append(
+                        f"handover-protocol: handover {r.get('id')!r} "
+                        f"opened at record {idx} while handover "
+                        f"{open_handover[1]!r} (record {open_handover[0]}) "
+                        "was still in flight"
+                    )
+                open_handover = (idx, r.get("id"), r)
+            elif kind == events_mod.PREEMPT_ACK:
+                acked[r.get("id")] = idx
+                if r.get("forced"):
+                    notice = (open_handover[2]
+                              if open_handover is not None
+                              and open_handover[1] == r.get("id")
+                              else None)
+                    deadline = (notice.get("ack_deadline")
+                                if notice is not None else None)
+                    ts = r.get("ts", 0.0)
+                    if deadline is not None and ts < deadline - self._EPS:
+                        violations.append(
+                            f"handover-protocol: FORCED ack for "
+                            f"{r.get('id')!r} at t={ts:.1f} (record "
+                            f"{idx}) BEFORE the ack deadline "
+                            f"t={deadline:.1f} — forcing early is a "
+                            "kill, not a bounded wait"
+                        )
+            elif kind == events_mod.ROLE_CHANGED:
+                rid = r.get("id")
+                if rid in (None, "alloc-initial"):
+                    continue
+                if (r.get("role") == "serving" and not r.get("aborted")
+                        and rid not in acked):
+                    violations.append(
+                        f"handover-protocol: to-serving ROLE_CHANGED "
+                        f"{rid!r} at record {idx} without a "
+                        "PREEMPT_ACK — the trainer was never given its "
+                        "checkpoint window"
+                    )
+                if open_handover is not None and open_handover[1] == rid:
+                    open_handover = None
+        return violations
+
+    # -- 14: allocation — a slice is never in both roles at once -----------
+
+    _ROLE_LEGAL = {
+        ("serving", "transitioning"), ("training", "transitioning"),
+        ("transitioning", "serving"), ("transitioning", "training"),
+        # the initial assignment flips serving -> training directly
+        # (no handover: nothing is running on either side yet)
+        ("serving", "training:initial"),
+    }
+
+    def check_role_exclusivity(self, ledger_records: list) -> list:
+        """Replay the role state machine per slice: serving <->
+        transitioning <-> training, nothing else. A PREEMPT_NOTICE
+        naming a slice already mid-handover, or a ROLE_CHANGED flipping
+        a slice that was never transitioned, is a slice in two roles at
+        once — the invariant the whole protocol exists to hold."""
+        violations: list = []
+        role: dict = {}  # slice -> current role (default serving)
+        for idx, r in enumerate(ledger_records):
+            kind = r.get("kind")
+            if kind == events_mod.PREEMPT_NOTICE:
+                for i in r.get("slices", []):
+                    current = role.get(int(i), "serving")
+                    if (current, "transitioning") not in self._ROLE_LEGAL:
+                        violations.append(
+                            f"role-exclusivity: slice {i} entered a "
+                            f"handover at record {idx} while "
+                            f"{current} (already mid-handover?)"
+                        )
+                    role[int(i)] = "transitioning"
+            elif kind == events_mod.ROLE_CHANGED:
+                new = r.get("role", "serving")
+                tag = (f"{new}:initial" if r.get("initial") else new)
+                for i in r.get("slices", []):
+                    current = role.get(int(i), "serving")
+                    if (current, tag) not in self._ROLE_LEGAL:
+                        violations.append(
+                            f"role-exclusivity: slice {i} moved "
+                            f"{current} -> {new} at record {idx} "
+                            "without a handover"
+                        )
+                    role[int(i)] = new
+        return violations
+
+    # -- 15: allocation — TRAINING slices receive zero dispatches ----------
+
+    def check_no_dispatch_to_training(self, req_records: list,
+                                      ledger_records: list) -> list:
+        """From one propagation grace after a slice's role leaves
+        SERVING (a PREEMPT_NOTICE in either direction, or the initial
+        training assignment) until a ROLE_CHANGED hands it back, the
+        slice may receive NO dispatch: the Router saw the role and
+        stopped pulling. A dispatch inside the window is inference
+        work landing on the training job's slice — the two-workloads
+        invariant broken."""
+        intervals: dict = {}  # slice -> list of (t0, t1)
+        left_at: dict = {}  # slice -> ts it left SERVING
+        for r in ledger_records:
+            kind = r.get("kind")
+            ts = r.get("ts", 0.0)
+            if kind == events_mod.PREEMPT_NOTICE:
+                for i in r.get("slices", []):
+                    left_at.setdefault(int(i), ts)
+            elif kind == events_mod.ROLE_CHANGED:
+                role = r.get("role", "serving")
+                for i in r.get("slices", []):
+                    if role == "serving":
+                        t0 = left_at.pop(int(i), None)
+                        if t0 is not None:
+                            intervals.setdefault(int(i), []).append(
+                                (t0, ts))
+                    else:
+                        left_at.setdefault(int(i), ts)
+        for i, t0 in left_at.items():  # never returned to serving
+            intervals.setdefault(int(i), []).append((t0, float("inf")))
+        violations: list = []
+        grace = self.drain_grace_s
+        for idx, r in enumerate(req_records):
+            if r.get("kind") != reqlog_mod.DISPATCHED:
+                continue
+            index = r.get("slice")
+            if index is None:
+                continue
+            ts = r.get("ts", 0.0)
+            for t0, t1 in intervals.get(int(index), []):
+                # end-exclusive: a claim at EXACTLY the ROLE_CHANGED
+                # timestamp followed the same-tick status publish that
+                # made the slice eligible again (abort path) — the
+                # role IS serving at that instant
+                if t0 + grace < ts < t1:
+                    violations.append(
+                        f"dispatch-to-training: slice {index} claimed "
+                        f"inference work at t={ts:.1f} (record {idx}) "
+                        f"while out of the serving role since "
+                        f"t={t0:.1f}"
+                    )
+        return violations
+
+    # -- 16: allocation — per-tenant goodput within WFQ weight bounds ------
+
+    def check_tenant_fairness(self, req_records: list, weights: dict,
+                              flood_tenant: str,
+                              window: tuple,
+                              slack: float = 1.75) -> list:
+        """Inside the flood window every tenant kept demand queued, so
+        completed work must track the WFQ weights: the flooding tenant
+        may not exceed `slack` x its weight share of the window's
+        completions, and the other tenants together must not be
+        squeezed below (1 - flood_share * slack). One stream must not
+        buy more than its weight."""
+        t0, t1 = window
+        t1 += 60.0  # completions of work admitted in the window
+        tenant_of: dict = {}
+        for r in req_records:
+            if r.get("kind") == reqlog_mod.ACCEPTED and r.get("key"):
+                tenant_of[r["key"]] = r.get("tenant") or "default"
+        done: dict = {}
+        for r in req_records:
+            if r.get("kind") != reqlog_mod.COMPLETED:
+                continue
+            ts = r.get("ts", 0.0)
+            if not (t0 <= ts <= t1):
+                continue
+            tenant = tenant_of.get(r.get("key"), "default")
+            done[tenant] = done.get(tenant, 0) + 1
+        total = sum(done.values())
+        if total < 10:
+            return []  # too little service in the window to judge
+        w_total = sum(float(v) or 1.0 for v in weights.values())
+        w_flood = float(weights.get(flood_tenant, 1.0)) or 1.0
+        flood_share = done.get(flood_tenant, 0) / total
+        weight_share = w_flood / w_total
+        if flood_share > min(1.0, weight_share * slack):
+            return [
+                f"tenant-fairness: tenant {flood_tenant!r} took "
+                f"{flood_share:.0%} of window completions, over "
+                f"{slack:.2f}x its {weight_share:.0%} weight share"
+            ]
+        return []
 
 
 def _static_status_doc(now: float, num_slices: int,
@@ -2292,5 +2538,733 @@ def run_autoscale_campaign(scenario: AutoscaleScenario,
     kwargs["torn_status_at"] = tuple(torn_status)
     kwargs["torn_demand_at"] = tuple(torn_demand)
     out = run_autoscale_drive(Path(workdir), **kwargs)
+    out["events"] = [e["kind"] for e in scenario.events]
+    return out
+
+
+# ------------------------------------------- co-scheduling (one fleet)
+
+
+class KillOnKindLedger(events_mod.EventLedger):
+    """An event ledger that SIGKILLs the supervisor right AFTER the Nth
+    record of `kill_kind` lands — the record is durable, the process
+    dies on the next instruction. This is how the campaigns kill a
+    supervisor between PREEMPT_NOTICE and ROLE_CHANGED: a handover
+    cannot be interrupted from the RunFn side (no terraform runs in a
+    role flip), so the crash seam is the ledger append itself."""
+
+    def __init__(self, *args, kill_kind: str | None = None,
+                 kill_after: int = 1, **kwargs) -> None:
+        super().__init__(*args, **kwargs)
+        self._kill_kind = kill_kind
+        self._kill_remaining = max(0, int(kill_after))
+
+    def append(self, kind: str, **fields) -> dict:
+        record = super().append(kind, **fields)
+        if kind == self._kill_kind and self._kill_remaining > 0:
+            self._kill_remaining -= 1
+            if self._kill_remaining == 0:
+                raise SupervisorKilled(
+                    f"scripted SIGKILL after {kind} record"
+                )
+        return record
+
+
+class VirtualTrainer:
+    """The elastic trainer's virtual-clock twin for the co-scheduling
+    drives: it models parallel/elastic.py's loop over the slices the
+    supervisor's `allocation.training` list assigns it. Steps accrue at
+    `steps_per_slice_s` per owned slice; a periodic checkpoint every
+    `checkpoint_every` steps bounds any loss; a drain notice touching
+    its slices (membership.draining) triggers the ~0-cost checkpoint
+    flush plus a job-ack `notified` (the PREEMPT_NOTICE handshake); a
+    membership generation bump costs the steps since the last
+    checkpoint (~0 when the drain notice was honored) plus `resume_s`
+    of rejoin time, then training continues at the NEW world size.
+    `ack=False` models a wedged trainer that never acknowledges — the
+    supervisor's bounded wait must FORCE the preemption, and the last
+    periodic checkpoint must bound the loss."""
+
+    def __init__(self, status_path: Path, ack_path: Path, clock,
+                 steps_per_slice_s: float = 0.5,
+                 checkpoint_every: int = 60,
+                 resume_s: float = 20.0,
+                 poll_every_s: float = 5.0,
+                 ack: bool = True) -> None:
+        from tritonk8ssupervisor_tpu.parallel.elastic import JobAck
+
+        self.status_path = Path(status_path)
+        self.clock = clock
+        self.rate = float(steps_per_slice_s)
+        self.checkpoint_every = max(1, int(checkpoint_every))
+        self.resume_s = float(resume_s)
+        self.poll_every_s = max(0.5, float(poll_every_s))
+        self.ack_enabled = bool(ack)
+        self._ack = JobAck(ack_path, clock=clock.time)
+        self.owned: list = []
+        self.generation: int | None = None
+        self._step = 0.0
+        self._saved = 0.0
+        self._busy_until = 0.0
+        self._last = 0.0
+        self._last_poll = float("-inf")
+        self._flushed = False
+        self.report: dict = {
+            "steps": 0, "steps_lost": 0, "resumes": [],
+            "drain_flushes": 0, "acks_written": 0,
+        }
+
+    def _read_status(self) -> dict | None:
+        try:
+            doc = json.loads(self.status_path.read_text())
+        except (OSError, ValueError):
+            return None  # absent or torn: unknown, retry
+        return doc if isinstance(doc, dict) else None
+
+    def _write_ack(self, phase: str, generation, reason: str = "") -> None:
+        if not self.ack_enabled:
+            return
+        self._ack.write(phase, generation, int(self._step),
+                        world=len(self.owned), slices=(),
+                        reason=reason)
+        self.report["acks_written"] += 1
+
+    def next_wake(self, now: float) -> float:
+        return max(now, self._last_poll + self.poll_every_s)
+
+    def advance(self, now: float) -> None:
+        """Accrue training progress up to `now` and poll the status
+        file on the poll cadence. Called from the drive's main loop —
+        the trainer is a co-actor on the same virtual clock."""
+        if now > self._last:
+            start = max(self._last, self._busy_until)
+            if now > start and self.owned:
+                self._step += self.rate * len(self.owned) * (now - start)
+            self._last = now
+        # periodic durability: the bound on any preemption's loss
+        while self._step - self._saved >= self.checkpoint_every:
+            self._saved += self.checkpoint_every
+        if now - self._last_poll < self.poll_every_s:
+            return
+        self._last_poll = now
+        doc = self._read_status()
+        if doc is None:
+            return
+        membership = doc.get("membership") or {}
+        alloc = doc.get("allocation") or {}
+        gen = membership.get("generation")
+        draining = set(membership.get("draining") or [])
+        training = sorted(int(i) for i in alloc.get("training") or [])
+        if self.generation is None:
+            self.generation = gen
+            self.owned = training
+            return
+        if draining & set(self.owned) and not self._flushed:
+            # the drain-notice checkpoint window: flush NOW (costs ~0
+            # steps), acknowledge, keep stepping until the world moves
+            self._saved = self._step
+            self._flushed = True
+            self.report["drain_flushes"] += 1
+            self._write_ack("notified", gen, reason="drain notice")
+        if gen != self.generation:
+            if self.ack_enabled:
+                # a planned membership change: the real ElasticTrainer
+                # flushes AT the boundary (state_intact=True), so the
+                # loss is ~0; only a wedged trainer (ack=False) rolls
+                # back to its last periodic checkpoint
+                self._saved = self._step
+            lost = int(self._step - self._saved)
+            self.report["steps_lost"] += lost
+            self.report["resumes"].append({
+                "ts": round(now, 3), "steps_lost": lost,
+                "world": len(training), "generation": gen,
+            })
+            self._step = self._saved
+            self._busy_until = now + self.resume_s
+            self.generation = gen
+            self.owned = training
+            self._flushed = False
+            self._write_ack("resumed", gen)
+        self.report["steps"] = int(self._step)
+
+    def finish(self) -> dict:
+        self.report["steps"] = int(self._step)
+        return dict(self.report)
+
+
+def default_alloc_policy(num_slices: int = 4):
+    """The campaign allocation policy: thresholds sized to the modeled
+    engine's capacity (like default_autoscale_policy), confirmation
+    windows short enough to exercise inside a bounded sim, an ack
+    timeout that a healthy trainer beats by one poll interval and a
+    wedged one forces within the drive."""
+    from tritonk8ssupervisor_tpu.provision import allocator as alloc_mod
+
+    return alloc_mod.AllocatorPolicy(
+        min_serving=1, min_training=0,
+        train_slices=max(1, num_slices // 2),
+        up_queue_per_slice=6.0, slo_p99_s=60.0,
+        idle_queue_per_slice=3.0, idle_p99_margin=0.5,
+        confirm_to_serving=2, confirm_to_training=2,
+        cooldown_s=45.0, cooldown_cap_s=600.0,
+        ack_timeout_s=90.0, drain_timeout_s=120.0,
+        idle_inflight_per_slice=3.0,
+        signal_max_age_s=75.0,
+    )
+
+
+def _alloc_summary(ledger_records: list) -> dict:
+    kinds = [r.get("kind") for r in ledger_records]
+    to_serving = [r for r in ledger_records
+                  if r.get("kind") == events_mod.ROLE_CHANGED
+                  and r.get("role") == "serving"
+                  and not r.get("initial") and not r.get("aborted")]
+    to_training = [r for r in ledger_records
+                   if r.get("kind") == events_mod.ROLE_CHANGED
+                   and r.get("role") == "training"
+                   and not r.get("initial")]
+    return {
+        "decisions": kinds.count(events_mod.ALLOC_DECISION),
+        "notices": kinds.count(events_mod.PREEMPT_NOTICE),
+        "acks": kinds.count(events_mod.PREEMPT_ACK),
+        "forced": sum(1 for r in ledger_records
+                      if r.get("kind") == events_mod.PREEMPT_ACK
+                      and r.get("forced")),
+        "preemptions": len(to_serving),
+        "handbacks": len(to_training),
+        "aborted": sum(1 for r in ledger_records
+                       if r.get("kind") == events_mod.ROLE_CHANGED
+                       and r.get("aborted")),
+        "stragglers_requeued": sum(int(r.get("stragglers") or 0)
+                                   for r in to_training),
+    }
+
+
+def _training_slice_seconds(ledger_records: list, end_s: float) -> float:
+    """Integrate the TRAINING-role slice count over the run — the
+    training side of the co-scheduling ledger. TRANSITIONING time
+    bills to neither side (the handover is the overhead both pay)."""
+    total = 0.0
+    t_prev = 0.0
+    roles: dict = {}
+    for r in ledger_records:
+        kind = r.get("kind")
+        if kind not in (events_mod.PREEMPT_NOTICE,
+                        events_mod.ROLE_CHANGED):
+            continue
+        ts = min(float(r.get("ts", 0.0)), end_s)
+        training = sum(1 for v in roles.values() if v == "training")
+        total += training * max(0.0, ts - t_prev)
+        t_prev = ts
+        if kind == events_mod.PREEMPT_NOTICE:
+            for i in r.get("slices", []):
+                roles[int(i)] = "transitioning"
+        else:
+            for i in r.get("slices", []):
+                roles[int(i)] = r.get("role", "serving")
+    training = sum(1 for v in roles.values() if v == "training")
+    total += training * max(0.0, end_s - t_prev)
+    return total
+
+
+def run_coschedule_drive(
+    workdir: Path,
+    num_slices: int = 4,
+    duration_s: float = 1500.0,
+    base_rps: float = 3.0,
+    diurnal_amplitude: float = 0.6,
+    diurnal_period_s: float = 900.0,
+    diurnal_phase: float = 0.0,
+    bursts: tuple = (),
+    deadline_s: float = 120.0,
+    seed: int = 13,
+    alloc_policy=None,
+    policy: "sup_mod.SupervisePolicy | None" = None,
+    gw_policy=None,
+    trainer_rate: float = 0.5,
+    checkpoint_every: int = 60,
+    trainer_resume_s: float = 20.0,
+    trainer_ack: bool = True,
+    kill_on_notice: int = 0,  # SIGKILL the supervisor after the Nth
+    # PREEMPT_NOTICE lands on the ledger (mid-handover crash)
+    tenants: dict | None = None,  # tenant -> weight (arms gateway WFQ)
+    flood: dict | None = None,  # {"tenant", "at", "duration",
+    # "rps", "priority"}: a second open-loop stream from ONE tenant
+    preempt: tuple = (),  # ((slice, at), ...) world faults
+    torn_status_at: tuple = (),
+    torn_demand_at: tuple = (),
+    drain_grace_s: float = 1800.0,
+) -> dict:
+    """Drive ONE fleet under BOTH workloads on one SimClock: a REAL
+    Supervisor (with the third controller when `alloc_policy` is set —
+    `None` is the serving-only arm) reconciles the scripted world and
+    executes the preemption protocol, a REAL Gateway serves the seeded
+    diurnal(+burst) open-loop stream and publishes demand-signal.json,
+    and a VirtualTrainer fills the TRAINING slices, answering drain
+    notices with the ~0-cost checkpoint flush + job-ack. Faults
+    compose: slice preemptions, torn status/demand copies, a
+    supervisor SIGKILL right after a PREEMPT_NOTICE lands (the
+    mid-handover crash), a trainer that never acks (bounded wait →
+    forced preemption), and a tenant flood against the WFQ admission
+    queue. Afterwards the ServeInvariantChecker folds BOTH ledgers
+    with the allocation invariants armed; the result carries goodput,
+    training steps, and the preemption MTTR under the first burst."""
+    from tritonk8ssupervisor_tpu import obs as obs_lib
+    from tritonk8ssupervisor_tpu.provision import allocator as alloc_mod
+    from tritonk8ssupervisor_tpu.provision.fleetview import FileHealthSource
+    from tritonk8ssupervisor_tpu.serving import gateway as gw_mod
+    from tritonk8ssupervisor_tpu.serving import traffic as traffic_mod
+
+    policy = policy or default_policy()
+    interval = policy.interval
+    clock = SimClock(stall_timeout=60.0)
+    config = sim_config(num_slices, failure_domains=0)
+    world = ChaosFleet(Path(workdir), clock, config, heal_seconds=30.0)
+    for index, at in preempt:
+        world.preempt(int(index), at=float(at))
+    torn_at = sorted(float(t) for t in torn_status_at)
+    torn_demand = sorted(float(t) for t in torn_demand_at)
+
+    if kill_on_notice > 0:
+        ledger: events_mod.EventLedger = KillOnKindLedger(
+            world.paths.events, clock=clock.time,
+            echo=lambda line: None, fsync=False,
+            kill_kind=events_mod.PREEMPT_NOTICE,
+            kill_after=int(kill_on_notice),
+        )
+    else:
+        ledger = events_mod.EventLedger(world.paths.events,
+                                        clock=clock.time,
+                                        echo=lambda line: None,
+                                        fsync=False)
+    reqlog = reqlog_mod.RequestLog(world.paths.request_log,
+                                   clock=clock.time,
+                                   echo=lambda line: None, fsync=False)
+    span_log = obs_lib.SpanLog(world.paths.span_log, clock=clock.time,
+                               echo=lambda line: None, fsync=False)
+    registry = obs_lib.MetricsRegistry(clock=clock.time)
+    telemetry = obs_lib.Telemetry(
+        registry,
+        obs_lib.Tracer(span_log, plane=obs_lib.SERVING,
+                       clock=clock.time, incarnation=1),
+        snapshot_path=world.paths.metrics_snapshot,
+    )
+    sup_telemetry = obs_lib.Telemetry(
+        registry,
+        obs_lib.Tracer(span_log, plane=obs_lib.SUPERVISOR,
+                       clock=clock.time),
+    )
+    gw_policy = gw_policy or gw_mod.GatewayPolicy(
+        max_seq_len=512, slots_per_slice=4, prefill_chunk=64,
+        queue_budget=48, bucket_bounds=(64, 128, 256),
+        poll_every_s=2.0, default_deadline_s=deadline_s,
+        demand_signal_every_s=5.0,
+        tenant_weights=dict(tenants) if tenants else None,
+        # raw record streams ARE the checker evidence: no retention caps
+        terminal_key_retention=0, journal_compact_records=0,
+        audit_retention=0,
+    )
+    cost = gw_mod.DecodeCostModel()
+    status_path = world.paths.fleet_status
+
+    stop = threading.Event()
+    sup_restarts = [0]
+    clock.launch()
+
+    def make_supervisor() -> "sup_mod.Supervisor":
+        from tritonk8ssupervisor_tpu.provision import retry as retry_mod
+
+        allocator = None
+        if alloc_policy is not None:
+            # rng pinned like the supervisor's: the drives must be a
+            # pure function of (scenario, seed)
+            allocator = alloc_mod.Allocator(
+                alloc_policy, num_slices,
+                cooldown=retry_mod.Cooldown(alloc_policy.cooldown_s,
+                                            alloc_policy.cooldown_cap_s,
+                                            rng=lambda: 0.0),
+            )
+        return sup_mod.Supervisor(
+            config, world.paths, _Quiet(),
+            run=world.run, run_quiet=world.run_quiet, policy=policy,
+            ledger=ledger, clock=clock.time, sleep=clock.sleep,
+            rng=lambda: 0.0, readiness_timeout=60.0, hooks=clock,
+            telemetry=sup_telemetry, allocator=allocator,
+        )
+
+    def sup_body() -> None:
+        clock.begin()
+        try:
+            supervisor = make_supervisor()
+            supervisor.restore()
+            while not stop.is_set():
+                try:
+                    supervisor.tick()
+                except SupervisorKilled:
+                    # SIGKILL between PREEMPT_NOTICE and ROLE_CHANGED:
+                    # resume from the ledger — the open handover must
+                    # be finished under its ORIGINAL id, never
+                    # restarted as a sibling
+                    sup_restarts[0] += 1
+                    supervisor = make_supervisor()
+                    supervisor.restore()
+                    continue
+                if stop.is_set():
+                    break
+                clock.sleep(interval)
+        finally:
+            clock.release()
+
+    def make_gateway() -> "gw_mod.Gateway":
+        engines = {
+            i: gw_mod.ModeledEngine(slots=gw_policy.slots_per_slice,
+                                    prefill_chunk=gw_policy.prefill_chunk,
+                                    cost=cost)
+            for i in range(num_slices)
+        }
+        return gw_mod.Gateway(
+            engines, FileHealthSource(status_path),
+            policy=gw_policy, clock=clock.time, reqlog=reqlog,
+            telemetry=telemetry,
+            demand_path=world.paths.demand_signal,
+        )
+
+    model = traffic_mod.TrafficModel(
+        base_rps=base_rps, diurnal_amplitude=diurnal_amplitude,
+        diurnal_period_s=diurnal_period_s, diurnal_phase=diurnal_phase,
+        bursts=tuple(bursts),
+        seed=seed, deadline_s=deadline_s, key_prefix=f"co{seed}",
+        tenant=("base" if tenants else None),
+    )
+    arrivals = traffic_mod.generate_arrivals(model, duration_s)
+    flood_window = None
+    if flood is not None:
+        flood_model = traffic_mod.TrafficModel(
+            base_rps=float(flood.get("rps", 8.0)),
+            diurnal_amplitude=0.0, seed=seed + 7919,
+            deadline_s=deadline_s,
+            key_prefix=f"fl{seed}",
+            tenant=str(flood.get("tenant", "flood")),
+            priority=int(flood.get("priority", 0)),
+        )
+        at = float(flood.get("at", duration_s / 3.0))
+        dur = float(flood.get("duration", 180.0))
+        extra = [r for r in traffic_mod.generate_arrivals(
+            flood_model, dur, rid0=10_000_000)]
+        for r in extra:
+            r.arrival += at
+        arrivals = sorted(arrivals + extra, key=lambda r: r.arrival)
+        flood_window = (at, at + dur)
+    hard_stop = duration_s + drain_grace_s
+
+    trainer = None
+    if alloc_policy is not None:
+        trainer = VirtualTrainer(
+            status_path, world.paths.job_ack, clock,
+            steps_per_slice_s=trainer_rate,
+            checkpoint_every=checkpoint_every,
+            resume_s=trainer_resume_s, ack=trainer_ack,
+        )
+
+    def handover_in_progress() -> dict | None:
+        try:
+            doc = json.loads(status_path.read_text())
+        except (OSError, ValueError):
+            return None
+        block = doc.get("allocation") if isinstance(doc, dict) else None
+        return block.get("in_progress") if isinstance(block, dict) \
+            else None
+
+    thread = threading.Thread(target=sup_body, daemon=True)
+    thread.start()
+    gateway = make_gateway()
+    gateway.recover(0.0)
+    i_arr = 0
+    next_step: dict = {i: None for i in gateway.workers}
+    quiet = False
+    clock.launch()
+    clock.begin()
+    try:
+        while True:
+            now = clock.time()
+            while torn_at and torn_at[0] <= now:
+                torn_at.pop(0)
+                _tear_file(status_path)
+            while torn_demand and torn_demand[0] <= now:
+                torn_demand.pop(0)
+                _tear_file(world.paths.demand_signal)
+            if trainer is not None:
+                trainer.advance(now)
+            gateway.poll(now)
+            gateway.expire_queued(now)
+            down = world.down_now()
+            for i, worker in gateway.workers.items():
+                if i in down and worker.alive:
+                    worker.fail()
+                    next_step[i] = None
+                elif i not in down and not worker.alive:
+                    worker.revive()
+                    next_step[i] = now
+            while i_arr < len(arrivals) and arrivals[i_arr].arrival <= now:
+                gateway.submit(arrivals[i_arr], now)
+                i_arr += 1
+            for i in sorted(gateway.workers):
+                if next_step[i] is not None and next_step[i] <= now:
+                    dt = gateway.workers[i].step(now)
+                    next_step[i] = None if dt is None else now + dt
+            for i, worker in gateway.workers.items():
+                if (next_step[i] is None and worker.alive
+                        and (worker.inflight or (
+                            gateway.queue_depth()
+                            and gateway.slice_mode(i) == gw_mod.SERVE))):
+                    next_step[i] = now
+            quiet = (i_arr >= len(arrivals)
+                     and gateway.queue_depth() == 0
+                     and all(w.idle()
+                             for w in gateway.workers.values()))
+            if quiet and alloc_policy is not None:
+                # let a handover already in flight close — an abandoned
+                # one would read as an orphaned PREEMPT_NOTICE
+                quiet = handover_in_progress() is None
+            if quiet or now >= hard_stop:
+                break
+            candidates = [t for t in next_step.values() if t is not None]
+            if i_arr < len(arrivals):
+                candidates.append(arrivals[i_arr].arrival)
+            if torn_at:
+                candidates.append(torn_at[0])
+            if torn_demand:
+                candidates.append(torn_demand[0])
+            if trainer is not None:
+                candidates.append(trainer.next_wake(now))
+            candidates.append(now + 2.0 * gw_policy.poll_every_s)
+            t_next = min(candidates)
+            if t_next > now:
+                clock.sleep(t_next - now)
+    finally:
+        stop.set()
+        clock.release()
+    thread.join(timeout=120)
+
+    req_records = reqlog.replay()
+    led_records = ledger.replay()
+    end_s = clock.time()
+    gateway.update_gauges()
+    metrics_snapshot = telemetry.write_snapshot() or registry.snapshot()
+    checker = ServeInvariantChecker(
+        gw_policy, interval_s=interval,
+        staleness_bound_s=2.0 * 30.0 + 4.0 * interval
+        + gw_policy.poll_every_s,
+        alloc_policy=alloc_policy,
+        # propagation grace covers one full tick: a status copy torn
+        # at the PREEMPT_NOTICE's own publish leaves the gateway on
+        # its last-good (pre-notice) view until the NEXT tick rewrites
+        # the file — keep-last-good is the reader contract, not a leak
+        drain_grace_s=interval + 2.0 * gw_policy.poll_every_s + 1.0,
+    )
+    violations = checker.check(req_records, led_records,
+                               metrics=metrics_snapshot)
+    if not quiet:
+        violations.append(
+            f"convergence: request plane not quiescent by "
+            f"t={hard_stop:.0f}s (seed {seed})"
+        )
+    trainer_report = trainer.finish() if trainer is not None else None
+    if trainer_report is not None:
+        # THE preemption-cost invariant: the drain-notice checkpoint
+        # window (acked) or the periodic checkpoint (forced) bounds
+        # every preemption to <= one checkpoint interval of steps
+        for resume in trainer_report["resumes"]:
+            if resume["steps_lost"] > checkpoint_every:
+                violations.append(
+                    f"preemption-cost: resume at t={resume['ts']} lost "
+                    f"{resume['steps_lost']} steps > one checkpoint "
+                    f"interval ({checkpoint_every})"
+                )
+    if flood_window is not None and tenants:
+        violations += checker.check_tenant_fairness(
+            req_records, tenants, flood["tenant"], flood_window)
+    view = reqlog_mod.fold(req_records)
+    latencies = sorted(
+        r["latency_s"] for r in req_records
+        if r.get("kind") == reqlog_mod.COMPLETED
+        and r.get("latency_s") is not None
+    )
+
+    def pct(q: float):
+        if not latencies:
+            return None
+        idx = min(len(latencies) - 1,
+                  max(0, int(round(q * (len(latencies) - 1)))))
+        return round(latencies[idx], 3)
+
+    from tritonk8ssupervisor_tpu.obs import metrics as metrics_mod
+
+    tokens = int(metrics_mod.counter_total(
+        metrics_snapshot, "serving_tokens_generated_total"))
+    completed = sum(kv.completions for kv in view.keys.values())
+    accepted = sum(1 for kv in view.keys.values() if kv.accepts > 0)
+    first_burst = min((b[0] for b in bursts), default=None)
+    preempt_mttr = None
+    if first_burst is not None:
+        reclaims = [r.get("ts", 0.0) for r in led_records
+                    if r.get("kind") == events_mod.ROLE_CHANGED
+                    and r.get("role") == "serving"
+                    and not r.get("initial") and not r.get("aborted")
+                    and r.get("ts", 0.0) >= first_burst]
+        if reclaims:
+            preempt_mttr = round(min(reclaims) - first_burst, 3)
+    return {
+        "seed": seed,
+        "coscheduled": alloc_policy is not None,
+        "num_slices": num_slices,
+        "duration_s": duration_s,
+        "end_s": round(end_s, 3),
+        "offered": len(arrivals),
+        "accepted": accepted,
+        "completed": completed,
+        "expired": sum(kv.expiries for kv in view.keys.values()),
+        "requeues": sum(kv.requeues for kv in view.keys.values()),
+        "sheds": view.sheds,
+        "tokens": tokens,
+        "goodput": (round(completed / len(arrivals), 4)
+                    if arrivals else None),
+        "p50_latency_s": pct(0.50),
+        "p99_latency_s": pct(0.99),
+        "training": trainer_report,
+        "training_slice_seconds": round(
+            _training_slice_seconds(led_records, end_s), 1),
+        "preempt_mttr_s": preempt_mttr,
+        "handovers": _alloc_summary(led_records),
+        "supervisor_restarts": sup_restarts[0],
+        "violations": violations,
+        "converged": quiet,
+    }
+
+
+@dataclasses.dataclass
+class CoscheduleScenario:
+    """One seeded composition of diurnal(+burst) traffic, a training
+    job filling the troughs, and the co-scheduling fault primitives.
+    Every scenario is convergeable: bursts end, torn files are
+    rewritten by the next publish, kills resume from the ledgers, a
+    wedged trainer is forced past the bounded wait."""
+
+    seed: int
+    num_slices: int
+    duration_s: float
+    base_rps: float
+    diurnal_amplitude: float
+    diurnal_period_s: float
+    bursts: tuple
+    deadline_s: float
+    events: list
+
+
+COSCHEDULE_PRIMITIVES = (
+    "surge-during-training", "supervisor-kill-mid-handover",
+    "never-acking-trainer", "tenant-flood", "torn-status",
+    "torn-demand", "slice-outage",
+)
+
+
+def generate_coschedule_scenario(seed: int,
+                                 num_slices: int = 4
+                                 ) -> CoscheduleScenario:
+    """Deterministic co-scheduling scenario from `seed`: a diurnal
+    trace whose trough lends slices to training and whose peak (or a
+    burst landing IN the trough) forces preemption back, composed with
+    up to two fault primitives — the supervisor SIGKILL mid-handover,
+    the never-acking trainer, and the tenant flood being the three the
+    acceptance criteria name."""
+    rng = random.Random(int(seed))
+    period = 900.0
+    duration = 1200.0 + 150.0 * rng.randrange(0, 3)
+    base = 2.6 + 0.3 * rng.randrange(0, 3)
+    amplitude = 0.55 + 0.05 * rng.randrange(0, 3)
+    events: list = []
+    bursts: list = []
+    if rng.random() < 0.8:
+        # surge-during-training: the burst lands in the trough, where
+        # the fleet has lent the most slices to training — the moment
+        # the preemption protocol earns its keep
+        at = 0.55 * period + 30.0 * rng.randrange(0, 8)
+        bursts.append((at, 150.0 + 60.0 * rng.randrange(0, 2),
+                       2.5 + 0.5 * rng.randrange(0, 2)))
+        events.append({"kind": "surge-during-training", "at": at})
+    used: set = set()
+    for _ in range(rng.randrange(0, 3)):
+        kind = rng.choice(COSCHEDULE_PRIMITIVES[1:])
+        if kind in used:
+            continue
+        used.add(kind)
+        if kind == "supervisor-kill-mid-handover":
+            events.append({"kind": kind, "nth": 1 + rng.randrange(2)})
+        elif kind == "never-acking-trainer":
+            events.append({"kind": kind})
+        elif kind == "tenant-flood":
+            events.append({
+                "kind": kind,
+                "at": 120.0 + 60.0 * rng.randrange(0, 6),
+                "duration": 120.0 + 60.0 * rng.randrange(0, 2),
+                "rps": 6.0 + 2.0 * rng.randrange(0, 2),
+            })
+        elif kind in ("torn-status", "torn-demand"):
+            events.append({"kind": kind,
+                           "at": 120.0 + 60.0 * rng.randrange(0, 8)})
+        elif kind == "slice-outage":
+            events.append({"kind": kind,
+                           "slice": rng.randrange(num_slices),
+                           "at": 90.0 + 60.0 * rng.randrange(0, 5)})
+    return CoscheduleScenario(
+        seed=int(seed), num_slices=num_slices, duration_s=duration,
+        base_rps=base, diurnal_amplitude=amplitude,
+        diurnal_period_s=period, bursts=tuple(bursts),
+        deadline_s=120.0, events=events,
+    )
+
+
+def run_coschedule_campaign(scenario: CoscheduleScenario,
+                            workdir: Path) -> dict:
+    """One seeded co-scheduling campaign: the scenario's traffic and
+    faults through `run_coschedule_drive` with the default campaign
+    policies. The verdict carries the checker's violations (allocation
+    + WFQ invariants armed) plus the handover bookkeeping."""
+    kwargs: dict = dict(
+        num_slices=scenario.num_slices,
+        duration_s=scenario.duration_s,
+        base_rps=scenario.base_rps,
+        diurnal_amplitude=scenario.diurnal_amplitude,
+        diurnal_period_s=scenario.diurnal_period_s,
+        bursts=scenario.bursts,
+        deadline_s=scenario.deadline_s,
+        seed=scenario.seed,
+        alloc_policy=default_alloc_policy(scenario.num_slices),
+    )
+    preempt: list = []
+    torn_status: list = []
+    torn_demand: list = []
+    for event in scenario.events:
+        kind = event["kind"]
+        if kind == "supervisor-kill-mid-handover":
+            kwargs["kill_on_notice"] = event.get("nth", 1)
+        elif kind == "never-acking-trainer":
+            kwargs["trainer_ack"] = False
+        elif kind == "tenant-flood":
+            kwargs["tenants"] = {"base": 3.0, "flood": 1.0}
+            kwargs["flood"] = {
+                "tenant": "flood", "at": event["at"],
+                "duration": event["duration"], "rps": event["rps"],
+            }
+        elif kind == "torn-status":
+            torn_status.append(event["at"])
+        elif kind == "torn-demand":
+            torn_demand.append(event["at"])
+        elif kind == "slice-outage":
+            preempt.append((event["slice"], event["at"]))
+    kwargs["preempt"] = tuple(preempt)
+    kwargs["torn_status_at"] = tuple(torn_status)
+    kwargs["torn_demand_at"] = tuple(torn_demand)
+    out = run_coschedule_drive(Path(workdir), **kwargs)
     out["events"] = [e["kind"] for e in scenario.events]
     return out
