@@ -1534,6 +1534,8 @@ def run_serve_scenario(
     shared_prefix_share: float = 0.0,
     prompt_lens: tuple | None = None,
     with_telemetry: bool = False,
+    spec_k: int = 0,
+    spec_acceptance: float = 0.85,
 ) -> dict:
     """One open-loop traffic drive against the gateway on a virtual
     clock. `slots=1` + whole-bucket prefill IS the request-at-a-time
@@ -1585,6 +1587,8 @@ def run_serve_scenario(
             page_size=page_size,
             pages_per_slice=pages_per_slice,
             prefix_cache=prefix_cache,
+            spec_k=spec_k,
+            spec_acceptance=spec_acceptance,
         )
         clock = SimClock()
         engines = {
@@ -1593,7 +1597,9 @@ def run_serve_scenario(
                                     cost=cost,
                                     page_size=page_size,
                                     num_pages=pages_per_slice,
-                                    prefix_cache=prefix_cache)
+                                    prefix_cache=prefix_cache,
+                                    spec_k=spec_k,
+                                    spec_acceptance=spec_acceptance)
             for i in range(num_slices)
         }
         # fsync=False: the virtual-clock drive never crashes the OS,
@@ -1748,6 +1754,7 @@ def run_serve_scenario(
             result["shared_prefix_share"] = shared_prefix_share
             result["pages_per_slice"] = pages_per_slice
             result["prefix_cache"] = prefix_cache
+            result["spec_k"] = spec_k
         if outage is not None:
             t0, t_heal = window
             in_window = [r for r in m.completed
@@ -1871,6 +1878,25 @@ def run_serve_benchmark(num_slices: int = 4) -> dict:
         slots=16, prefill_chunk=64, prefix_cache=False,
         pages_per_slice=256, **mixed_common
     )
+    # ---- speculative A/B (the engine-speed headline): the same
+    # open-loop stream on the SAME memory-equal paged pool, with and
+    # without a drafter. Load is sized ABOVE both arms' capacity
+    # (~667 vs ~1370 modeled tok/s at 4 slices), so each arm saturates
+    # and the ratio measures per-chip CAPACITY — the matched-memory
+    # spec-vs-paged-baseline comparison the acceptance bar names. The
+    # modeled engine mirrors the real SlotEngine's token accounting
+    # with seeded per-request acceptance draws at 0.85.
+    spec_common = dict(
+        num_slices=num_slices, duration_s=600.0, base_rps=30.0,
+        diurnal_amplitude=0.2, queue_budget=96, seed=11,
+        deadline_s=300.0, with_reqlog=True, page_size=16,
+        pages_per_slice=256, prefix_cache=False,
+    )
+    spec_base = run_serve_scenario(slots=8, prefill_chunk=64,
+                                   **spec_common)
+    spec_drive = run_serve_scenario(slots=8, prefill_chunk=64,
+                                    spec_k=4, spec_acceptance=0.85,
+                                    **spec_common)
     # load chosen to sit BETWEEN (N-1)- and N-slice capacity during
     # the outage window (which rides the diurnal high): losing one
     # slice makes the SLO budget bind (sheds must appear) and the heal
@@ -1914,6 +1940,13 @@ def run_serve_benchmark(num_slices: int = 4) -> dict:
         "shared_prefix_aligned_tokens") or 0
     paged_peak = (paged.get("engine") or {}).get("peak_slots_busy")
     fixed_peak = (paged_fixed.get("engine") or {}).get("peak_slots_busy")
+    spec_over_paged = (
+        round(spec_drive["tokens_per_sec_per_chip"]
+              / spec_base["tokens_per_sec_per_chip"], 3)
+        if spec_base["tokens_per_sec_per_chip"] else None
+    )
+    spec_engine_stats = (spec_drive.get("engine") or {}).get("spec") or {}
+    spec_acceptance = spec_engine_stats.get("acceptance_rate")
     passes = bool(
         speedup is not None and speedup >= 2.0
         and cont["p99_latency_s"] is not None
@@ -1958,6 +1991,21 @@ def run_serve_benchmark(num_slices: int = 4) -> dict:
         and paged["tokens_per_sec"] > paged_fixed["tokens_per_sec"]
         and paged["quiescent"]
         and paged["overload_sheds_below_budget"] == 0
+        # speculative: >= 1.4x per-chip over the paged baseline at
+        # matched KV memory, no worse p99, honest sheds, acceptance
+        # actually near the modeled 0.85 (the seeded draws work)
+        and spec_over_paged is not None and spec_over_paged >= 1.4
+        and spec_drive["p99_latency_s"] is not None
+        and spec_base["p99_latency_s"] is not None
+        and spec_drive["p99_latency_s"] <= spec_base["p99_latency_s"]
+        and spec_drive["quiescent"] and spec_base["quiescent"]
+        and spec_drive["overload_sheds_below_budget"] == 0
+        and spec_drive["expired"] == 0
+        # accepted/drafted under LEADING-RUN semantics at per-token
+        # acceptance a=0.85, k=4 is (a + a^2 + a^3 + a^4)/4 ~ 0.677,
+        # not 0.85 — a reject truncates the rest of the draft
+        and spec_acceptance is not None
+        and 0.62 <= spec_acceptance <= 0.73
     )
     return {
         "benchmark": "serving_gateway",
@@ -1993,6 +2041,23 @@ def run_serve_benchmark(num_slices: int = 4) -> dict:
             "fixed_peak_slots_busy": fixed_peak,
             "fixed": paged_fixed,
             "paged": paged,
+        },
+        "speculative": {
+            "metric": "spec_over_paged_baseline_tokens_per_sec_per_chip",
+            "unit": "x (same saturating open-loop stream on the same "
+                    "memory-equal paged pool; spec = drafter k=4 at "
+                    "modeled acceptance 0.85, seeded per-request "
+                    "draws — >= 1.4x per chip at no worse p99 is the "
+                    "acceptance bar)",
+            "value": spec_over_paged,
+            "spec_k": 4,
+            "acceptance_rate": spec_acceptance,
+            # greedy token-identity is the REAL engine's property —
+            # pinned in BENCH_engine.json's speculative block (which
+            # --check verifies structurally) and tests/test_spec.py;
+            # this modeled block mirrors the token ACCOUNTING only
+            "baseline": spec_base,
+            "spec": spec_drive,
         },
         "passes": passes,
     }
@@ -2977,6 +3042,19 @@ def run_check(
             committed_sv.get("paged_slots", {}).get("value"),
             current_sv.get("paged_slots", {}).get("value"),
         )
+        committed_spec = committed_sv.get("speculative", {})
+        current_spec = current_sv.get("speculative", {})
+        compare_floor(
+            "serve speculative speedup (spec over paged baseline)",
+            committed_spec.get("value"), current_spec.get("value"))
+        compare("serve speculative p99 latency",
+                committed_spec.get("spec", {}).get("p99_latency_s"),
+                current_spec.get("spec", {}).get("p99_latency_s"))
+        if current_spec.get("acceptance_rate") is None:
+            problems.append(
+                "serve speculative block lost its acceptance rate "
+                "(engines no longer report spec accounting)"
+            )
         if not current_sv["passes"]:
             problems.append(
                 "serve drill no longer passes (continuous batching >= "
@@ -3009,6 +3087,29 @@ def run_check(
             problems.append(
                 "committed BENCH_engine.json lost token identity "
                 "between prefix-cold and prefix-warm drives"
+            )
+        # the speculative block's structural pins: the committed
+        # evidence must show EXACT greedy decoding (token-identical to
+        # the drafterless baseline), a recorded acceptance rate, and
+        # the >= 1.4x matched-memory speedup the acceptance bar names
+        committed_spec_en = committed_en.get("speculative") or {}
+        if not committed_spec_en.get("token_identical", False):
+            problems.append(
+                "committed BENCH_engine.json speculative block is not "
+                "token-identical to the drafterless baseline (greedy "
+                "speculative decoding must be EXACT)"
+            )
+        if committed_spec_en.get("acceptance_rate") is None:
+            problems.append(
+                "committed BENCH_engine.json speculative block lacks "
+                "an acceptance rate"
+            )
+        if (committed_spec_en.get("value") is None
+                or committed_spec_en["value"] < 1.4):
+            problems.append(
+                "committed BENCH_engine.json speculative speedup "
+                f"{committed_spec_en.get('value')} is below the 1.4x "
+                "matched-memory acceptance bar"
             )
 
     servechaos_baseline = Path(servechaos_baseline)
@@ -3542,7 +3643,14 @@ def main(argv: list[str] | None = None) -> int:
             f" tok on hits); paged slots: peak busy "
             f"{result['paged_slots']['value']} vs fixed "
             f"{result['paged_slots']['fixed_peak_slots_busy']} "
-            f"(memory-equal) -> passes={result['passes']}",
+            f"(memory-equal); speculative k=4: "
+            f"{result['speculative']['spec']['tokens_per_sec_per_chip']:.1f}"
+            f" tok/s/chip = {result['speculative']['value']:.2f}x the "
+            f"paged baseline at matched memory (acceptance "
+            f"{result['speculative']['acceptance_rate']:.0%}, p99 "
+            f"{result['speculative']['spec']['p99_latency_s']:.1f}s vs "
+            f"{result['speculative']['baseline']['p99_latency_s']:.1f}s)"
+            f" -> passes={result['passes']}",
             file=sys.stderr,
         )
         return 0 if result["passes"] else 1

@@ -467,8 +467,17 @@ def test_check_gate_covers_elastic(tmp_path):
     the current time-to-training-resumed regressed past tolerance."""
     import bench_provision
 
+    absent = tmp_path / "absent.json"
+    # every OTHER optional baseline is pointed absent too: with a real
+    # baseline on disk run_check RE-RUNS that benchmark (chaos/serve
+    # campaigns, autoscale + allocator cost drives — minutes of sim),
+    # and this smoke only asserts the elastic gate trips
     ok, problems, _ = bench_provision.run_check(
-        elastic_baseline=tmp_path / "absent.json"
+        elastic_baseline=absent,
+        supervise_baseline=absent, fleetscale_baseline=absent,
+        chaos_baseline=absent, serve_baseline=absent,
+        servechaos_baseline=absent, obs_baseline=absent,
+        autoscale_baseline=absent, allocator_baseline=absent,
     )
     assert not ok
     assert any("elastic" in p for p in problems)

@@ -69,6 +69,51 @@ def test_pool_peak_tracks_high_water():
     assert pool.peak_in_use == 6
 
 
+def test_release_span_frees_exactly_the_truncated_tail():
+    """The rollback primitive: allocate -> speculate past the point
+    the accept run reached -> reject truncates -> release_span frees
+    exactly the pages past the truncation point, and the later
+    whole-slot release cannot double-unref (conservation)."""
+    pool = kvpool.PagePool(8, page_size=4)
+    slot_pages = pool.alloc(6)  # prompt+budget needs 4; spec window +2
+    kept = list(slot_pages[:4])
+    freed = pool.release_span(slot_pages, 4)
+    assert freed == 2
+    assert slot_pages == kept  # truncated in place
+    assert pool.pages_in_use == 4 and pool.pages_free == 4
+    # the whole-slot release sees only the kept span: balanced pool
+    assert pool.unref(slot_pages) == 4
+    assert pool.pages_in_use == 0 and pool.pages_free == 8
+
+
+def test_release_span_respects_shared_refcounts():
+    """A truncated tail page someone else still holds (a shared
+    prefix, the store) is unref'd but NOT freed — refcounts, not
+    ownership, decide what returns to the free list."""
+    pool = kvpool.PagePool(4, page_size=4)
+    pages = pool.alloc(3)
+    shared_tail = pages[2]
+    pool.ref([shared_tail])  # a second holder
+    assert pool.release_span(pages, 2) == 0  # unref'd, still alive
+    assert pool.refcount(shared_tail) == 1
+    assert len(pages) == 2
+    assert pool.unref([shared_tail]) == 1  # the other holder frees it
+    pool.unref(pages)
+    assert pool.pages_in_use == 0
+
+
+def test_release_span_noop_past_end_and_from_zero():
+    pool = kvpool.PagePool(4, page_size=4)
+    pages = pool.alloc(2)
+    assert pool.release_span(pages, 5) == 0  # nothing past the end
+    assert len(pages) == 2
+    whole = list(pages)
+    assert pool.release_span(pages, 0) == 2  # whole-list truncation
+    assert pages == [] and pool.pages_in_use == 0
+    with pytest.raises(ValueError, match="free page"):
+        pool.unref(whole)  # conservation: they are genuinely gone
+
+
 # ---------------------------------------------------------- block keying
 
 

@@ -1166,6 +1166,12 @@ def test_check_gate_covers_serve(tmp_path):
         supervise_baseline=absent, elastic_baseline=absent,
         fleetscale_baseline=absent, chaos_baseline=absent,
         serve_baseline=absent, servechaos_baseline=absent,
+        # these three RE-RUN their cost drives when their committed
+        # baselines exist — point them absent too or this smoke pays
+        # for the autoscale + allocator benchmarks (the docstring's
+        # "fast provision-sim-only run" promise)
+        obs_baseline=absent, autoscale_baseline=absent,
+        allocator_baseline=absent,
     )
     assert not ok
     assert any("(serve)" in p for p in problems)

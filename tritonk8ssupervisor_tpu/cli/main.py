@@ -399,6 +399,27 @@ def build_parser() -> argparse.ArgumentParser:
         "behavior, kept as an A/B lever)",
     )
     parser.add_argument(
+        "--draft-model", type=str, default="tiny",
+        choices=("tiny", "none"),
+        help="serve: drafter config for speculative decoding (a "
+        "smaller models/ TransformerLM proposing --spec-k tokens per "
+        "round; the target verifies them in one batched forward with "
+        "exact accept/reject, so greedy output stays token-identical). "
+        "'none' disables, same as --no-spec",
+    )
+    parser.add_argument(
+        "--spec-k", type=int, default=4, metavar="K",
+        help="serve: drafter tokens proposed per speculative round "
+        "(default 4) — tokens-per-target-step multiplies by the "
+        "acceptance length; 0 disables speculation",
+    )
+    parser.add_argument(
+        "--no-spec", action="store_true",
+        help="serve: disable speculative decoding (one target decode "
+        "step per token — the pre-spec behavior, kept as an A/B "
+        "lever)",
+    )
+    parser.add_argument(
         "--config",
         type=Path,
         default=None,
@@ -1143,6 +1164,22 @@ def serve_cmd(args, paths: state.RunPaths, prompter: Prompter) -> int:
     )
     sample = jax.random.randint(jax.random.key(0), (1, 8), 0, vocab)
     params = model.init(jax.random.key(1), sample, train=False)["params"]
+    # speculative decoding: a smaller drafter proposes --spec-k tokens
+    # per round, the target verifies them in ONE batched forward with
+    # exact accept/reject — greedy serving stays token-identical to
+    # plain decode (pinned), only tokens-per-target-step changes
+    spec_on = (not args.no_spec and args.spec_k > 0
+               and args.draft_model != "none")
+    draft_model = draft_params = None
+    if spec_on:
+        draft_model = TransformerLM(
+            vocab_size=vocab, num_layers=1, num_heads=2, embed_dim=32,
+            max_seq_len=max_seq, dtype=jnp.float32,
+            logits_dtype=jnp.float32,
+        )
+        draft_params = draft_model.init(
+            jax.random.key(2), sample, train=False
+        )["params"]
     policy = gateway_mod.GatewayPolicy(
         max_seq_len=max_seq,
         slots_per_slice=max(1, args.slots),
@@ -1158,6 +1195,7 @@ def serve_cmd(args, paths: state.RunPaths, prompter: Prompter) -> int:
         pages_per_slice=(args.kv_pages if args.kv_pages > 0 else None),
         prefix_cache=not args.no_prefix_cache,
         tenant_weights=_parse_tenant_weights(args.tenant_weights),
+        spec_k=(args.spec_k if spec_on else 0),
     )
     # the telemetry plane (obs/): spans fsync'd to the workdir's span
     # log (they survive a SIGKILL exactly like the request journal),
@@ -1180,7 +1218,15 @@ def serve_cmd(args, paths: state.RunPaths, prompter: Prompter) -> int:
         num_pages=policy.pages_per_slice,
         prefix_cache=policy.prefix_cache,
         tracer=telemetry.tracer, slice_index=0,
+        draft_model=draft_model, draft_params=draft_params,
+        spec_k=policy.spec_k,
     )
+    if spec_on:
+        prompter.say(
+            f"[serve] speculative decoding ON: drafter "
+            f"'{args.draft_model}' proposes k={policy.spec_k} tokens "
+            "per round, exact accept/reject (--no-spec to disable)"
+        )
     gw = gateway_mod.Gateway(
         {0: eng},
         FileHealthSource(args.status_file or paths.fleet_status),
@@ -1210,6 +1256,14 @@ def serve_cmd(args, paths: state.RunPaths, prompter: Prompter) -> int:
             f"{report['tokens_generated']} tokens, p50 "
             f"{report['p50_latency_s']:.3f}s"
         )
+        spec = (report.get("engine") or {}).get("spec")
+        if spec and spec.get("drafted"):
+            prompter.say(
+                f"speculative: k={spec['spec_k']}, acceptance "
+                f"{spec['acceptance_rate']:.0%} ({spec['accepted']}/"
+                f"{spec['drafted']} drafted accepted, "
+                f"{spec['rolled_back']} rolled back)"
+            )
         return 0 if report["completed"] == report["submitted"] else 1
     return server_mod.serve_http(
         gw, "127.0.0.1", args.port, echo=lambda line: prompter.say(line)

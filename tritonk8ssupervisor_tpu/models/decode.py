@@ -105,6 +105,83 @@ def init_kv_pool(model, num_pages: int, page_size: int,
     }
 
 
+def softmax_np(logits, temperature: float = 1.0):
+    """Host-side softmax over the last axis (numpy, float64
+    accumulation): the acceptance arithmetic of speculative decoding
+    runs on the HOST between two compiled dispatches, and the exactness
+    proof is about probabilities, so the reference math lives here next
+    to the models that produce the logits."""
+    import numpy as np
+
+    z = np.asarray(logits, np.float64) / max(1e-8, float(temperature))
+    z = z - np.max(z, axis=-1, keepdims=True)
+    e = np.exp(z)
+    return e / np.sum(e, axis=-1, keepdims=True)
+
+
+def speculative_accept(draft_tokens, draft_logits, target_logits,
+                       temperature: float, rng):
+    """Exact accept/reject for one slot's k-token draft — the
+    correctness core of speculative decoding (serving/engine.py calls
+    this per slot per round; unit-pinned by a chi-square test).
+
+    Inputs: `draft_tokens` (k,) — the drafter's proposals d_1..d_k;
+    `draft_logits` (k, V) — the drafter logits each proposal was drawn
+    from (ignored when temperature == 0); `target_logits` (k+1, V) —
+    the target model's logits at the k+1 verify positions (row i scores
+    the candidate at draft index i; row k is the bonus position reached
+    only when every draft accepted). `rng` is a numpy Generator (the
+    engine's seeded stream).
+
+    Returns (accepted, emitted): `accepted` leading drafts survived and
+    `emitted` is those tokens plus EXACTLY ONE more from the target —
+    the correction at the first rejected position, or the bonus token.
+
+    Greedy (temperature <= 0): accept d_i iff it equals the target's
+    argmax — the emitted chain IS the target-only greedy chain, token
+    for token. Sampled: the Leviathan et al. rejection rule — accept
+    d_i ~ q with probability min(1, p(d_i)/q(d_i)), else resample from
+    norm(max(p - q, 0)). For ANY draft distribution q this yields
+    exactly p at every emitted position, which is why the drafter can
+    be arbitrarily small/wrong without bending the output distribution
+    (only the acceptance rate, i.e. the speed, suffers)."""
+    import numpy as np
+
+    draft_tokens = np.asarray(draft_tokens)
+    k = int(draft_tokens.shape[0])
+    emitted: list[int] = []
+    if temperature <= 0:
+        ref = np.argmax(np.asarray(target_logits, np.float64), axis=-1)
+        accepted = 0
+        for i in range(k):
+            if int(draft_tokens[i]) != int(ref[i]):
+                break
+            emitted.append(int(draft_tokens[i]))
+            accepted += 1
+        emitted.append(int(ref[accepted]))
+        return accepted, emitted
+    p = softmax_np(target_logits, temperature)  # (k+1, V)
+    q = softmax_np(draft_logits, temperature)  # (k, V)
+    accepted = 0
+    for i in range(k):
+        tok = int(draft_tokens[i])
+        ratio = p[i, tok] / max(q[i, tok], 1e-300)
+        if rng.random() < min(1.0, ratio):
+            emitted.append(tok)
+            accepted += 1
+            continue
+        residual = np.maximum(p[i] - q[i], 0.0)
+        total = residual.sum()
+        if total <= 0.0:
+            # p == q at this position: the rejection branch has measure
+            # zero; fall back to the target distribution outright
+            residual, total = p[i], p[i].sum()
+        emitted.append(int(rng.choice(residual.size, p=residual / total)))
+        return accepted, emitted
+    emitted.append(int(rng.choice(p[k].size, p=p[k] / p[k].sum())))
+    return accepted, emitted
+
+
 def _quant_kv(x):
     """(B, S, H, D) -> (int8 values, (B, S, H) f32 scales): symmetric
     per-(token, head) quantization. The scale rides OUTSIDE the cache

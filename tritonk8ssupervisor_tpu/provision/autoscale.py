@@ -73,6 +73,11 @@ class DemandSignal:
     deadline_headroom_s: float | None = None
     inflight: dict = dataclasses.field(default_factory=dict)  # slice -> n
     active_workers: tuple = ()
+    # KV page-pool headroom across the gateway's bounded pools (None on
+    # pre-paged or unbounded-sim documents): pressure evidence DISTINCT
+    # from queue depth — a fleet can show free slots and a short queue
+    # while its page pools are pinned by long prompts / fat budgets
+    kv_pages_free: int | None = None
 
     def inflight_on(self, slices) -> int:
         return sum(int(self.inflight.get(int(i), 0)) for i in slices)
@@ -93,7 +98,9 @@ def parse_demand_signal(raw) -> DemandSignal | None:
         rate = raw.get("service_rate")
         p99 = raw.get("p99_s")
         headroom = raw.get("deadline_headroom_s")
+        kv_free = raw.get("kv_pages_free")
         return DemandSignal(
+            kv_pages_free=int(kv_free) if kv_free is not None else None,
             updated=float(raw["updated"]),
             queue_depth=int(raw.get("queue_depth", 0)),
             service_rate=float(rate) if rate is not None else None,

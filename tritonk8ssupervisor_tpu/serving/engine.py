@@ -62,6 +62,38 @@ WHERE work happens, never what a token's logits are.
 tests/test_serving.py pins token parity against `decode.generate` for
 staggered joins, chunked prefill, warm-prefix hits, page-boundary
 crossings, and eviction.
+
+**Speculative decoding** (`draft_model=`/`draft_params=`/`spec_k=`)
+multiplies tokens-per-target-step by the acceptance length: a small
+drafter runs `spec_k` cheap autoregressive steps per round proposing a
+draft, and the target model scores all `spec_k + 1` positions in ONE
+batched forward (`_verify_window_paged` — `_decode_step_paged`
+generalized to a per-slot token window through the same page tables).
+Acceptance is EXACT rejection sampling (`models/decode.
+speculative_accept`): greedy streams are token-identical to
+`decode.generate`, sampled streams match the target-only distribution
+provably (chi-square pinned in tests/test_spec.py). Three invariants
+keep it inside the existing discipline:
+
+- **Compiled-once, masked, never reshaped.** The program set stays
+  bounded: target prefill, drafter prefill (same body, drafter
+  closure), drafter decode, target verify — each one shape. Per-slot
+  variable acceptance is handled on the HOST by truncating emissions;
+  inactive rows park their writes on the trash page exactly like
+  plain decode. Nothing recompiles per acceptance length.
+- **Rollback is positional, not copied.** Speculative positions write
+  into the slot's pages; a reject simply does not advance `pos` past
+  the last accepted token, and every later dispatch re-writes its own
+  positions before attending them — the rejected K/V is dead weight
+  overwritten in place, never visible to a neighbour (trash parking
+  covers inactive rows) and never leaked (`kvpool.PagePool.
+  release_span` trims the speculative overhang a finished slot can no
+  longer reach).
+- **The drafter shadows the target page-for-page.** The drafter's KV
+  pool shares the slot page tables (same geometry, its own storage),
+  its prefill mirrors the target's chunks, and a prefix-store hit
+  seeds BOTH pools copy-free — drafter K/V is a pure function of the
+  same token content.
 """
 
 from __future__ import annotations
@@ -90,7 +122,9 @@ class SlotEngine:
                  cache_int8: bool = False,
                  prefix_cache: bool = True,
                  tracer: Tracer | None = None,
-                 slice_index: int | None = None) -> None:
+                 slice_index: int | None = None,
+                 draft_model=None, draft_params=None, spec_k: int = 0,
+                 temperature: float = 0.0, seed: int = 0) -> None:
         import jax
         import jax.numpy as jnp
 
@@ -98,6 +132,25 @@ class SlotEngine:
             raise ValueError(
                 f"max_len {max_len} exceeds model.max_seq_len "
                 f"{model.max_seq_len} (no position embeddings past it)"
+            )
+        if spec_k and (draft_model is None or draft_params is None):
+            raise ValueError(
+                "spec_k > 0 needs a draft_model AND draft_params "
+                "(a smaller models/ config; quantize_params_int8 "
+                "applies to it like any LM tree)"
+            )
+        if draft_model is not None and max_len > draft_model.max_seq_len:
+            raise ValueError(
+                f"max_len {max_len} exceeds draft_model.max_seq_len "
+                f"{draft_model.max_seq_len} (the drafter decodes the "
+                "same positions the target does)"
+            )
+        if (draft_model is not None
+                and draft_model.vocab_size != model.vocab_size):
+            raise ValueError(
+                "draft and target models must share a vocabulary "
+                f"({draft_model.vocab_size} != {model.vocab_size}): "
+                "acceptance compares token ids"
             )
         from tritonk8ssupervisor_tpu.models import decode as dec
 
@@ -130,13 +183,44 @@ class SlotEngine:
         self.pos = np.zeros((self.slots,), np.int32)
         self.last = np.zeros((self.slots,), np.int32)
         self.active = np.zeros((self.slots,), bool)
+        # drafter catch-up state: after an ALL-ACCEPT round the drafter
+        # proposed d_k but never EMBEDDED it, so its KV at the last
+        # accepted position is a hole — the next round must backfill it
+        # (one masked drafter dispatch) before proposing, or the
+        # drafter attends stale garbage there the moment pages are
+        # reused and its acceptance collapses (the target pool has no
+        # such hole: verify writes all k+1 window positions)
+        self._catchup_need = np.zeros((self.slots,), bool)
+        self._catchup_tok = np.zeros((self.slots,), np.int32)
+        self._catchup_pos = np.zeros((self.slots,), np.int32)
         self._requests: dict = {}  # slot -> {tokens, done, budget, out, ...}
         self._prefill_rr = 0
+        # ---- speculative decoding state (None/0 = plain decode) ----
+        self.draft_model = draft_model
+        self.draft_params = draft_params
+        self.spec_k = int(spec_k) if draft_model is not None else 0
+        self.spec = self.spec_k >= 1
+        self.temperature = float(temperature)
+        self._rng = np.random.default_rng(int(seed))
+        # the drafter's pool shadows the target's page-for-page: same
+        # page count + size, its OWN storage (smaller H*D), the SAME
+        # per-slot tables — so allocation, sharing, eviction, and the
+        # trash-parking trick are decided ONCE, in the target's terms
+        self.draft_pool = (dec.init_kv_pool(draft_model,
+                                            self.num_pages + 1,
+                                            self.page_size,
+                                            int8=self.cache_int8)
+                           if self.spec else None)
         # counters the gateway's report()/healthz surface
         self.joins = 0
         self.steps = 0  # step boundaries that did work
         self.prefill_tokens = 0  # prompt tokens actually processed
         self.peak_slots_busy = 0
+        # speculative accounting (stats()["spec"], /metrics gauges)
+        self.spec_rounds = 0
+        self.spec_drafted = 0  # drafter proposals offered to verify
+        self.spec_accepted = 0  # proposals that survived
+        self.spec_rolled_back = 0  # proposals truncated by a reject
         # per-chunk prefill spans (obs/trace.py): a real compiled
         # dispatch is ms-scale compute, so one span line per chunk is
         # noise next to it — and exactly the "where did the 4k prompt
@@ -160,19 +244,52 @@ class SlotEngine:
             _decode_step_paged(model, params, pool, tables, last, pos,
                                active, ps, mp, trash, int8)
         )
+        # sampled non-speculative decode ships logits to the host (the
+        # sampler draws there); jit is lazy, so this compiles only when
+        # temperature > 0 actually routes through it
+        self._decode_logits_fn = jax.jit(
+            lambda params, pool, tables, last, pos, active:
+            _decode_step_paged(model, params, pool, tables, last, pos,
+                               active, ps, mp, trash, int8,
+                               with_logits=True)
+        )
+        if self.spec:
+            dm, win = draft_model, self.spec_k + 1
+            self._draft_prefill_fn = jax.jit(
+                lambda params, pool, tokens, table, start, last_row:
+                _prefill_chunk_paged(dm, params, pool, tokens, table,
+                                     start, last_row, chunk, ps, mp,
+                                     int8)
+            )
+            self._draft_decode_fn = jax.jit(
+                lambda params, pool, tables, last, pos, active:
+                _decode_step_paged(dm, params, pool, tables, last, pos,
+                                   active, ps, mp, trash, int8,
+                                   with_logits=True)
+            )
+            self._verify_fn = jax.jit(
+                lambda params, pool, tables, window, pos, active:
+                _verify_window_paged(model, params, pool, tables,
+                                     window, pos, active, win, ps, mp,
+                                     trash, int8)
+            )
 
     # ------------------------------------------------------- page plumbing
 
     def _span_pages(self, prompt_len: int, max_new: int,
                     shared_blocks: int) -> int:
         """Total pages a slot needs: the larger of the padded prefill
-        reach and prompt + budget, clamped to the table (writes past
-        max_len park on the trash page)."""
+        reach and prompt + budget — plus the speculative window when a
+        drafter is wired (a verify dispatch may write up to `spec_k`
+        positions past the last accepted token, and admission must
+        account the pages those writes land on) — clamped to the table
+        (writes past max_len park on the trash page)."""
         start0 = shared_blocks * self.page_size
         suffix = max(1, prompt_len - start0)
         prefill_end = start0 + -(-suffix // self.prefill_chunk) \
             * self.prefill_chunk
-        span = min(max(prefill_end, prompt_len + max_new),
+        reach = prompt_len + max_new + (self.spec_k if self.spec else 0)
+        span = min(max(prefill_end, reach),
                    self.max_pages * self.page_size)
         return min(-(-span // self.page_size), self.max_pages)
 
@@ -267,6 +384,7 @@ class SlotEngine:
         }
         self.active[slot] = False
         self.pos[slot] = 0
+        self._catchup_need[slot] = False
         self.joins += 1
         self.peak_slots_busy = max(self.peak_slots_busy,
                                    len(self._requests))
@@ -277,6 +395,7 @@ class SlotEngine:
             self.pages.unref(st["pages"])
             self.tables[slot][:] = self.trash
         self.active[slot] = False
+        self._catchup_need[slot] = False
 
     def reset(self) -> None:
         """Drop every request AND flush the prefix store: a reset wipes
@@ -289,6 +408,7 @@ class SlotEngine:
         self.tables[:] = self.trash
         self.active[:] = False
         self.pos[:] = 0
+        self._catchup_need[:] = False
 
     def stats(self) -> dict:
         """The paged-KV/prefix observability block Gateway.report()
@@ -298,7 +418,12 @@ class SlotEngine:
             "page_size": self.page_size,
             "pages_total": self.num_pages,
             "pages_in_use": in_use,
+            # kv_pages_free is page-pool headroom as the AUTOSCALER'S
+            # demand evidence — distinct from slot headroom (a paged
+            # engine can have free slots and no free pages, or the
+            # reverse); report()/healthz/demand-signal.json carry it up
             "pages_free": self.pages.pages_free,
+            "kv_pages_free": self.pages.pages_free,
             "kv_utilization": round(in_use / self.num_pages, 4),
             "peak_pages_in_use": self.pages.peak_in_use,
             "peak_slots_busy": self.peak_slots_busy,
@@ -309,12 +434,56 @@ class SlotEngine:
         }
         out["prefix"] = (self.prefix.stats() if self.prefix is not None
                          else None)
+        out["spec"] = self.spec_stats()
         return out
+
+    def spec_stats(self) -> dict | None:
+        """The speculative-decoding observability block (None when no
+        drafter is wired): proposal/acceptance/rollback counters and
+        the acceptance rate — the first place to look when spec-mode
+        tokens/sec/chip is not what the drafter promised."""
+        if not self.spec:
+            return None
+        return {
+            "spec_k": self.spec_k,
+            "rounds": self.spec_rounds,
+            "drafted": self.spec_drafted,
+            "accepted": self.spec_accepted,
+            "rolled_back": self.spec_rolled_back,
+            "acceptance_rate": (round(self.spec_accepted
+                                      / self.spec_drafted, 4)
+                                if self.spec_drafted else None),
+        }
+
+    def _sample(self, logits) -> int:
+        """One host-side draw from softmax(logits / T) on the engine's
+        seeded stream (the sampled-mode counterpart of argmax)."""
+        probs = self._dec.softmax_np(logits, self.temperature)[None]
+        return int(self._rng.choice(probs.shape[-1], p=probs[0]))
+
+    def _finish(self, slot: int, st: dict, finished: dict) -> None:
+        """Terminal bookkeeping for a slot whose budget filled. In
+        speculative mode the slot's span was allocated `spec_k` tokens
+        past prompt + budget (the verify window's write reach); those
+        overhang pages are unreachable the moment the budget fills, so
+        they go back to the pool NOW (`release_span` truncates the
+        slot's page list — the final `release` cannot double-unref)."""
+        self.active[slot] = False
+        finished[slot] = list(st["out"])
+        if self.spec:
+            need = -(-(st["tokens"].size + st["budget"])
+                     // self.page_size)
+            if len(st["pages"]) > need:
+                self.pages.release_span(st["pages"], need)
+                self.tables[slot][need:] = self.trash
 
     def step(self) -> StepResult | None:
         """One step boundary: one prefill chunk (round-robin) + one
-        decode token for every active slot. Wall time is real compute;
-        dt=0.0 — the caller's clock measures it."""
+        decode round for every active slot — a single greedy/sampled
+        token each in plain mode, or a drafter-propose / target-verify
+        speculative round emitting `accepted + 1` tokens each when a
+        drafter is wired. Wall time is real compute; dt=0.0 — the
+        caller's clock measures it."""
         if not self._requests:
             return None
         jnp = self._jnp
@@ -337,6 +506,15 @@ class SlotEngine:
                 jnp.asarray(self.tables[slot]),
                 jnp.int32(start), jnp.int32(take - 1),
             )
+            if self.spec:
+                # the drafter shadows the target chunk-for-chunk: its
+                # K/V for these positions must exist before the first
+                # speculative round proposes from them
+                self.draft_pool, _ = self._draft_prefill_fn(
+                    self.draft_params, self.draft_pool,
+                    jnp.asarray(chunk), jnp.asarray(self.tables[slot]),
+                    jnp.int32(start), jnp.int32(take - 1),
+                )
             if self._tracer.enabled:
                 self._tracer.emit(
                     "prefill-chunk", t0, self._tracer.now(),
@@ -356,24 +534,54 @@ class SlotEngine:
                     )
                     st["registered"] = True
                 # the final chunk's logits ARE the first generated token
-                first = int(np.argmax(np.asarray(logits)))
+                logits_host = np.asarray(logits, np.float64)
+                first = (self._sample(logits_host)
+                         if self.temperature > 0
+                         else int(np.argmax(logits_host)))
                 st["out"].append(first)
                 self.last[slot] = first
                 self.pos[slot] = st["tokens"].size
                 self.active[slot] = True
                 emitted[slot] = 1
                 if len(st["out"]) >= st["budget"]:
-                    self.active[slot] = False
-                    finished[slot] = list(st["out"])
+                    self._finish(slot, st, finished)
         decoding = sorted(s for s in self._requests if self.active[s])
-        if decoding:
+        if decoding and self.spec:
+            for slot, toks in self._spec_round().items():
+                st = self._requests[slot]
+                toks = toks[:st["budget"] - len(st["out"])]
+                if not toks:
+                    continue
+                st["out"].extend(toks)
+                self.last[slot] = toks[-1]
+                # invariant: pos = prompt + generated - 1 — the
+                # position `last` will occupy. A reject truncated the
+                # window HERE, on the host view; the rejected K/V past
+                # it is overwritten before anything attends it.
+                self.pos[slot] = st["tokens"].size + len(st["out"]) - 1
+                emitted[slot] = emitted.get(slot, 0) + len(toks)
+                if len(st["out"]) >= st["budget"]:
+                    self._finish(slot, st, finished)
+        elif decoding:
             active = self.active.copy()
-            self.pool, next_tokens, new_pos = self._decode_fn(
-                self.params, self.pool, jnp.asarray(self.tables),
-                jnp.asarray(self.last), jnp.asarray(self.pos),
-                jnp.asarray(active),
-            )
-            next_host = np.asarray(next_tokens)
+            if self.temperature > 0:
+                self.pool, next_tokens, logits, new_pos = \
+                    self._decode_logits_fn(
+                        self.params, self.pool, jnp.asarray(self.tables),
+                        jnp.asarray(self.last), jnp.asarray(self.pos),
+                        jnp.asarray(active),
+                    )
+                logits_host = np.asarray(logits, np.float64)
+                next_host = np.asarray(next_tokens).copy()
+                for slot in decoding:
+                    next_host[slot] = self._sample(logits_host[slot])
+            else:
+                self.pool, next_tokens, new_pos = self._decode_fn(
+                    self.params, self.pool, jnp.asarray(self.tables),
+                    jnp.asarray(self.last), jnp.asarray(self.pos),
+                    jnp.asarray(active),
+                )
+                next_host = np.asarray(next_tokens)
             self.pos = np.array(new_pos)  # writable host copy
             for slot in decoding:
                 st = self._requests[slot]
@@ -382,12 +590,96 @@ class SlotEngine:
                 self.last[slot] = tok
                 emitted[slot] = emitted.get(slot, 0) + 1
                 if len(st["out"]) >= st["budget"]:
-                    self.active[slot] = False
-                    finished[slot] = list(st["out"])
+                    self._finish(slot, st, finished)
         if not emitted and not prefilling:
             return None
         self.steps += 1
         return StepResult(dt=0.0, emitted=emitted, finished=finished)
+
+    def _spec_round(self) -> dict:
+        """One drafter-propose / target-verify round for every active
+        slot: `spec_k` drafter decode dispatches propose a draft, ONE
+        target dispatch scores all `spec_k + 1` positions through the
+        page tables, and exact rejection sampling on the host decides
+        how much of each slot's draft survives. Returns slot ->
+        emitted tokens (accepted drafts + exactly one target token).
+
+        The drafter runs on a SHADOW of the host decode state
+        (last/pos copies): a reject must leave the real state exactly
+        where the last accepted token put it, and the next round's
+        dispatches re-write every position they touch before attending
+        it — rollback is pointer arithmetic, not data movement."""
+        jnp = self._jnp
+        k = self.spec_k
+        active = self.active.copy()
+        idx = np.nonzero(active)[0]
+        catchup = self._catchup_need & active
+        if catchup.any():
+            # backfill the drafter's KV hole from the last all-accept
+            # round: embed the final accepted draft at its position
+            # (write-only — the proposal logits are discarded); masked,
+            # so slots without a hole park on the trash page
+            self.draft_pool, _, _, _ = self._draft_decode_fn(
+                self.draft_params, self.draft_pool,
+                jnp.asarray(self.tables),
+                jnp.asarray(self._catchup_tok),
+                jnp.asarray(self._catchup_pos), jnp.asarray(catchup),
+            )
+            self._catchup_need &= ~catchup
+        window = np.zeros((self.slots, k + 1), np.int32)
+        window[:, 0] = self.last
+        draft_tokens = np.zeros((self.slots, k), np.int32)
+        draft_logits = None  # (S, k, V) lazily shaped from the first step
+        d_last = self.last.copy()
+        d_pos = self.pos.copy()
+        for i in range(k):
+            self.draft_pool, toks, logits, d_pos_new = \
+                self._draft_decode_fn(
+                    self.draft_params, self.draft_pool,
+                    jnp.asarray(self.tables), jnp.asarray(d_last),
+                    jnp.asarray(d_pos), jnp.asarray(active),
+                )
+            logits_host = np.asarray(logits, np.float64)
+            if draft_logits is None:
+                draft_logits = np.zeros(
+                    (self.slots, k, logits_host.shape[-1]), np.float64)
+            draft_logits[:, i] = logits_host
+            if self.temperature > 0:
+                # sampled mode proposes BY SAMPLING the drafter (the
+                # rejection rule's q must be the proposal law)
+                toks_host = np.asarray(toks).copy()
+                for slot in idx:
+                    toks_host[slot] = self._sample(logits_host[slot])
+            else:
+                toks_host = np.asarray(toks)
+            draft_tokens[:, i] = toks_host
+            window[:, i + 1] = toks_host
+            d_last = toks_host
+            d_pos = np.asarray(d_pos_new)
+        self.pool, v_logits = self._verify_fn(
+            self.params, self.pool, jnp.asarray(self.tables),
+            jnp.asarray(window), jnp.asarray(self.pos),
+            jnp.asarray(active),
+        )
+        v_host = np.asarray(v_logits, np.float64)  # (S, k+1, V)
+        out: dict = {}
+        self.spec_rounds += 1
+        for slot in idx:
+            accepted, toks = self._dec.speculative_accept(
+                draft_tokens[slot], draft_logits[slot], v_host[slot],
+                self.temperature, self._rng,
+            )
+            self.spec_drafted += k
+            self.spec_accepted += accepted
+            self.spec_rolled_back += k - accepted
+            if accepted >= k:
+                # all accepted: d_k was proposed but never embedded —
+                # mark its position for next round's backfill dispatch
+                self._catchup_need[slot] = True
+                self._catchup_tok[slot] = draft_tokens[slot, k - 1]
+                self._catchup_pos[slot] = self.pos[slot] + k
+            out[int(slot)] = toks
+        return out
 
 
 # --------------------------------------------------- compiled step bodies
@@ -503,7 +795,8 @@ def _prefill_chunk_paged(model, params, pool, tokens, table, start,
 
 
 def _decode_step_paged(model, params, pool, tables, last, pos, active,
-                       page_size, max_pages, trash, int8):
+                       page_size, max_pages, trash, int8,
+                       with_logits=False):
     """One greedy decode token for every slot at once, with PER-SLOT
     positions AND page tables: slot s embeds its last token at pos[s],
     scatters K/V into page tables[s, pos[s] // page_size], gathers its
@@ -611,4 +904,125 @@ def _decode_step_paged(model, params, pool, tables, last, pos, active,
     logits = dec._head(params, x, model)[:, 0]  # (S, vocab)
     next_tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     new_pos = pos + active.astype(jnp.int32)
+    if with_logits:
+        # the drafter/sampled variants ship logits to the host (the
+        # rejection sampler and the temperature draw both live there);
+        # the greedy hot path keeps the token-sized transfer
+        return new_pool, next_tokens, logits, new_pos
     return new_pool, next_tokens, new_pos
+
+
+def _verify_window_paged(model, params, pool, tables, window, pos,
+                         active, win, page_size, max_pages, trash,
+                         int8):
+    """Score a `win`-token window for EVERY slot in one dispatch: slot
+    s's window holds [last, d_1, .., d_{win-1}] at logical positions
+    [pos[s], pos[s]+win) — the target-verify half of speculative
+    decoding. `_decode_step_paged` generalized from one query to a
+    static window of queries: K/V scatters into the slot's pages at
+    the window's positions, attention gathers the slot's logical view
+    back through the table, and query i attends positions <= pos+i.
+
+    Bit-equivalence with sequential decode is the design constraint:
+    like the decode step (and UNLIKE the prefill chunk, whose own-chunk
+    trick mirrors dense prefill), the window's own K/V is read BACK
+    from the pool — bf16-rounded, int8-quantized — because that is
+    exactly what `win` consecutive decode steps would have attended.
+    Inactive rows park every write on the trash page; rows whose
+    window would cross the table's end clamp onto the sentinel row
+    (trash) — rejected or over-budget positions are garbage by
+    construction and every later dispatch re-writes its own positions
+    before attending them."""
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+
+    from tritonk8ssupervisor_tpu.models import decode as dec
+
+    slots = window.shape[0]
+    head_dim = model.embed_dim // model.num_heads
+    length = max_pages * page_size
+    emb = params["tok_embed"]["embedding"]
+    x = jnp.take(emb, window, axis=0).astype(model.dtype)  # (S, W, E)
+    pos_idx = pos[:, None] + jnp.arange(win)[None, :]  # (S, W)
+    # jnp.take clips out-of-range position-embedding reads (the window
+    # tail past max_seq_len belongs to over-budget candidates whose
+    # emissions the host truncates anyway) — same mode the decode step
+    # relies on
+    x = x + jnp.take(params["pos_embed"], pos_idx, axis=0).astype(
+        model.dtype
+    )
+    logical = jnp.arange(length)
+    valid = logical[None, None, :] <= pos_idx[:, :, None]  # (S, W, L)
+    g_page = tables[:, logical // page_size]  # (S, L)
+    g_off = logical % page_size  # (L,) broadcast against g_page
+    own = jnp.take_along_axis(
+        tables, jnp.minimum(pos_idx // page_size, max_pages), axis=1
+    )  # (S, W)
+    w_page = jnp.where(active[:, None], own, trash)
+    w_off = jnp.where(active[:, None], pos_idx % page_size, 0)
+    new_pool = dict(pool)
+    for i in range(model.num_layers):
+        name = f"Block_{i}"
+        bp = params[name]
+        y = dec._ln(bp["LayerNorm_0"], x, model.dtype)
+        qkv = dec._dense(bp["qkv"], y, 3 * model.embed_dim, model.dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(slots, win, model.num_heads, head_dim)
+        k = k.reshape(slots, win, model.num_heads, head_dim)
+        v = v.reshape(slots, win, model.num_heads, head_dim)
+        layer = new_pool[name]
+        if int8:
+            kq, ks = dec._quant_kv(k)  # (S, W, H, D), (S, W, H)
+            vq, vs_ = dec._quant_kv(v)
+            new_k = layer["k"].at[w_page, w_off].set(kq)
+            new_v = layer["v"].at[w_page, w_off].set(vq)
+            k_scale = layer["k_scale"].at[w_page, w_off].set(ks)
+            v_scale = layer["v_scale"].at[w_page, w_off].set(vs_)
+            new_pool[name] = {"k": new_k, "v": new_v,
+                              "k_scale": k_scale, "v_scale": v_scale}
+            keys = new_k[g_page, g_off]  # (S, L, H, D)
+            vals = new_v[g_page, g_off]
+            ksc = k_scale[g_page, g_off]  # (S, L, H)
+            vsc = v_scale[g_page, g_off]
+            scores = jnp.einsum(
+                "bqhd,bkhd->bhqk", q, keys.astype(q.dtype)
+            ) / jnp.sqrt(head_dim).astype(q.dtype)
+            scores = scores * ksc.transpose(0, 2, 1)[
+                :, :, None, :].astype(scores.dtype)
+            scores = jnp.where(valid[:, None], scores, dec.NEG_INF)
+            probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+            probs = probs * vsc.transpose(0, 2, 1)[
+                :, :, None, :].astype(probs.dtype)
+            attn = jnp.einsum(
+                "bhqk,bkhd->bqhd",
+                probs.astype(model.dtype), vals.astype(model.dtype),
+            )
+        else:
+            new_k = layer["k"].at[w_page, w_off].set(
+                k.astype(jnp.bfloat16))
+            new_v = layer["v"].at[w_page, w_off].set(
+                v.astype(jnp.bfloat16))
+            new_pool[name] = {"k": new_k, "v": new_v}
+            keys = new_k[g_page, g_off]  # (S, L, H, D)
+            vals = new_v[g_page, g_off]
+            scores = jnp.einsum(
+                "bqhd,bkhd->bhqk", q, keys.astype(q.dtype)
+            ) / jnp.sqrt(head_dim).astype(q.dtype)
+            scores = jnp.where(valid[:, None], scores, dec.NEG_INF)
+            probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+            attn = jnp.einsum(
+                "bhqk,bkhd->bqhd",
+                probs.astype(model.dtype), vals.astype(model.dtype),
+            )
+        x = x + dec._dense(
+            bp["proj"], attn.reshape(slots, win, model.embed_dim),
+            model.embed_dim, model.dtype,
+        )
+        y = dec._ln(bp["LayerNorm_1"], x, model.dtype)
+        y = dec._dense(bp["mlp_up"], y, model.mlp_ratio * model.embed_dim,
+                       model.dtype)
+        y = nn.gelu(y)
+        x = x + dec._dense(bp["mlp_down"], y, model.embed_dim, model.dtype)
+    logits = dec._head(params, x, model)  # (S, W, vocab)
+    return new_pool, logits

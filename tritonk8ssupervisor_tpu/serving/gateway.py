@@ -76,6 +76,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import random
 import time
 from collections import deque
 from pathlib import Path
@@ -236,6 +237,16 @@ class GatewayPolicy:
     pages_per_slice: int | None = None
     # cross-request prefix/KV reuse (the shared-system-prompt lever)
     prefix_cache: bool = True
+    # speculative decoding (docs/performance.md "Engine hot path"):
+    # drafter proposals verified per round — 0 disables (the plain
+    # one-token-per-step decode, byte-identical to pre-spec). The real
+    # engine takes the draft model from the CLI (`./setup.sh serve
+    # --draft-model`); the MODELED engine mirrors the token accounting
+    # with seeded per-request acceptance draws at `spec_acceptance`,
+    # so SimClock drills and the autoscale/allocator cost models see
+    # speculative throughput without running a drafter
+    spec_k: int = 0
+    spec_acceptance: float = 0.85
     # long-running-server bound on the in-memory audit trails
     # (GatewayMetrics.depth_samples and the shed/expiry/admission audit
     # lists): past this many entries the oldest are evicted in
@@ -294,6 +305,14 @@ class DecodeCostModel:
     prefill_fixed_s: float = 0.004
     prefill_per_token_s: float = 0.0001
     chips_per_slice: int = 4
+    # speculative decoding: one drafter decode dispatch (the drafter
+    # re-reads ITS weights — a fraction of the target's fixed cost
+    # because the model is a fraction of the size) plus a per-slot
+    # cache read; the verify dispatch is costed as one target decode
+    # step (same weight read, same cache gather — the window adds
+    # queries, not bandwidth, which is what makes speculation pay)
+    draft_fixed_s: float = 0.008
+    draft_per_slot_s: float = 0.0002
 
 
 class ModeledEngine:
@@ -314,7 +333,9 @@ class ModeledEngine:
                  cost: DecodeCostModel | None = None,
                  page_size: int = 16,
                  num_pages: int | None = None,
-                 prefix_cache: bool = True) -> None:
+                 prefix_cache: bool = True,
+                 spec_k: int = 0,
+                 spec_acceptance: float = 0.85) -> None:
         self.slots = int(slots)
         self.prefill_chunk = max(1, int(prefill_chunk))
         self.cost = cost or DecodeCostModel()
@@ -323,6 +344,18 @@ class ModeledEngine:
         self.pages = kvpool.PagePool(self.num_pages, self.page_size)
         self.prefix = (kvpool.PrefixStore(self.pages)
                        if prefix_cache else None)
+        # speculative-decoding twin: the cost model charges k drafter
+        # dispatches + one verify-shaped target dispatch per round, and
+        # each request draws its acceptance lengths from its OWN seeded
+        # stream (rid-keyed) — deterministic per scenario, independent
+        # of slot placement, so A/B drives compare like with like
+        self.spec_k = max(0, int(spec_k))
+        self.spec = self.spec_k >= 1
+        self.spec_acceptance = min(1.0, max(0.0, float(spec_acceptance)))
+        self.spec_rounds = 0
+        self.spec_drafted = 0
+        self.spec_accepted = 0
+        self.spec_rolled_back = 0
         self._slots: dict = {}  # slot -> {prefill_left, budget, generated}
         self._prefill_rr = 0  # round-robin pointer over prefilling slots
         self.joins = 0
@@ -353,7 +386,11 @@ class ModeledEngine:
         suffix = max(1, prompt_len - start0)
         prefill_end = start0 + -(-suffix // self.prefill_chunk) \
             * self.prefill_chunk
-        span = max(prefill_end, prompt_len + max_new)
+        # the speculative page window mirrors the real engine: a verify
+        # dispatch may write spec_k positions past the last accepted
+        # token, and admission accounts the pages they land on
+        reach = prompt_len + max_new + (self.spec_k if self.spec else 0)
+        span = max(prefill_end, reach)
         return -(-span // self.page_size)
 
     def _alloc(self, need: int) -> list | None:
@@ -398,11 +435,17 @@ class ModeledEngine:
         self._slots[slot] = {
             "prefill_left": int(request.prompt_len)
             - shared_n * self.page_size,
+            "prompt_len": int(request.prompt_len),
             "budget": int(request.max_new_tokens),
             "generated": 0,
             "keys": keys,
             "pages": list(shared_pages) + list(private),
             "registered": shared_n >= len(keys),
+            # seeded per-request acceptance draws: the request's rid is
+            # the seed, so the SAME request accepts the same lengths no
+            # matter which slot or slice serves it
+            "spec_rng": (random.Random(0x5BD1E995 ^ int(request.rid))
+                         if self.spec else None),
         }
         self.joins += 1
         self.peak_slots_busy = max(self.peak_slots_busy, len(self._slots))
@@ -420,12 +463,14 @@ class ModeledEngine:
 
     def stats(self) -> dict:
         in_use = self.pages.pages_in_use
+        pages_free = (self.pages.pages_free
+                      if self.num_pages is not None else None)
         return {
             "page_size": self.page_size,
             "pages_total": self.num_pages,
             "pages_in_use": in_use,
-            "pages_free": (self.pages.pages_free
-                           if self.num_pages is not None else None),
+            "pages_free": pages_free,
+            "kv_pages_free": pages_free,
             "kv_utilization": (round(in_use / self.num_pages, 4)
                                if self.num_pages else None),
             "peak_pages_in_use": self.pages.peak_in_use,
@@ -436,6 +481,16 @@ class ModeledEngine:
             "cache_int8": False,
             "prefix": (self.prefix.stats() if self.prefix is not None
                        else None),
+            "spec": ({
+                "spec_k": self.spec_k,
+                "rounds": self.spec_rounds,
+                "drafted": self.spec_drafted,
+                "accepted": self.spec_accepted,
+                "rolled_back": self.spec_rolled_back,
+                "acceptance_rate": (round(self.spec_accepted
+                                          / self.spec_drafted, 4)
+                                    if self.spec_drafted else None),
+            } if self.spec else None),
         }
 
     def step(self) -> StepResult | None:
@@ -473,7 +528,42 @@ class ModeledEngine:
                 emitted[slot] = 1
                 if st["generated"] >= st["budget"]:
                     finished[slot] = None
-        if decoding:
+        if decoding and self.spec:
+            # one speculative round: k drafter dispatches over the
+            # batch + one verify-shaped target dispatch; every decoding
+            # slot emits its accepted run + one target token (clamped
+            # to budget), drawn from the request's seeded stream —
+            # exactly the real engine's accounting, minus the drafter
+            dt += (self.cost.decode_fixed_s
+                   + len(decoding) * self.cost.decode_per_slot_s
+                   + self.spec_k * (self.cost.draft_fixed_s
+                                    + len(decoding)
+                                    * self.cost.draft_per_slot_s))
+            self.spec_rounds += 1
+            for slot in decoding:
+                st = self._slots[slot]
+                accepted = 0
+                while (accepted < self.spec_k
+                       and st["spec_rng"].random()
+                       < self.spec_acceptance):
+                    accepted += 1
+                self.spec_drafted += self.spec_k
+                self.spec_accepted += accepted
+                self.spec_rolled_back += self.spec_k - accepted
+                take = min(accepted + 1,
+                           st["budget"] - st["generated"])
+                st["generated"] += take
+                emitted[slot] = emitted.get(slot, 0) + take
+                if st["generated"] >= st["budget"]:
+                    # the speculative page-window overhang frees the
+                    # moment the budget fills (kvpool.release_span:
+                    # decrements exactly the truncated tail)
+                    need = -(-(st["prompt_len"] + st["budget"])
+                             // self.page_size)
+                    if len(st["pages"]) > need:
+                        self.pages.release_span(st["pages"], need)
+                    finished[slot] = None
+        elif decoding:
             dt += (self.cost.decode_fixed_s
                    + len(decoding) * self.cost.decode_per_slot_s)
             for slot in decoding:
@@ -722,6 +812,25 @@ class Gateway:
         self._g_pages_peak = reg.gauge(
             "serving_kv_pages_in_use_peak",
             "sum of per-engine peak pages in use")
+        self._g_pages_free = reg.gauge(
+            "serving_kv_pages_free",
+            "KV page-pool headroom across bounded pools (the demand "
+            "signal distinct from slot headroom)")
+        # speculative decoding (engines report cumulative counts; the
+        # gauges mirror them at scrape/snapshot time like occupancy)
+        self._g_spec_drafted = reg.gauge(
+            "serving_spec_drafted_tokens",
+            "drafter proposals offered to target verify")
+        self._g_spec_accepted = reg.gauge(
+            "serving_spec_accepted_tokens",
+            "drafter proposals that survived exact rejection sampling")
+        self._g_spec_rolled_back = reg.gauge(
+            "serving_spec_rolled_back_tokens",
+            "drafter proposals truncated by a reject (paged-KV "
+            "rollback)")
+        self._g_spec_acceptance = reg.gauge(
+            "serving_spec_acceptance_rate",
+            "accepted / drafted over the engines' lifetime")
         self.workers = {
             int(i): SliceWorker(int(i), engine, self)
             for i, engine in engines.items()
@@ -835,6 +944,7 @@ class Gateway:
         if self.policy.default_deadline_s is not None and wait is not None:
             headroom = round(float(self.policy.default_deadline_s)
                              - wait, 3)
+        engine = self.engine_report()
         doc = {
             "v": 1,
             "updated": now,
@@ -843,6 +953,11 @@ class Gateway:
             "p99_s": self.recent_p99(),
             "recent_sheds": recent_sheds,
             "deadline_headroom_s": headroom,
+            # page-pool headroom as demand evidence: a fleet can have
+            # free SLOTS and no free PAGES (long prompts, fat budgets)
+            # — slot-only signals would under-report that pressure
+            "kv_pages_free": (engine.get("kv_pages_free")
+                              if engine is not None else None),
             "inflight": {
                 str(i): len(w.inflight)
                 for i, w in sorted(self.workers.items())
@@ -1587,6 +1702,10 @@ class Gateway:
                    if s["pages_total"] is not None]
         pages_total = sum(bounded) if len(bounded) == len(stats) else None
         pages_in_use = sum(s["pages_in_use"] for s in stats)
+        # page-pool headroom (bounded pools only): the demand-signal /
+        # autoscaler evidence that is DISTINCT from slot headroom
+        kv_pages_free = (pages_total - pages_in_use
+                         if pages_total is not None else None)
         prefix_stats = [s["prefix"] for s in stats
                         if s["prefix"] is not None]
         prefix = None
@@ -1599,9 +1718,24 @@ class Gateway:
             asked = prefix["hits"] + prefix["misses"]
             prefix["hit_rate"] = (round(prefix["hits"] / asked, 4)
                                   if asked else None)
+        spec_stats = [s.get("spec") for s in stats
+                      if s.get("spec") is not None]
+        spec = None
+        if spec_stats:
+            spec = {
+                key: sum(p[key] for p in spec_stats)
+                for key in ("rounds", "drafted", "accepted",
+                            "rolled_back")
+            }
+            spec["spec_k"] = max(p["spec_k"] for p in spec_stats)
+            spec["acceptance_rate"] = (
+                round(spec["accepted"] / spec["drafted"], 4)
+                if spec["drafted"] else None
+            )
         return {
             "pages_in_use": pages_in_use,
             "pages_total": pages_total,
+            "kv_pages_free": kv_pages_free,
             "kv_utilization": (round(pages_in_use / pages_total, 4)
                                if pages_total else None),
             "peak_pages_in_use": sum(s["peak_pages_in_use"]
@@ -1609,6 +1743,7 @@ class Gateway:
             "peak_slots_busy": max(s["peak_slots_busy"] for s in stats),
             "prefill_tokens": sum(s["prefill_tokens"] for s in stats),
             "prefix": prefix,
+            "spec": spec,
             "per_slice": per_slice,
         }
 
@@ -1633,6 +1768,15 @@ class Gateway:
             self._g_pages_peak.set(engine["peak_pages_in_use"])
             if engine["pages_total"] is not None:
                 self._g_pages_total.set(engine["pages_total"])
+            if engine["kv_pages_free"] is not None:
+                self._g_pages_free.set(engine["kv_pages_free"])
+            spec = engine.get("spec")
+            if spec is not None:
+                self._g_spec_drafted.set(spec["drafted"])
+                self._g_spec_accepted.set(spec["accepted"])
+                self._g_spec_rolled_back.set(spec["rolled_back"])
+                if spec["acceptance_rate"] is not None:
+                    self._g_spec_acceptance.set(spec["acceptance_rate"])
 
     def report(self) -> dict:
         """The machine-readable serving summary (the drill/bench

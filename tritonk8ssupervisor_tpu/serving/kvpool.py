@@ -149,6 +149,23 @@ class PagePool:
                 freed += 1
         return freed
 
+    def release_span(self, table, from_page: int) -> int:
+        """The rollback primitive: unref EXACTLY the pages at indices
+        >= `from_page` of a slot's page list, truncating the list in
+        place so a later whole-slot `release` cannot double-unref them.
+        A speculative reject (or an early finish inside a speculative
+        window) shrinks the slot's logical span; the pages past the
+        truncation point are unreachable for THIS slot but may live on
+        under other holders (a shared prefix, the store) — refcounts,
+        not ownership, decide what actually frees. Returns pages
+        returned to the free list (refcount-conservation is pinned in
+        tests/test_kvpool.py)."""
+        from_page = max(0, int(from_page))
+        tail = list(table[from_page:])
+        freed = self.unref(tail)
+        del table[from_page:]
+        return freed
+
     def refcount(self, page: int) -> int:
         return self._refs.get(page, 0)
 

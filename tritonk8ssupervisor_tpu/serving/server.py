@@ -260,10 +260,13 @@ def serve_http(gateway: Gateway, host: str, port: int,
     server = ThreadingHTTPServer((host, port),
                                  make_handler(gateway, lock, loop=loop))
     loop.start()
+    engine = gateway.engine_report()
+    spec = (engine or {}).get("spec")
     echo(f"[serve] listening on http://{host}:{server.server_address[1]} "
          f"({len(gateway.workers)} slice worker(s), "
-         f"{gateway.policy.slots_per_slice} slots each); "
-         "POST /generate, GET /healthz; Ctrl-C to stop")
+         f"{gateway.policy.slots_per_slice} slots each"
+         + (f", speculative k={spec['spec_k']}" if spec else "")
+         + "); POST /generate, GET /healthz; Ctrl-C to stop")
     try:
         server.serve_forever(poll_interval=0.2)
     except KeyboardInterrupt:
