@@ -1,0 +1,460 @@
+"""Gateway fleet (serving/fleet.py): the sharded request plane's
+units and edges — stable key-partition routing, the slice-lease state
+machine at its boundaries (tick-boundary expiry, revoke racing a
+dispatch, crash mid-RENEW), the N-journal merge fold, the fleet demand
+fold's staleness guards, the per-replica artifact paths and their
+teardown scrub, the fleet control loop (grant/kill/reassign/revive),
+the tier-1 few-seed fleet-chaos smoke, the kill acceptance drill, and
+the committed BENCH_fleet.json structural check."""
+
+import io
+import json
+import zlib
+
+import pytest
+
+from tritonk8ssupervisor_tpu.cli.io import Prompter
+from tritonk8ssupervisor_tpu.config.schema import ClusterConfig
+from tritonk8ssupervisor_tpu.provision import autoscale
+from tritonk8ssupervisor_tpu.provision import events as ev
+from tritonk8ssupervisor_tpu.provision import state, teardown
+from tritonk8ssupervisor_tpu.serving import fleet as fleet_mod
+from tritonk8ssupervisor_tpu.serving import gateway as gw_mod
+from tritonk8ssupervisor_tpu.serving import reqlog
+from tritonk8ssupervisor_tpu.testing import chaos
+
+
+def ledger(tmp_path, name="events.jsonl"):
+    return ev.EventLedger(tmp_path / name, clock=lambda: 0.0,
+                          echo=lambda line: None, fsync=False)
+
+
+# ------------------------------------------------------- partition routing
+
+
+def test_partition_of_pins_crc32_mapping():
+    """The key->partition map must be crc32 (pinned values), never
+    hash(): it has to survive PYTHONHASHSEED and process restarts, or
+    a restarted fleet would route duplicates to a replica that never
+    journaled the original."""
+    assert fleet_mod.partition_of("sess:conv-1", 32) == 26
+    assert fleet_mod.partition_of("key:fkill-17", 32) == 5
+    assert fleet_mod.partition_of("rid:42", 32) == 16
+    for key in ("a", "bb", "sess:x", "key:y"):
+        assert (fleet_mod.partition_of(key, 32)
+                == zlib.crc32(key.encode()) % 32)
+        assert fleet_mod.partition_of(key, 1) == 0  # clamp, no div-zero
+
+
+def test_route_key_prefers_session_then_key_then_rid():
+    both = gw_mod.Request(rid=7, prompt_len=8, max_new_tokens=4,
+                          key="k1", session_id="c9")
+    keyed = gw_mod.Request(rid=7, prompt_len=8, max_new_tokens=4,
+                           key="k1")
+    bare = gw_mod.Request(rid=7, prompt_len=8, max_new_tokens=4)
+    assert fleet_mod.route_key(both) == "sess:c9"  # KV affinity wins
+    assert fleet_mod.route_key(keyed) == "key:k1"
+    assert fleet_mod.route_key(bare) == "rid:7"
+
+
+# ------------------------------------------------- slice leases (the edges)
+
+
+def test_lease_dead_at_exact_expiry_boundary(tmp_path):
+    """Tick-boundary expiry: a lease granted until T is DEAD at
+    exactly T — the dispatch fence and a sweep at the same instant
+    must agree, so there is no instant where the old holder can still
+    pull while the sweep re-grants."""
+    leases = fleet_mod.SliceLeases(ledger(tmp_path))
+    leases.grant(3, "g0", now=100.0, ttl_s=30.0)
+    assert leases.live(3, 129.999) is not None
+    assert leases.check(3, "g0", 129.999) == 1
+    assert leases.live(3, 130.0) is None  # inclusive boundary
+    assert leases.check(3, "g0", 130.0) is None
+    swept = leases.sweep(130.0)
+    assert [index for index, _ in swept] == [3]
+    kinds = [r["kind"] for r in leases.ledger.replay()]
+    assert kinds == [ev.LEASE_GRANT, ev.LEASE_EXPIRE]
+
+
+def test_revoke_races_dispatch_fence_refuses(tmp_path):
+    """Revoke racing a dispatch: after the revoke lands, the old
+    holder's fenced claim gets None even though its own clock still
+    thinks the lease is live — the epoch dies with the revoke."""
+    leases = fleet_mod.SliceLeases(ledger(tmp_path))
+    leases.grant(0, "g1", now=0.0, ttl_s=30.0)
+    assert leases.check(0, "g1", 10.0) == 1
+    gone = leases.revoke(0, 10.0, reason="rebalance")
+    assert gone["replica"] == "g1"
+    assert leases.check(0, "g1", 10.1) is None  # well before expires_at
+    last = leases.ledger.replay()[-1]
+    assert last["kind"] == ev.LEASE_REVOKE
+    assert last["reason"] == "rebalance"
+
+
+def test_grant_refuses_live_lease_but_regrants_at_expiry(tmp_path):
+    """A live lease can never be silently overlapped (LeaseHeld); a
+    re-grant AT the expiry instant is legal (the old lease is already
+    dead there) and closes the lapsed lease on the ledger first."""
+    leases = fleet_mod.SliceLeases(ledger(tmp_path))
+    leases.grant(1, "g0", now=0.0, ttl_s=30.0)
+    with pytest.raises(fleet_mod.LeaseHeld, match="slice 1"):
+        leases.grant(1, "g1", now=10.0, ttl_s=30.0)
+    entry = leases.grant(1, "g1", now=30.0, ttl_s=30.0)
+    assert entry["epoch"] == 2  # fresh fence, never the dead holder's
+    kinds = [r["kind"] for r in leases.ledger.replay()]
+    assert kinds == [ev.LEASE_GRANT, ev.LEASE_EXPIRE, ev.LEASE_GRANT]
+
+
+def test_renew_only_extends_the_live_holders_lease(tmp_path):
+    leases = fleet_mod.SliceLeases(ledger(tmp_path))
+    leases.grant(2, "g0", now=0.0, ttl_s=30.0)
+    assert leases.renew(2, "g1", 5.0, 30.0) is None  # peer: refused
+    renewed = leases.renew(2, "g0", 25.0, 30.0)
+    assert renewed["epoch"] == 1  # same epoch, later expiry
+    assert renewed["expires_at"] == 55.0
+    assert leases.renew(2, "g0", 55.0, 30.0) is None  # lapsed: too late
+    kinds = [r["kind"] for r in leases.ledger.replay()]
+    assert kinds == [ev.LEASE_GRANT, ev.LEASE_RENEW]
+
+
+def test_restore_after_crash_mid_renew_no_double_grant(tmp_path):
+    """Kill-mid-RENEW: whichever side of the renew the crash landed
+    on, the folded ledger restores to exactly ONE live lease with the
+    same epoch — never a double grant, never a lost fence."""
+    # arm A: the renew landed before the crash
+    landed = fleet_mod.SliceLeases(ledger(tmp_path, "a.jsonl"))
+    landed.grant(0, "g0", now=0.0, ttl_s=30.0)
+    landed.renew(0, "g0", 25.0, 30.0)
+    resumed = fleet_mod.SliceLeases(landed.ledger)
+    resumed.restore(ev.fold(landed.ledger.replay()))
+    assert resumed.epoch == 1
+    assert list(resumed.table) == [0]
+    assert resumed.table[0]["expires_at"] == 55.0  # the renewed expiry
+    assert resumed.check(0, "g0", 40.0) == 1
+    # arm B: the crash beat the renew — same epoch, original expiry
+    lost = fleet_mod.SliceLeases(ledger(tmp_path, "b.jsonl"))
+    lost.grant(0, "g0", now=0.0, ttl_s=30.0)
+    resumed_b = fleet_mod.SliceLeases(lost.ledger)
+    resumed_b.restore(ev.fold(lost.ledger.replay()))
+    assert resumed_b.epoch == 1
+    assert resumed_b.table[0]["expires_at"] == 30.0
+    assert resumed_b.live(0, 40.0) is None  # lapsed: re-grant, no overlap
+
+
+def test_restore_epoch_high_water_never_reuses_a_dead_fence(tmp_path):
+    """The restored epoch is the max ever GRANTED — a post-crash
+    re-grant must mint a fence strictly above every fence any dead
+    holder could still present."""
+    leases = fleet_mod.SliceLeases(ledger(tmp_path))
+    leases.grant(0, "g0", now=0.0, ttl_s=30.0)
+    leases.revoke(0, 10.0, reason="replica-dead")
+    leases.grant(0, "g1", now=10.0, ttl_s=30.0)  # epoch 2
+    resumed = fleet_mod.SliceLeases(leases.ledger)
+    resumed.restore(ev.fold(leases.ledger.replay()))
+    assert resumed.epoch == 2  # high-water survives the revoke
+    fresh = resumed.grant(1, "g0", now=50.0, ttl_s=30.0)
+    assert fresh["epoch"] == 3
+
+
+# --------------------------------------------------- N-journal merge fold
+
+
+def test_merge_records_restores_global_time_order_stably():
+    a = [{"ts": 1.0, "kind": reqlog.ACCEPTED, "key": "k1"},
+         {"ts": 5.0, "kind": reqlog.COMPLETED, "key": "k1"}]
+    b = [{"ts": 2.0, "kind": reqlog.ACCEPTED, "key": "k2"},
+         {"ts": 5.0, "kind": reqlog.COMPLETED, "key": "k2"}]
+    merged = reqlog.merge_records(a, b)
+    assert [r["ts"] for r in merged] == [1.0, 2.0, 5.0, 5.0]
+    # ties keep journal order: a's record before b's at ts=5.0
+    assert [r["key"] for r in merged] == ["k1", "k2", "k1", "k2"]
+
+
+def test_merged_fold_conserves_a_key_adopted_across_shards():
+    """Adoption splits one key's history across two journal shards
+    (victim accepted+dispatched, successor requeued+completed); the
+    merged fold must still read as ONE conserved, settled key."""
+    victim = [
+        {"ts": 1.0, "kind": reqlog.ACCEPTED, "key": "k", "rid": 1,
+         "prompt_len": 8, "max_new_tokens": 4},
+        {"ts": 2.0, "kind": reqlog.DISPATCHED, "key": "k"},
+    ]
+    successor = [
+        {"ts": 5.0, "kind": reqlog.REQUEUED, "key": "k"},
+        {"ts": 6.0, "kind": reqlog.DISPATCHED, "key": "k"},
+        {"ts": 7.0, "kind": reqlog.COMPLETED, "key": "k",
+         "result": {"tokens": 4}},
+    ]
+    view = reqlog.fold(reqlog.merge_records(victim, successor))
+    kv = view.keys["k"]
+    assert kv.state == "completed"
+    assert kv.accepts == 1  # adoption never re-accepts
+    assert kv.requeues == 1 and kv.completions == 1
+    assert view.incomplete() == []
+
+
+# ------------------------------------------------------- fleet demand fold
+
+
+def sig(**overrides):
+    base = dict(updated=100.0, queue_depth=2, service_rate=1.0,
+                p99_s=3.0, recent_sheds=0, deadline_headroom_s=20.0,
+                inflight={0: 1}, active_workers=(0,), kv_pages_free=10)
+    base.update(overrides)
+    return autoscale.DemandSignal(**base)
+
+
+def test_merge_demand_signals_sums_demand_and_takes_worst_pain():
+    merged = autoscale.merge_demand_signals({
+        "g0": sig(updated=100.0, queue_depth=2, service_rate=1.5,
+                  p99_s=3.0, recent_sheds=1, deadline_headroom_s=20.0,
+                  inflight={0: 1, 1: 2}, active_workers=(0, 1),
+                  kv_pages_free=10),
+        "g1": sig(updated=90.0, queue_depth=5, service_rate=2.5,
+                  p99_s=7.0, recent_sheds=2, deadline_headroom_s=4.0,
+                  inflight={1: 1, 2: 3}, active_workers=(2,),
+                  kv_pages_free=6),
+    })
+    assert merged.queue_depth == 7  # demand sums (disjoint pools)
+    assert merged.service_rate == 4.0
+    assert merged.recent_sheds == 3
+    assert merged.kv_pages_free == 16
+    assert merged.p99_s == 7.0  # pain takes the worst case
+    assert merged.deadline_headroom_s == 4.0
+    assert merged.inflight == {0: 1, 1: 3, 2: 3}
+    assert merged.active_workers == (0, 1, 2)
+    assert merged.updated == 90.0  # only as fresh as the stalest member
+
+
+def test_merge_demand_signals_drops_stale_members_not_the_fold():
+    """One dead replica's week-old 'queue is empty' must neither
+    freeze the merged view stale nor dilute live pressure — the stale
+    member is dropped, the fresh ones merge."""
+    merged = autoscale.merge_demand_signals(
+        {"g0": sig(updated=50.0, queue_depth=100),  # pre-incident ghost
+         "g1": sig(updated=150.0, queue_depth=3),
+         "g2": None},  # torn/absent shard: not evidence
+        now=200.0, max_age=90.0,
+    )
+    assert merged.queue_depth == 3
+    assert merged.updated == 150.0
+    assert autoscale.merge_demand_signals(
+        {"g0": sig(updated=50.0)}, now=200.0, max_age=90.0) is None
+    assert autoscale.merge_demand_signals({"g0": None}) is None
+
+
+def test_read_fleet_demand_folds_shards_else_single_gateway(tmp_path):
+    base = tmp_path / "demand-signal.json"
+    base.write_text(json.dumps({"updated": 10.0, "queue_depth": 9}))
+    # no shards: byte-identical to the single-gateway read
+    alone = autoscale.read_fleet_demand(base)
+    assert alone.queue_depth == 9
+    (tmp_path / "demand-signal-g0.json").write_text(
+        json.dumps({"updated": 100.0, "queue_depth": 2}))
+    (tmp_path / "demand-signal-g1.json").write_text(
+        json.dumps({"updated": 150.0, "queue_depth": 4}))
+    merged = autoscale.read_fleet_demand(base)
+    assert merged.queue_depth == 6  # shards fold; the legacy file is
+    assert merged.updated == 100.0  # a separate artifact, not a member
+    # per-replica staleness guard runs inside the fold
+    guarded = autoscale.read_fleet_demand(base, now=200.0, max_age=90.0)
+    assert guarded.queue_depth == 4  # g0 (age 100) dropped, g1 kept
+
+
+# ----------------------------------------- per-replica artifacts, teardown
+
+
+def test_runpaths_replica_helpers_and_globs(tmp_path):
+    paths = state.RunPaths(tmp_path)
+    assert paths.request_log_replica("g1").name == "serve-requests-g1.jsonl"
+    assert paths.demand_signal_replica("g1").name == "demand-signal-g1.json"
+    assert paths.request_logs() == []  # nothing on disk yet
+    paths.request_log_replica("g1").write_text("")
+    paths.request_log_replica("g0").write_text("")
+    assert paths.request_logs() == [paths.request_log_replica("g0"),
+                                    paths.request_log_replica("g1")]
+    paths.request_log.write_text("")  # the single-gateway journal
+    assert paths.request_logs()[0] == paths.request_log
+    paths.demand_signal_replica("g0").write_text("{}")
+    paths.demand_signal.write_text("{}")
+    assert paths.demand_signals() == [paths.demand_signal,
+                                      paths.demand_signal_replica("g0")]
+
+
+def test_teardown_scrubs_fleet_journal_and_signal_shards(tmp_path):
+    """A fleet of N replicas leaves N journal shards and N demand
+    signals behind — teardown's globbed scrub must take them all, not
+    just the single-gateway files."""
+    paths = state.RunPaths(tmp_path)
+    config = ClusterConfig(project="my-proj", zone="us-west4-a",
+                           generation="v5e", topology="4x4",
+                           mode="tpu-vm")
+    paths.config_file.write_text("PROJECT=my-proj\n")
+    doomed = [paths.request_log, paths.demand_signal]
+    for rid in ("g0", "g1", "g2"):
+        doomed.append(paths.request_log_replica(rid))
+        doomed.append(paths.demand_signal_replica(rid))
+    for artifact in doomed:
+        artifact.write_text("{}\n")
+    prompter = Prompter(io.StringIO("yes\nyes\n"), io.StringIO())
+    run = lambda args, cwd=None, **kwargs: ""  # noqa: E731
+    assert teardown.clean(config, paths, prompter, run=run) is True
+    for artifact in doomed:
+        assert not artifact.exists(), artifact
+
+
+# --------------------------------------------------- the fleet control loop
+
+
+def fleet_under_test(tmp_path, replicas=2, num_slices=2, **policy):
+    gw_policy = gw_mod.GatewayPolicy(
+        max_seq_len=512, slots_per_slice=2, prefill_chunk=64,
+        queue_budget=16, bucket_bounds=(64, 128), poll_every_s=2.0,
+        default_deadline_s=120.0,
+    )
+    engines = {
+        i: gw_mod.ModeledEngine(slots=gw_policy.slots_per_slice,
+                                prefill_chunk=gw_policy.prefill_chunk,
+                                cost=gw_mod.DecodeCostModel())
+        for i in range(num_slices)
+    }
+    paths = state.RunPaths(tmp_path)
+    led = ev.EventLedger(paths.events, clock=lambda: 0.0,
+                         echo=lambda line: None, fsync=False)
+    return fleet_mod.GatewayFleet(
+        engines, paths, led,
+        policy=fleet_mod.FleetPolicy(replicas=replicas, **policy),
+        gateway_policy=gw_policy, clock=lambda: 0.0, fsync=False,
+    )
+
+
+def test_tick_grants_every_slice_and_partitions_cover_replicas(tmp_path):
+    fleet = fleet_under_test(tmp_path, replicas=2, num_slices=4)
+    fleet.tick(0.0)
+    assert sorted(fleet.leases.table) == [0, 1, 2, 3]
+    held = {rid: fleet.leases.held_by(rid) for rid in fleet.replica_ids}
+    assert all(len(slices) == 2 for slices in held.values())  # least-loaded
+    counts = fleet.partition_counts()
+    assert sum(counts.values()) == fleet.policy.partitions
+    assert all(n > 0 for n in counts.values())
+    for rid, slices in held.items():  # leased slices carry workers
+        assert sorted(fleet.gateways[rid].workers) == slices
+
+
+def test_kill_routes_429_then_tick_reassigns_and_adopts(tmp_path):
+    fleet = fleet_under_test(tmp_path, replicas=2, num_slices=2)
+    fleet.tick(0.0)
+    victim = "g1"
+    # a key that routes to the victim (scan: crc32 spreads keys evenly)
+    req = next(
+        gw_mod.Request(rid=n, prompt_len=8, max_new_tokens=4,
+                       key=f"k{n}", arrival=10.0)
+        for n in range(64)
+        if fleet.owner_of(gw_mod.Request(
+            rid=n, prompt_len=8, max_new_tokens=4, key=f"k{n}")) == victim
+    )
+    fleet.kill(victim, 10.0)
+    refused = fleet.submit(req, 10.5)  # the MTTR window: honest 429
+    assert refused.ok is False
+    assert refused.reason == gw_mod.REJECT_NO_CAPACITY
+    assert refused.retry_after_s == fleet.policy.tick_every_s
+    assert fleet.dead_routed == 1
+    moved = fleet.tick(12.0)
+    assert moved["revoked"] == 1  # the victim's lease, fenced off
+    assert moved["granted"] == 1  # ... and re-granted to the survivor
+    assert len(moved["adopted"]) == 1
+    audit = fleet.reassignments[0]
+    assert audit["from"] == victim and audit["to"] == "g0"
+    assert set(fleet.partition_owner.values()) == {"g0"}
+    accepted = fleet.submit(req, 12.5)  # same key, now owned by g0
+    assert accepted.ok is True
+    # the revived victim is a STANDBY: partitions moved on, and lease
+    # grants follow partition ownership, so it holds no slices
+    fleet.revive(victim, 20.0)
+    fleet.tick(22.0)
+    assert fleet.leases.held_by(victim) == []
+    assert set(fleet.partition_owner.values()) == {"g0"}
+
+
+# ----------------------------------------------- campaign smoke (tier-1)
+
+
+def test_fleet_campaign_smoke_few_seeds_zero_violations(tmp_path):
+    """The tier-1 fleet-chaos smoke: seeded campaigns over the sharded
+    request plane — replica kills, revives, forced lease expiries —
+    every one converging with zero merged-fold/lease violations."""
+    for seed in (1, 5):
+        scenario = chaos.generate_fleet_scenario(seed)
+        out = chaos.run_fleet_campaign(scenario, tmp_path / f"seed-{seed}")
+        assert out["violations"] == [], (seed, out)
+        assert out["converged"] is True
+        assert out["replica_kills"] >= 1
+        assert out["reassignments"] >= 1
+        assert out["accepted"] > 0
+        assert out["completed"] + out["expired"] >= out["accepted"]
+
+
+def test_fleet_kill_drill_reassigns_all_and_loses_nothing(tmp_path):
+    """THE kill acceptance drill at tier-1 scale: one replica dies
+    mid-dispatch; its partitions land on a successor within the tick
+    budget, the merged N-shard fold loses zero accepted keys, and
+    duplicates of the dead replica's completions replay from the
+    ADOPTED journal instead of regenerating."""
+    drill = chaos.run_fleet_kill_drill(tmp_path, duration_s=120.0)
+    assert drill["violations"] == [], drill
+    assert drill["converged"] is True
+    assert drill["requests_lost"] == 0
+    assert drill["partitions_reassigned"] > 0
+    assert drill["successor"] is not None
+    assert drill["successor"] != drill["victim"]
+    assert (drill["duplicates_replayed_from_journal"]
+            == drill["duplicates_resubmitted"] > 0)
+    # MTTR bounded by the tick cadence (one tick + adoption)
+    assert drill["kill_to_reassign_s"] <= 2 * 2.0
+
+
+# ------------------------------------------------ status block & baseline
+
+
+def test_fleet_status_emits_bounded_gateway_fleet_block():
+    records = [
+        {"kind": ev.LEASE_GRANT, "ts": 1.0, "slice": 0, "replica": "g0",
+         "epoch": 1, "expires_at": 31.0},
+        {"kind": ev.LEASE_GRANT, "ts": 1.0, "slice": 1, "replica": "g1",
+         "epoch": 2, "expires_at": 31.0},
+        {"kind": ev.LEASE_RENEW, "ts": 21.0, "slice": 0, "replica": "g0",
+         "epoch": 1, "expires_at": 51.0},
+        {"kind": ev.LEASE_REVOKE, "ts": 25.0, "slice": 1,
+         "replica": "g1", "epoch": 2, "at": 25.0,
+         "reason": "replica-dead"},
+    ]
+    doc = ev.fleet_status(ev.fold(records), 30.0)
+    block = doc["gateway_fleet"]
+    assert block["leases_total"] == 1  # the revoked lease is closed
+    assert block["leases"]["0"]["replica"] == "g0"
+    assert block["leases"]["0"]["expires_at"] == 51.0  # renewed
+    assert block["lease_epoch"] == 2
+    assert (block["grants"], block["renews"], block["revokes"]) == (2, 1, 1)
+    assert block["stalest_demand_age_s"] is None  # caller's to fill
+    # pre-fleet ledgers keep the pinned schema: no block at all
+    assert "gateway_fleet" not in ev.fleet_status(ev.fold([]), 30.0)
+
+
+def test_fleet_committed_baseline_still_green():
+    """The committed BENCH_fleet.json must describe a passing run —
+    the --check gate trusts its scaling ratio and kill-drill MTTR."""
+    import bench_provision
+
+    doc = json.loads(bench_provision.FLEET_BASELINE.read_text())
+    assert doc["benchmark"] == "gateway_fleet"
+    assert doc["passes"] is True
+    assert doc["value"] >= 2.5  # N=4 over N=1 accepted throughput
+    assert doc["campaigns"]["violation_count"] == 0
+    assert doc["campaigns"]["converged"] == doc["campaigns"]["campaigns"]
+    streaming = doc["streaming"]
+    assert streaming["ttft_p99_s"] < streaming["full_response_p99_s"]
+    kill = doc["kill_drill"]
+    assert kill["requests_lost"] == 0
+    assert kill["partitions_reassigned"] > 0
+    assert kill["kill_to_reassign_s"] <= doc["mttr_budget_s"]

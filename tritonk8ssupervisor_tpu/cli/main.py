@@ -861,6 +861,22 @@ def status_cmd(args, paths: state.RunPaths, prompter: Prompter) -> int:
             "span_log_bytes": span_bytes,
             "last_tick_s": last_tick,
         }
+    fleet_block = doc.get("gateway_fleet")
+    if (isinstance(fleet_block, dict)
+            and fleet_block.get("stalest_demand_age_s") is None):
+        # a ledger fold (or a supervisor without a live demand fold)
+        # leaves the staleness slot empty; fill it from the on-disk
+        # shards' mtimes — wall clock, because mtimes are wall clock,
+        # NOT the supervisor's monotonic timeline
+        ages = []
+        for shard in paths.demand_signals():
+            try:
+                ages.append(time_mod.time() - shard.stat().st_mtime)
+            except OSError:
+                continue  # scrubbed between glob and stat: not stale
+        if ages:
+            fleet_block["stalest_demand_age_s"] = round(max(0.0,
+                                                            *ages), 3)
     if args.json:
         prompter.say(json_mod.dumps(doc, indent=2, sort_keys=True))
     else:
@@ -962,6 +978,20 @@ def status_cmd(args, paths: state.RunPaths, prompter: Prompter) -> int:
                    f" ({last.get('reason')})" if last else "")
                 + (f", {handovers.get('forced', 0)} forced"
                    if handovers.get("forced") else "")
+            )
+        fleet = doc.get("gateway_fleet") or {}
+        if fleet:
+            stale = fleet.get("stalest_demand_age_s")
+            prompter.say(
+                f"gateway fleet: {len(fleet.get('replicas') or [])} "
+                f"replica(s), {fleet.get('leases_total', 0)} lease(s) "
+                f"(epoch {fleet.get('lease_epoch', 0)}; "
+                f"{fleet.get('grants', 0)} granted, "
+                f"{fleet.get('renews', 0)} renewed, "
+                f"{fleet.get('expiries', 0)} expired, "
+                f"{fleet.get('revokes', 0)} revoked)"
+                + (f", stalest demand signal {stale:.0f}s"
+                   if stale is not None else "")
             )
         membership = doc.get("membership", {})
         if membership:

@@ -114,12 +114,21 @@ class Counter(_Metric):
         with self._lock:
             return sum(self._values.values())
 
-    def per_label(self, label: str) -> dict:
+    def per_label(self, label: str, **match) -> dict:
         """{label value: count} for one label name — how report() folds
-        e.g. rejected-per-reason out of the registry."""
+        e.g. rejected-per-reason out of the registry. `match` narrows
+        to children carrying those exact label values first — the
+        gateway fleet's per-replica reports fold a shared registry with
+        per_label("reason", replica="g0") while the unfiltered call
+        keeps summing fleet-wide."""
         out: dict = {}
         with self._lock:
             for key, value in self._values.items():
+                if match:
+                    kd = dict(key)
+                    if any(kd.get(name) != want
+                           for name, want in match.items()):
+                        continue
                 for name, lv in key:
                     if name == label:
                         out[lv] = out.get(lv, 0.0) + value

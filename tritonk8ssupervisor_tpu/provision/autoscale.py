@@ -130,6 +130,103 @@ def read_demand_signal(path: Path | str) -> DemandSignal | None:
     return parse_demand_signal(raw)
 
 
+# ------------------------------------------------------- fleet demand fold
+
+# default per-replica staleness bound for the fleet fold, matching
+# AutoscalePolicy.signal_max_age_s — callers with a policy pass theirs
+FLEET_SIGNAL_MAX_AGE_S = 90.0
+
+
+def merge_demand_signals(
+    signals: dict,
+    now: float | None = None,
+    max_age: float | None = None,
+) -> DemandSignal | None:
+    """Fold N replicas' demand signals (serving/fleet.py: each replica
+    publishes demand-signal-<replica>.json for ITS key-partition and
+    leased slices) into the ONE DemandSignal the autoscaler and
+    allocator consume. The per-replica staleness guard runs HERE, not
+    just on the merged document: one dead replica's week-old "queue is
+    empty" must neither drag the merged view stale (freezing the
+    controllers) nor dilute live replicas' pressure — stale members are
+    dropped, fresh ones merge.
+
+    Merge semantics: demand sums (queue_depth, service_rate,
+    recent_sheds, kv_pages_free — slice leases are disjoint, so
+    per-replica engine reports never double-count a pool), pain takes
+    the worst case (p99 = max, deadline_headroom = min), per-slice
+    inflight sums, active_workers unions, and `updated` is the OLDEST
+    included signal — the merged view is only as fresh as its stalest
+    member, so the autoscaler's own staleness guard stays honest."""
+    fresh = {}
+    for replica, signal in signals.items():
+        if signal is None:
+            continue
+        if (now is not None and max_age is not None
+                and now - signal.updated > max_age):
+            continue  # this replica's signal is not evidence
+        fresh[replica] = signal
+    if not fresh:
+        return None
+    members = list(fresh.values())
+    rates = [s.service_rate for s in members if s.service_rate is not None]
+    p99s = [s.p99_s for s in members if s.p99_s is not None]
+    headrooms = [s.deadline_headroom_s for s in members
+                 if s.deadline_headroom_s is not None]
+    kv_frees = [s.kv_pages_free for s in members
+                if s.kv_pages_free is not None]
+    inflight: dict = {}
+    workers: set = set()
+    for s in members:
+        for index, n in s.inflight.items():
+            inflight[int(index)] = inflight.get(int(index), 0) + int(n)
+        workers.update(int(i) for i in s.active_workers)
+    return DemandSignal(
+        updated=min(s.updated for s in members),
+        queue_depth=sum(s.queue_depth for s in members),
+        service_rate=sum(rates) if rates else None,
+        p99_s=max(p99s) if p99s else None,
+        recent_sheds=sum(s.recent_sheds for s in members),
+        deadline_headroom_s=min(headrooms) if headrooms else None,
+        inflight=inflight,
+        active_workers=tuple(sorted(workers)),
+        kv_pages_free=sum(kv_frees) if kv_frees else None,
+    )
+
+
+def fleet_signal_paths(path: Path | str) -> dict:
+    """The per-replica demand-signal shards next to the legacy path:
+    demand-signal-<replica>.json siblings (state.RunPaths naming).
+    Empty dict = no fleet is publishing here."""
+    path = Path(path)
+    stem, suffix = path.stem, path.suffix
+    out = {}
+    for shard in sorted(path.parent.glob(f"{stem}-*{suffix}")):
+        replica = shard.stem[len(stem) + 1:]
+        if replica:
+            out[replica] = shard
+    return out
+
+
+def read_fleet_demand(
+    path: Path | str,
+    now: float | None = None,
+    max_age: float | None = None,
+) -> DemandSignal | None:
+    """The supervisor's ONE demand read: when per-replica shards exist
+    next to `path`, fold them (per-replica staleness-guarded) into a
+    merged signal; when none do, this is exactly `read_demand_signal`
+    — a single-gateway deployment's behavior, byte-identical."""
+    shards = fleet_signal_paths(path)
+    if not shards:
+        return read_demand_signal(path)
+    return merge_demand_signals(
+        {replica: read_demand_signal(p) for replica, p in shards.items()},
+        now=now,
+        max_age=max_age if max_age is not None else FLEET_SIGNAL_MAX_AGE_S,
+    )
+
+
 # ------------------------------------------------------------------ policy
 
 

@@ -107,6 +107,20 @@ ALLOC_DECISION = "alloc-decision"  # confirmed role reassignment
 PREEMPT_NOTICE = "preempt-notice"  # handover open: slices TRANSITIONING
 PREEMPT_ACK = "preempt-ack"  # trainer acked (or forced past deadline)
 ROLE_CHANGED = "role-changed"  # handover closed: roles flipped
+# Gateway-fleet lease vocabulary (serving/fleet.py): the sharded
+# request plane's slice-ownership protocol. Every GRANT carries a
+# fleet-monotonic `epoch` — the fence a replica must present with each
+# dispatch, so a holder whose lease was revoked/expired behind its back
+# is REFUSED instead of double-pulling from a slot pool a peer now
+# owns. RENEW keeps the epoch and extends `expires_at`; EXPIRE/REVOKE
+# close the lease (a re-grant always mints a fresh epoch, which is why
+# a supervisor/fleet restart folding this ledger can never hand out a
+# stale fence). Ledgers from before the fleet existed fold unchanged —
+# the fields default empty.
+LEASE_GRANT = "lease-grant"  # slice -> replica ownership opened (epoch)
+LEASE_RENEW = "lease-renew"  # same epoch, expiry pushed out
+LEASE_EXPIRE = "lease-expire"  # TTL lapsed (swept at a fleet tick)
+LEASE_REVOKE = "lease-revoke"  # administratively closed (carries reason)
 
 # Role vocabulary shared with provision/allocator.py (string literals
 # here to avoid the module cycle; tests pin the two stay in sync).
@@ -426,6 +440,18 @@ class LedgerView:
     forced_preemptions: int = 0
     role_changes: int = 0
     alloc_cooldown_until: float | None = None
+    # ---- gateway-fleet lease fold (serving/fleet.py) ----
+    # `leases` is the LIVE lease table (slice -> {replica, epoch,
+    # expires_at, since}); `lease_epoch` is the highest epoch ever
+    # granted — the monotonic fence a restarted fleet resumes from so
+    # a re-grant after a crash can never reuse a dead holder's epoch.
+    leases: dict = dataclasses.field(default_factory=dict)
+    lease_epoch: int = 0
+    lease_grants: int = 0
+    lease_renews: int = 0
+    lease_expiries: int = 0
+    lease_revokes: int = 0
+    fleet_replicas: set = dataclasses.field(default_factory=set)
     open_heals: list = dataclasses.field(default_factory=list)  # records
     # heal-start id -> record, until a done/failed closes it (the list
     # above is kept in sync — it is the public face, this is the index)
@@ -517,6 +543,18 @@ def snapshot_fields(view: LedgerView) -> dict:
         "forced_preemptions": view.forced_preemptions,
         "role_changes": view.role_changes,
         "alloc_cooldown_until": view.alloc_cooldown_until,
+        # the gateway-fleet lease fold: the live lease table AND the
+        # monotonic epoch high-water mark must survive compaction — a
+        # fleet restarting over a compacted ledger that forgot either
+        # could double-grant a slice or mint a reused (unfenceable)
+        # epoch
+        "leases": {str(k): dict(v) for k, v in view.leases.items()},
+        "lease_epoch": view.lease_epoch,
+        "lease_grants": view.lease_grants,
+        "lease_renews": view.lease_renews,
+        "lease_expiries": view.lease_expiries,
+        "lease_revokes": view.lease_revokes,
+        "fleet_replicas": sorted(view.fleet_replicas),
         # orphaned heal-starts (the crash signature) survive the compact
         "pending_heals": {str(k): v for k, v in view.pending_heals.items()},
         "mttr_samples": list(view.mttr_samples),
@@ -610,6 +648,15 @@ def _apply_snapshot(view: LedgerView, record: dict) -> None:
     view.forced_preemptions = record.get("forced_preemptions", 0)
     view.role_changes = record.get("role_changes", 0)
     view.alloc_cooldown_until = record.get("alloc_cooldown_until")
+    view.leases = {int(k): dict(v)
+                   for k, v in (record.get("leases") or {}).items()}
+    view.lease_epoch = record.get("lease_epoch", 0)
+    view.lease_grants = record.get("lease_grants", 0)
+    view.lease_renews = record.get("lease_renews", 0)
+    view.lease_expiries = record.get("lease_expiries", 0)
+    view.lease_revokes = record.get("lease_revokes", 0)
+    view.fleet_replicas = {str(r)
+                           for r in record.get("fleet_replicas") or []}
     view.pending_heals = dict(record.get("pending_heals") or {})
     view.open_heals = list(view.pending_heals.values())
     view.mttr_samples = list(record.get("mttr_samples") or [])
@@ -902,6 +949,39 @@ def apply(view: LedgerView, record: dict) -> LedgerView:
         # handover that never happened.
         if not record.get("aborted"):
             view.membership_generation += 1
+    elif kind == LEASE_GRANT:
+        view.lease_grants += 1
+        epoch = int(record.get("epoch", 0))
+        # the epoch high-water mark is monotone over the ledger's whole
+        # lifetime — grants land in epoch order, but a compacted prefix
+        # plus a replayed suffix must still fold to the max ever seen
+        view.lease_epoch = max(view.lease_epoch, epoch)
+        replica = record.get("replica")
+        view.leases[int(record.get("slice", -1))] = {
+            "replica": replica,
+            "epoch": epoch,
+            "expires_at": record.get("expires_at"),
+            "since": ts,
+        }
+        if replica is not None:
+            view.fleet_replicas.add(str(replica))
+    elif kind == LEASE_RENEW:
+        view.lease_renews += 1
+        lease = view.leases.get(int(record.get("slice", -1)))
+        # a renew for a superseded epoch is a no-op on the fold: the
+        # live lease (newer epoch) is the truth, the stale renew is the
+        # race the fence exists for
+        if lease is not None and lease.get("epoch") == record.get("epoch"):
+            lease["expires_at"] = record.get("expires_at")
+    elif kind in (LEASE_EXPIRE, LEASE_REVOKE):
+        if kind == LEASE_EXPIRE:
+            view.lease_expiries += 1
+        else:
+            view.lease_revokes += 1
+        index = int(record.get("slice", -1))
+        lease = view.leases.get(index)
+        if lease is not None and lease.get("epoch") == record.get("epoch"):
+            view.leases.pop(index, None)
     return view
 
 
@@ -923,6 +1003,7 @@ def fleet_status(
     pid: int | None = None,
     all_slices: bool = False,
     telemetry: dict | None = None,
+    gateway_fleet: dict | None = None,
 ) -> dict:
     """The machine-readable status document. Written atomically to
     fleet-status.json every reconcile tick and rendered by
@@ -1179,6 +1260,37 @@ def fleet_status(
             "failures_on_record": len(view.breaker_failures),
         },
     }
+    # Gateway-fleet block (serving/fleet.py): present only when the
+    # ledger has ever seen a lease (or the caller passed live fleet
+    # evidence) so pre-fleet status documents keep their pinned schema.
+    # Bounded: replicas and lease COUNTS always, the per-slice lease
+    # map capped — at 256 slices the detail lives in the ledger, not
+    # in a document a gateway parses every poll.
+    if view.lease_grants or view.leases or gateway_fleet is not None:
+        lease_items = sorted(view.leases.items())
+        doc["gateway_fleet"] = {
+            "replicas": sorted(view.fleet_replicas),
+            "leases_total": len(view.leases),
+            "leases": {
+                str(i): {
+                    "replica": entry.get("replica"),
+                    "epoch": entry.get("epoch"),
+                    "expires_at": entry.get("expires_at"),
+                }
+                for i, entry in lease_items[:32]
+            },
+            "lease_epoch": view.lease_epoch,
+            "grants": view.lease_grants,
+            "renews": view.lease_renews,
+            "expiries": view.lease_expiries,
+            "revokes": view.lease_revokes,
+            # filled from the live demand fold when the supervisor (or
+            # status command) has one: how old the stalest replica's
+            # demand-signal-<replica>.json is
+            "stalest_demand_age_s": None,
+        }
+        if gateway_fleet:
+            doc["gateway_fleet"].update(gateway_fleet)
     if telemetry is not None:
         doc["telemetry"] = telemetry
     return doc

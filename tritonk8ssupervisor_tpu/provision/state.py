@@ -230,6 +230,31 @@ class RunPaths:
         # teardown with the other contract files
         return self.root / "demand-signal.json"
 
+    # ---- gateway-fleet artifacts (serving/fleet.py): each replica
+    # owns a key-partition, its OWN request journal shard, and its own
+    # demand signal; the merged views are folds over the globs below.
+    # The glob patterns deliberately require the "-<replica>" suffix,
+    # so the single-gateway files above are separate artifacts — the
+    # plural helpers return base + shards together for teardown and
+    # the fleet-wide folds.
+
+    def request_log_replica(self, replica) -> Path:
+        return self.root / f"serve-requests-{replica}.jsonl"
+
+    def demand_signal_replica(self, replica) -> Path:
+        return self.root / f"demand-signal-{replica}.json"
+
+    def request_logs(self) -> list:
+        """Every request journal on disk: the single-gateway file (when
+        present) plus the fleet's per-replica shards, sorted."""
+        out = [self.request_log] if self.request_log.exists() else []
+        return out + sorted(self.root.glob("serve-requests-*.jsonl"))
+
+    def demand_signals(self) -> list:
+        """Every demand signal on disk: single-gateway + per-replica."""
+        out = [self.demand_signal] if self.demand_signal.exists() else []
+        return out + sorted(self.root.glob("demand-signal-*.json"))
+
     @property
     def span_log(self) -> Path:
         # the unified telemetry plane's span ledger (obs/trace.py):

@@ -1100,7 +1100,17 @@ class Supervisor:
         # tests/test_allocator.py.
         signal = None
         if self.autoscaler is not None or self.allocator is not None:
-            signal = autoscale_mod.read_demand_signal(self._demand_path)
+            # the fleet-aware read: with per-replica demand shards on
+            # disk (serving/fleet.py) the N signals fold into ONE
+            # merged view — per-replica staleness-guarded, so a dead
+            # replica's last document neither freezes nor dilutes the
+            # controllers; with no shards this is the single-gateway
+            # read, byte-identical
+            signal = autoscale_mod.read_fleet_demand(
+                self._demand_path, now=now,
+                max_age=(self.autoscaler.policy.signal_max_age_s
+                         if self.autoscaler is not None
+                         else autoscale_mod.FLEET_SIGNAL_MAX_AGE_S))
         # the second controller: demand signal -> desired slice count
         # -> scale execution, AFTER heal reconcile (repairs first —
         # scaling a broken fleet is how thrash starts) and BEFORE the

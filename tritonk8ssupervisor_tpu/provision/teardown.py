@@ -102,18 +102,25 @@ def clean(
     # role map would route traffic around slices that no longer exist
     paths.fleet_status.unlink(missing_ok=True)
     paths.job_ack.unlink(missing_ok=True)
-    # the gateway's demand signal is derived state like fleet-status:
+    # the gateway's demand signals are derived state like fleet-status:
     # scrubbed with the contract files so a fresh run's autoscaler can
-    # never read a previous deployment's queue as evidence
-    paths.demand_signal.unlink(missing_ok=True)
+    # never read a previous deployment's queue as evidence. The plural
+    # helper globs the fleet's per-replica demand-signal-<replica>.json
+    # shards along with the single-gateway file — a fleet of N replicas
+    # leaves N signals behind, not one
+    for signal in paths.demand_signals():
+        signal.unlink(missing_ok=True)
     # telemetry artifacts scrub with the ledgers: the metrics snapshot
     # is derived state, and the span log is the telemetry plane's
     # flight record (obs/trace.py) — kept until the very end with the
     # request journal so an interrupted clean leaves the evidence
     paths.metrics_snapshot.unlink(missing_ok=True)
-    # the gateway's request journal holds client-owed work; like the
-    # event ledger it outlives every resumable step above
-    paths.request_log.unlink(missing_ok=True)
+    # the gateway's request journals hold client-owed work; like the
+    # event ledger they outlive every resumable step above. Globbed:
+    # the fleet's per-replica serve-requests-<replica>.jsonl shards
+    # scrub with the single-gateway journal
+    for request_log in paths.request_logs():
+        request_log.unlink(missing_ok=True)
     paths.span_log.unlink(missing_ok=True)
     events_mod.EventLedger(paths.events).scrub()
     prompter.say("Clean. Re-run ./setup.sh to provision again.")

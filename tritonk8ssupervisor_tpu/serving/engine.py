@@ -489,6 +489,9 @@ class SlotEngine:
         jnp = self._jnp
         emitted: dict = {}
         finished: dict = {}
+        # the boundary's new token ids per slot — what a streaming
+        # request's on_token callback delivers (gateway.SliceWorker)
+        tokens: dict = {}
         prefilling = sorted(s for s, st in self._requests.items()
                             if st["done"] < st["tokens"].size)
         if prefilling:
@@ -543,6 +546,7 @@ class SlotEngine:
                 self.pos[slot] = st["tokens"].size
                 self.active[slot] = True
                 emitted[slot] = 1
+                tokens[slot] = [first]
                 if len(st["out"]) >= st["budget"]:
                     self._finish(slot, st, finished)
         decoding = sorted(s for s in self._requests if self.active[s])
@@ -560,6 +564,7 @@ class SlotEngine:
                 # it is overwritten before anything attends it.
                 self.pos[slot] = st["tokens"].size + len(st["out"]) - 1
                 emitted[slot] = emitted.get(slot, 0) + len(toks)
+                tokens[slot] = tokens.get(slot, []) + list(toks)
                 if len(st["out"]) >= st["budget"]:
                     self._finish(slot, st, finished)
         elif decoding:
@@ -589,12 +594,14 @@ class SlotEngine:
                 st["out"].append(tok)
                 self.last[slot] = tok
                 emitted[slot] = emitted.get(slot, 0) + 1
+                tokens[slot] = tokens.get(slot, []) + [tok]
                 if len(st["out"]) >= st["budget"]:
                     self._finish(slot, st, finished)
         if not emitted and not prefilling:
             return None
         self.steps += 1
-        return StepResult(dt=0.0, emitted=emitted, finished=finished)
+        return StepResult(dt=0.0, emitted=emitted, finished=finished,
+                          tokens=tokens)
 
     def _spec_round(self) -> dict:
         """One drafter-propose / target-verify round for every active

@@ -155,6 +155,20 @@ class Request:
     out_tokens: list = dataclasses.field(default_factory=list)
     retries: int = 0  # times requeued (slice loss / engine / restart)
     notify: Callable | None = None  # settle callback (HTTP path)
+    # multi-turn sessions (serving/fleet.py): requests of one
+    # conversation share `session_id` — the fleet routes them to the
+    # SAME key-partition (KV affinity: turn k+1's prompt chain-matches
+    # turn k's registered prefix blocks in the PrefixStore), `turn`
+    # counts from 0
+    session_id: str | None = None
+    turn: int = 0
+    # streaming token delivery: with `stream` set, `on_token(request,
+    # n_new, ids_or_None, now)` fires at every step boundary that
+    # emitted tokens for this request — tokens flow to the client as
+    # decoded instead of arriving as one settled response, and TTFT
+    # (arrival -> first emission) becomes the user-visible latency
+    stream: bool = False
+    on_token: Callable | None = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -289,6 +303,10 @@ class StepResult:
     dt: float
     emitted: dict = dataclasses.field(default_factory=dict)  # slot -> n
     finished: dict = dataclasses.field(default_factory=dict)  # slot -> ids
+    # the step's NEW token ids per slot (real engines fill it; modeled
+    # engines leave it empty — they track counts) — what a streaming
+    # request's on_token callback delivers as the step settles
+    tokens: dict = dataclasses.field(default_factory=dict)
 
 
 @dataclasses.dataclass
@@ -408,7 +426,11 @@ class ModeledEngine:
                                 int(request.max_new_tokens),
                                 shared) - shared
         budget = self.pages.pages_free
+        if need <= budget:
+            return True  # free list suffices: skip the store walk
         if self.prefix is not None:
+            # only under real page pressure is the store's evictable
+            # count worth its O(entries) refcount walk
             budget += self.prefix.evictable_pages()
         return need <= budget
 
@@ -700,6 +722,12 @@ class SliceWorker:
             req.generated += n
             if req.first_token_at is None and n > 0:
                 req.first_token_at = end
+                self.gateway.note_first_token(req, end)
+            if n > 0 and req.on_token is not None:
+                # streaming delivery: tokens leave at the boundary they
+                # were decoded, not when the request settles (ids are
+                # None on modeled engines — they track counts)
+                req.on_token(req, n, result.tokens.get(slot), end)
         for slot, ids in result.finished.items():
             req = self.inflight.pop(slot, None)
             if req is None:
@@ -730,6 +758,20 @@ class SliceWorker:
         return result.dt
 
 
+@dataclasses.dataclass
+class WfqClock:
+    """The WFQ virtual clock: system virtual time plus each tenant's
+    last assigned finish tag. A standalone gateway owns its own; the
+    gateway FLEET (serving/fleet.py) hands ONE instance to every
+    replica, so tenant weights bind globally — a tenant's request
+    admitted on replica g0 advances the same virtual time a g3
+    admission tags against, and a flooding tenant cannot escape its
+    weight by spraying replicas."""
+
+    vtime: float = 0.0
+    finish: dict = dataclasses.field(default_factory=dict)  # tenant -> tag
+
+
 class Gateway:
     """Admission + bucketed queue + fleet-status routing over a set of
     per-slice workers. See the module docstring for the contract."""
@@ -744,6 +786,9 @@ class Gateway:
         reqlog: reqlog_mod.RequestLog | None = None,
         telemetry: "obs_mod.Telemetry | None" = None,
         demand_path=None,
+        replica: str | None = None,
+        lease_guard: Callable | None = None,
+        wfq: WfqClock | None = None,
     ) -> None:
         self.policy = policy or GatewayPolicy()
         self.buckets = SequenceBuckets(self.policy.bucket_bounds)
@@ -751,6 +796,17 @@ class Gateway:
         self._clock = clock
         self._echo = echo
         self.reqlog = reqlog
+        # gateway-fleet identity (serving/fleet.py): `replica` stamps a
+        # `replica` label on every counter/gauge/histogram write (None
+        # = the single-gateway unlabeled series, byte-identical) and
+        # rides on DISPATCHED journal records; `lease_guard(slice, now)
+        # -> epoch | None` is the slice-lease epoch fence consulted at
+        # every claim — None means this replica does NOT hold a live
+        # lease on the slice and the pull is refused.
+        self.replica = None if replica is None else str(replica)
+        self._lease_guard = lease_guard
+        self._labels = ({"replica": self.replica}
+                        if self.replica is not None else {})
         # The telemetry plane (obs/): the registry is ALWAYS real —
         # report()/healthz counts read from it as the single source of
         # truth — while spans flow only when a SpanLog is wired
@@ -790,12 +846,21 @@ class Gateway:
         self._c_engine_failures = reg.counter(
             "serving_engine_failures_total",
             "engines that crashed mid-step (EngineLoop containment)")
+        self._c_lease_fenced = reg.counter(
+            "serving_lease_fenced_total",
+            "dispatch pulls refused by the slice-lease epoch fence "
+            "(a stale holder tried to claim from a slot pool it no "
+            "longer owns)")
         self._h_latency = reg.histogram(
             "serving_request_latency_seconds",
             "arrival-to-completion latency (seconds, log buckets)")
         self._h_queue_wait = reg.histogram(
             "serving_queue_wait_seconds",
             "arrival-to-dispatch queue wait of completed requests")
+        self._h_ttft = reg.histogram(
+            "serving_ttft_seconds",
+            "arrival to first emitted token (TTFT) — the user-visible "
+            "latency under streaming delivery")
         self._g_depth = reg.gauge(
             "serving_queue_depth", "queued requests across all buckets")
         self._g_slots_busy = reg.gauge(
@@ -869,13 +934,29 @@ class Gateway:
         # ---- per-tenant WFQ state (policy.tenant_weights) ----
         # `_vtime` is the system virtual time (advanced to the claimed
         # request's tag at dispatch); `_wfq_finish` is each tenant's
-        # last assigned finish tag. `_priority_seen` keeps the legacy
-        # head-only claim scan until a prioritized request actually
-        # arrives — homogeneous streams pay nothing for the feature.
+        # last assigned finish tag — both live on a WfqClock that a
+        # fleet SHARES across replicas (fleet-wide weights) and a
+        # standalone gateway owns alone. `_priority_seen` keeps the
+        # legacy head-only claim scan until a prioritized request
+        # actually arrives — homogeneous streams pay nothing.
         self._wfq_enabled = bool(self.policy.tenant_weights)
-        self._wfq_finish: dict = {}
-        self._vtime = 0.0
+        self._wfq = wfq if wfq is not None else WfqClock()
         self._priority_seen = False
+
+    # The WFQ virtual clock's two faces, kept as attribute-shaped
+    # properties so every admission/claim site (and the tests pinning
+    # them) read/write the SHARED clock transparently.
+    @property
+    def _vtime(self) -> float:
+        return self._wfq.vtime
+
+    @_vtime.setter
+    def _vtime(self, value: float) -> None:
+        self._wfq.vtime = value
+
+    @property
+    def _wfq_finish(self) -> dict:
+        return self._wfq.finish
 
     # -------------------------------------------------------------- routing
 
@@ -910,11 +991,20 @@ class Gateway:
                   max(0, int(round(0.99 * (len(window) - 1)))))
         return window[idx]
 
+    def _total(self, counter) -> int:
+        """One counter's lifetime count FOR THIS GATEWAY: the exact
+        replica-labeled series in a fleet (the registry is shared, so
+        .total() would fold every replica together), the whole counter
+        standalone — byte-identical to the pre-fleet reports."""
+        if self._labels:
+            return int(counter.value(**self._labels))
+        return int(counter.total())
+
     def _pressure_sheds(self) -> int:
         """Lifetime count of load-pressure refusals (overload, breaker,
         no capacity, deadline-unmeetable) from the registry — 400-class
         unservables and duplicate refusals are not demand evidence."""
-        per_reason = self._c_rejected.per_label("reason")
+        per_reason = self._c_rejected.per_label("reason", **self._labels)
         return int(sum(
             count for reason, count in per_reason.items()
             if reason in (REJECT_OVERLOAD, REJECT_BREAKER,
@@ -1054,7 +1144,7 @@ class Gateway:
             self.queues[req.bucket].appendleft(req)
             self._journal(reqlog_mod.REQUEUED, key=req.key, rid=req.rid,
                           cause=cause, retries=req.retries)
-            self._c_requeued.inc(cause=cause)
+            self._c_requeued.inc(cause=cause, **self._labels)
             self._tracer.event("requeue", now, key=req.key, rid=req.rid,
                                cause=cause, retries=req.retries)
             requeued += 1
@@ -1075,12 +1165,43 @@ class Gateway:
         self.metrics.engine_failures.append(
             {"ts": now, "slice": int(index), "error": str(error)[:200]}
         )
-        self._c_engine_failures.inc()
+        self._c_engine_failures.inc(**self._labels)
         self._tracer.event("engine-failure", now, slice=int(index))
         self._echo(
             f"[gateway] slice {index} engine failed ({error}): "
             f"requeued {requeued} in-flight request(s)"
         )
+        return requeued
+
+    def attach_worker(self, index: int, engine) -> None:
+        """Start serving a slice this gateway did not construct with —
+        the fleet grants a slice LEASE and hands the replica the
+        slice's engine. Idempotent for the same index (a renew changes
+        nothing); a dead prior worker on the index is replaced."""
+        index = int(index)
+        worker = self.workers.get(index)
+        if worker is not None and worker.engine is engine:
+            worker.revive()
+            return
+        self.workers[index] = SliceWorker(index, engine, self)
+
+    def detach_worker(self, index: int, now: float | None = None,
+                      cause: str = "lease-revoked") -> int:
+        """Stop serving a slice (lease expired or revoked while this
+        replica is still alive): reap its in-flight work back to the
+        front of the queue and drop the worker — the next lease holder
+        gets a clean engine. Returns the number requeued."""
+        now = self._clock() if now is None else now
+        worker = self.workers.pop(int(index), None)
+        if worker is None:
+            return 0
+        lost = worker.reap()
+        requeued = self._requeue_lost(lost, now, cause)
+        if requeued:
+            self._echo(
+                f"[gateway] slice {index} lease lost ({cause}): "
+                f"requeued {requeued} in-flight request(s)"
+            )
         return requeued
 
     # ------------------------------------------------------------ admission
@@ -1119,7 +1240,7 @@ class Gateway:
         now = self._clock() if now is None else now
         self.poll(now)
         self.metrics.submitted += 1
-        self._c_submitted.inc()
+        self._c_submitted.inc(**self._labels)
         request.arrival = now
         if request.deadline_s is None:
             request.deadline_s = self.policy.default_deadline_s
@@ -1131,7 +1252,7 @@ class Gateway:
                     # exactly-once from the client's view: the recorded
                     # result answers the duplicate, nothing regenerates
                     self.metrics.replayed += 1
-                    self._c_replayed.inc()
+                    self._c_replayed.inc(**self._labels)
                     self._tracer.event("replay", now, key=request.key,
                                        rid=request.rid)
                     self._journal(reqlog_mod.REPLAYED, key=request.key,
@@ -1212,7 +1333,7 @@ class Gateway:
                       **({"tokens": [int(t) for t in request.tokens]}
                          if request.tokens is not None else {}))
         self.metrics.accepted.append((now, request.rid))
-        self._c_accepted.inc()
+        self._c_accepted.inc(**self._labels)
         self.metrics.depth_samples.append((now, self.queue_depth()))
         self._tracer.event("admission", now, key=request.key,
                            rid=request.rid, prompt_len=request.prompt_len,
@@ -1229,7 +1350,7 @@ class Gateway:
             "ts": now, "reason": reason, "depth": depth,
             "rid": request.rid,
         })
-        self._c_rejected.inc(reason=reason)
+        self._c_rejected.inc(reason=reason, **self._labels)
         self._tracer.event("shed", now, key=request.key,
                            rid=request.rid, reason=reason, depth=depth)
         self._journal(reqlog_mod.SHED, key=request.key, rid=request.rid,
@@ -1338,6 +1459,18 @@ class Gateway:
         stream of small ones)."""
         if self.slice_mode(slice_index) != SERVE:
             return None
+        # slice-lease epoch fence (serving/fleet.py): a replica may pull
+        # from a slice's slot pool only while it HOLDS a live lease on
+        # it. A stale holder — lease expired or revoked between its last
+        # renew and this claim — gets None, not work: the fence is what
+        # makes "two replicas never pull from the same pool" a checked
+        # invariant instead of a scheduling accident.
+        lease_epoch = None
+        if self._lease_guard is not None:
+            lease_epoch = self._lease_guard(int(slice_index), now)
+            if lease_epoch is None:
+                self._c_lease_fenced.inc(**self._labels)
+                return None
         while True:
             picked = self._pick_queued(now)
             if picked is None:
@@ -1367,12 +1500,16 @@ class Gateway:
                 view_age_s=(round(max(0.0, now - view.updated), 3)
                             if view is not None
                             and view.updated is not None else None),
+                **({"replica": self.replica}
+                   if self.replica is not None else {}),
+                **({"lease_epoch": lease_epoch}
+                   if lease_epoch is not None else {}),
             )
-            # hot path: ONE unlabeled counter inc — span detail for the
-            # dispatch lives in the journal record above, and the
-            # queue-wait histogram is observed at terminal settle, so
-            # the claim path stays inside the <5% overhead gate
-            self._c_dispatched.inc()
+            # hot path: ONE counter inc — span detail for the dispatch
+            # lives in the journal record above, and the queue-wait
+            # histogram is observed at terminal settle, so the claim
+            # path stays inside the <5% overhead gate
+            self._c_dispatched.inc(**self._labels)
             self.metrics.depth_samples.append((now, self.queue_depth()))
             return req
 
@@ -1401,9 +1538,9 @@ class Gateway:
             "served_s": served, "retries": request.retries,
         }
         self.metrics.expired.append(audit)
-        self._c_expired.inc(where=where)
+        self._c_expired.inc(where=where, **self._labels)
         if request.dispatched_at is not None:
-            self._h_queue_wait.observe(audit["queued_s"])
+            self._h_queue_wait.observe(audit["queued_s"], **self._labels)
         self._tracer.event("expiry", now, key=request.key,
                            rid=request.rid, where=where,
                            queued_s=audit["queued_s"], served_s=served,
@@ -1465,15 +1602,23 @@ class Gateway:
         self.expire(request, where, now)
         return True
 
+    def note_first_token(self, request: Request, now: float) -> None:
+        """The request's first decoded token just left the engine:
+        observe TTFT (arrival -> first emission), the user-visible
+        latency under streaming delivery. Called once per request by
+        the worker that emitted it."""
+        self._h_ttft.observe(max(0.0, now - request.arrival),
+                             **self._labels)
+
     def complete(self, request: Request) -> None:
         self.metrics.completed.append(request)
         done = (request.done_at if request.done_at is not None
                 else self._clock())
         self._completion_times.append(done)
-        self._c_completed.inc()
-        self._c_tokens.inc(max(0, request.generated))
+        self._c_completed.inc(**self._labels)
+        self._c_tokens.inc(max(0, request.generated), **self._labels)
         latency = max(0.0, done - request.arrival)
-        self._h_latency.observe(latency)
+        self._h_latency.observe(latency, **self._labels)
         self._recent_latencies.append(latency)
         # the request's span set, emitted at terminal settle as ONE
         # batched write (never on the claim/step hot paths): queue
@@ -1505,7 +1650,8 @@ class Gateway:
             self._tracer.emit_many(spans)
         if request.dispatched_at is not None:
             self._h_queue_wait.observe(
-                max(0.0, request.dispatched_at - request.arrival))
+                max(0.0, request.dispatched_at - request.arrival),
+                **self._labels)
         if request.key is not None:
             result = {
                 "rid": request.rid,
@@ -1603,7 +1749,33 @@ class Gateway:
         now = self._clock() if now is None else now
         records = self.reqlog.replay()
         view = reqlog_mod.fold(records)
-        redone = expired = cached = unrecoverable = 0
+        cached = self._seed_settled(view)
+        # an inherited journal past the compaction cap is folded down
+        # NOW, before the restart's own appends grow it further
+        self._journal_appends = len(records)
+        # journal timestamps live on the journal's clock; translate a
+        # key's age onto ours so deadlines keep their anchor even when
+        # the gateway clock is monotonic and the journal's is wall
+        journal_now = self.reqlog._clock()
+        redone, expired, unrecoverable = self._readmit(
+            view, now, journal_now, "gateway-restart")
+        self.metrics.requeued += redone
+        if redone or expired or cached or unrecoverable:
+            self._echo(
+                f"[gateway] journal recovered: {redone} request(s) "
+                f"re-admitted front-of-queue, {expired} expired during "
+                f"the outage, {unrecoverable} settled unrecoverable, "
+                f"{cached} completed key(s) answerable"
+            )
+        return {"redone": redone, "completed_cached": cached,
+                "expired_on_recover": expired,
+                "unrecoverable": unrecoverable}
+
+    def _seed_settled(self, view: "reqlog_mod.RequestLogView") -> int:
+        """Index a folded journal view's terminal keys (COMPLETED keys
+        become answerable duplicates, EXPIRED keys refuse re-service
+        until re-accepted). Returns the completed count."""
+        cached = 0
         for kv in view.keys.values():
             if kv.state == "completed":
                 self._trails[kv.key] = list(kv.trail)
@@ -1611,18 +1783,22 @@ class Gateway:
                 cached += 1
             elif kv.state == "expired":
                 self._settle_key(kv.key, "expired", None)
-        # an inherited journal past the compaction cap is folded down
-        # NOW, before the restart's own appends grow it further
-        self._journal_appends = len(records)
+        return cached
+
+    def _readmit(self, view: "reqlog_mod.RequestLogView", now: float,
+                 journal_now: float, cause: str) -> tuple:
+        """Re-admit a folded view's incomplete keys at the FRONT of the
+        queue (they already paid it once), settling the ones that
+        cannot be served faithfully. Shared by recover() (this
+        replica's own journal after a restart) and adopt() (a dead
+        peer's journal after a partition reassignment). Returns
+        (redone, expired, unrecoverable)."""
+        redone = expired = unrecoverable = 0
         # the engines decide what a re-admitted request must carry: a
         # real decode engine (SlotEngine) needs the prompt token ids; a
         # modeled one serves from the sizes alone
         needs_tokens = any(getattr(w.engine, "requires_tokens", False)
                            for w in self.workers.values())
-        # journal timestamps live on the journal's clock; translate a
-        # key's age onto ours so deadlines keep their anchor even when
-        # the gateway clock is monotonic and the journal's is wall
-        journal_now = self.reqlog._clock()
         for kv in reversed(view.incomplete()):  # appendleft: oldest in front
             age = max(0.0, journal_now - (kv.accepted_ts
                                           if kv.accepted_ts is not None
@@ -1665,18 +1841,36 @@ class Gateway:
             req.bucket = bound
             self.queues[bound].appendleft(req)
             self._journal(reqlog_mod.REQUEUED, key=kv.key, rid=kv.rid,
-                          cause="gateway-restart", retries=req.retries)
-            self._c_requeued.inc(cause="gateway-restart")
+                          cause=cause, retries=req.retries)
+            self._c_requeued.inc(cause=cause, **self._labels)
             self._tracer.event("requeue", now, key=kv.key, rid=kv.rid,
-                               cause="gateway-restart",
-                               retries=req.retries)
+                               cause=cause, retries=req.retries)
             redone += 1
+        return redone, expired, unrecoverable
+
+    def adopt(self, records: list, now: float | None = None,
+              cause: str = "partition-adopt") -> dict:
+        """Take over a DEAD replica's key-partition (serving/fleet.py
+        reassignment): fold ITS journal records, make its COMPLETED
+        keys answerable duplicates here, and re-admit its incomplete
+        keys front-of-THIS-replica's queue. The REQUEUED/terminal
+        records land in this replica's journal, so the fleet checker's
+        merged N-journal fold still sees every adopted ACCEPTED key
+        reach exactly one terminal state — the "kill one replica, lose
+        zero requests" guarantee."""
+        now = self._clock() if now is None else now
+        view = reqlog_mod.fold(list(records))
+        cached = self._seed_settled(view)
+        journal_now = (self.reqlog._clock()
+                       if self.reqlog is not None else now)
+        redone, expired, unrecoverable = self._readmit(
+            view, now, journal_now, cause)
         self.metrics.requeued += redone
         if redone or expired or cached or unrecoverable:
             self._echo(
-                f"[gateway] journal recovered: {redone} request(s) "
-                f"re-admitted front-of-queue, {expired} expired during "
-                f"the outage, {unrecoverable} settled unrecoverable, "
+                f"[gateway] partition adopted ({cause}): {redone} "
+                f"request(s) re-admitted, {expired} expired in the "
+                f"hand-off, {unrecoverable} settled unrecoverable, "
                 f"{cached} completed key(s) answerable"
             )
         return {"redone": redone, "completed_cached": cached,
@@ -1753,30 +1947,33 @@ class Gateway:
         (GET /metrics), at snapshot writes, and by the chaos checker —
         never on the claim/step hot paths, which is why occupancy is a
         gauge and not per-step bookkeeping."""
-        self._g_depth.set(self.queue_depth())
+        labels = self._labels
+        self._g_depth.set(self.queue_depth(), **labels)
         slots_total = busy = peak = 0
         for worker in self.workers.values():
             slots_total += int(getattr(worker.engine, "slots", 0))
             busy += len(worker.inflight)
             peak += int(getattr(worker.engine, "peak_slots_busy", 0))
-        self._g_slots_total.set(slots_total)
-        self._g_slots_busy.set(busy)
-        self._g_slots_peak.set(peak)
+        self._g_slots_total.set(slots_total, **labels)
+        self._g_slots_busy.set(busy, **labels)
+        self._g_slots_peak.set(peak, **labels)
         engine = self.engine_report()
         if engine is not None:
-            self._g_pages_in_use.set(engine["pages_in_use"])
-            self._g_pages_peak.set(engine["peak_pages_in_use"])
+            self._g_pages_in_use.set(engine["pages_in_use"], **labels)
+            self._g_pages_peak.set(engine["peak_pages_in_use"], **labels)
             if engine["pages_total"] is not None:
-                self._g_pages_total.set(engine["pages_total"])
+                self._g_pages_total.set(engine["pages_total"], **labels)
             if engine["kv_pages_free"] is not None:
-                self._g_pages_free.set(engine["kv_pages_free"])
+                self._g_pages_free.set(engine["kv_pages_free"], **labels)
             spec = engine.get("spec")
             if spec is not None:
-                self._g_spec_drafted.set(spec["drafted"])
-                self._g_spec_accepted.set(spec["accepted"])
-                self._g_spec_rolled_back.set(spec["rolled_back"])
+                self._g_spec_drafted.set(spec["drafted"], **labels)
+                self._g_spec_accepted.set(spec["accepted"], **labels)
+                self._g_spec_rolled_back.set(spec["rolled_back"],
+                                             **labels)
                 if spec["acceptance_rate"] is not None:
-                    self._g_spec_acceptance.set(spec["acceptance_rate"])
+                    self._g_spec_acceptance.set(spec["acceptance_rate"],
+                                                **labels)
 
     def report(self) -> dict:
         """The machine-readable serving summary (the drill/bench
@@ -1788,31 +1985,32 @@ class Gateway:
         schema byte-for-byte (pinned in tests/test_serving.py)."""
         m = self.metrics
         rejects = {reason: int(count) for reason, count
-                   in sorted(self._c_rejected.per_label("reason").items())}
+                   in sorted(self._c_rejected.per_label(
+                       "reason", **self._labels).items())}
         expired_where = {where: int(count) for where, count
                          in sorted(self._c_expired.per_label(
-                             "where").items())}
+                             "where", **self._labels).items())}
         return {
-            "submitted": int(self._c_submitted.total()),
-            "completed": int(self._c_completed.total()),
+            "submitted": self._total(self._c_submitted),
+            "completed": self._total(self._c_completed),
             "rejected": rejects,
-            "requeued_after_slice_loss": int(self._c_requeued.total()),
-            "tokens_generated": int(self._c_tokens.total()),
+            "requeued_after_slice_loss": self._total(self._c_requeued),
+            "tokens_generated": self._total(self._c_tokens),
             "p50_latency_s": m.percentile(0.50),
             "p99_latency_s": m.percentile(0.99),
             "max_queue_depth": max(
                 (d for _, d in m.depth_samples), default=0
             ),
-            "expired": int(self._c_expired.total()),
+            "expired": self._total(self._c_expired),
             "expired_where": expired_where,
-            "replayed_from_journal": int(self._c_replayed.total()),
+            "replayed_from_journal": self._total(self._c_replayed),
             # the routing-advice audit (the no_fleet_view cold-start
             # counter lives here and in rejected["no-fleet-view"])
             "serving": {
                 "view": "ok" if self.view is not None else "none",
                 "no_fleet_view_sheds": rejects.get(
                     REJECT_NO_FLEET_VIEW, 0),
-                "engine_failures": int(self._c_engine_failures.total()),
+                "engine_failures": self._total(self._c_engine_failures),
             },
             # the paged-KV/prefix observability block (why did
             # throughput move): docs/performance.md "Engine hot path"

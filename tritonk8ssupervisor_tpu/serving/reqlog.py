@@ -283,3 +283,20 @@ def fold(records: list[dict]) -> RequestLogView:
     for record in records:
         apply(view, record)
     return view
+
+
+def merge_records(*record_lists) -> list:
+    """Chronologically merge N replica journals' replays into ONE
+    record stream `fold()` can consume — the gateway-fleet invariant
+    checker's view (serving/fleet.py: each replica journals only its
+    own key-partition, so the per-key state machines never interleave
+    across journals; merging just restores global time order). Stable:
+    ties on `ts` keep journal order then record order, so the merged
+    fold is deterministic for a given journal tuple."""
+    tagged = []
+    for j, records in enumerate(record_lists):
+        for i, record in enumerate(records):
+            ts = record.get("ts")
+            tagged.append((ts if ts is not None else 0.0, j, i, record))
+    tagged.sort(key=lambda t: (t[0], t[1], t[2]))
+    return [t[3] for t in tagged]

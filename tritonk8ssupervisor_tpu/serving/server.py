@@ -7,6 +7,14 @@ single engine-loop thread advances every slice worker's step
 boundaries — handler threads only enqueue and wait, so the serving
 schedule stays the gateway's, not the socket layer's.
 
+`{"stream": true}` turns the response into NDJSON token chunks written
+as decode steps land (the engine loop's `on_token` emission feeds a
+per-request queue the handler thread drains), so the client's first
+byte arrives at first-token time instead of full-response time — the
+TTFT the fleet bench measures (`serving_ttft_seconds`,
+docs/observability.md). The final line carries the terminal verdict
+(`"done": true` with the result, or the deadline-expiry trail).
+
 `run_drill` is the no-network variant the CLI smoke and operators use:
 N seeded requests through the same gateway/engine path, one JSON
 report. Both modes watch the workdir's fleet-status.json through the
@@ -17,6 +25,7 @@ traffic exactly like it sheds bench traffic.
 from __future__ import annotations
 
 import json
+import queue
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -127,7 +136,9 @@ def make_handler(gateway: Gateway, lock: threading.Lock,
                  timeout_s: float = 300.0, loop: EngineLoop | None = None):
     """A request handler bound to one gateway. POST /generate with
     {"tokens": [...], "max_new_tokens": N} and optionally
-    {"deadline_s": S, "idempotency_key": K}; GET /healthz reports the
+    {"deadline_s": S, "idempotency_key": K, "stream": true}
+    (streaming: NDJSON token chunks as they decode, terminal line
+    last); GET /healthz reports the
     routed view (503 while shedding or after an engine crash — load
     balancers read this); GET /metrics is the Prometheus text
     exposition of the gateway's registry (obs/metrics.py — scrape
@@ -203,16 +214,29 @@ def make_handler(gateway: Gateway, lock: threading.Lock,
                 tenant = doc.get("tenant")
                 tenant = None if tenant is None else str(tenant)
                 priority = int(doc.get("priority", 0))
+                stream = bool(doc.get("stream", False))
             except (KeyError, TypeError, ValueError) as e:
                 self._reply(400, {"error": f"bad request: {e}"})
                 return
             done = threading.Event()
+            chunks: queue.Queue = queue.Queue()
             req = Request(rid=id(done) & 0x7FFFFFFF,
                           prompt_len=int(tokens.size),
                           max_new_tokens=new, tokens=tokens,
                           deadline_s=deadline, key=key,
                           tenant=tenant, priority=priority,
-                          notify=lambda _r: done.set())
+                          stream=stream,
+                          # settle (complete OR expire) unparks the
+                          # waiter; the sentinel closes the chunk drain
+                          notify=lambda _r: (done.set(),
+                                             chunks.put(None)))
+            if stream:
+                # called from the engine loop at each step boundary;
+                # queue.put is lock-free enough to sit under its lock
+                req.on_token = (
+                    lambda _r, n, ids, _now: chunks.put(
+                        (int(n), None if ids is None
+                         else [int(t) for t in ids])))
             with lock:
                 admission = gateway.submit(req, time.monotonic())
             if admission.ok and admission.result is not None:
@@ -236,6 +260,9 @@ def make_handler(gateway: Gateway, lock: threading.Lock,
             wait_s = timeout_s if req.deadline_s is None else min(
                 timeout_s, float(req.deadline_s) + 5.0
             )
+            if stream:
+                self._stream_reply(req, chunks, wait_s)
+                return
             if not done.wait(wait_s):
                 with lock:
                     cancelled = gateway.cancel(req, time.monotonic())
@@ -248,6 +275,58 @@ def make_handler(gateway: Gateway, lock: threading.Lock,
                 self._reply(200, _result_doc(req))
                 return
             self._reply(504, _expiry_doc(gateway, req))
+
+        def _stream_reply(self, req: Request, chunks: queue.Queue,
+                          wait_s: float) -> None:
+            """Drain the request's token-chunk queue onto the wire as
+            NDJSON. HTTP/1.0 read-until-close framing (no
+            Content-Length): the status must be sent before the first
+            token exists, so the terminal verdict travels in the LAST
+            line, not the status code."""
+            self.send_response(200)
+            self.send_header("Content-Type", "application/x-ndjson")
+            self.send_header("Cache-Control", "no-cache")
+            self.send_header("Connection", "close")
+            self.end_headers()
+            self.close_connection = True
+            hard_stop = time.monotonic() + wait_s
+            settled = False
+            while True:
+                remaining = hard_stop - time.monotonic()
+                if remaining <= 0:
+                    break
+                try:
+                    item = chunks.get(timeout=min(1.0, remaining))
+                except queue.Empty:
+                    continue
+                if item is None:
+                    settled = True
+                    break
+                n_new, ids = item
+                line = json.dumps({"rid": req.rid, "n": n_new,
+                                   "tokens": ids}, sort_keys=True)
+                try:
+                    self.wfile.write(line.encode() + b"\n")
+                    self.wfile.flush()
+                except (BrokenPipeError, ConnectionResetError, OSError):
+                    # the client hung up mid-stream: stop generating
+                    # for nobody — cancel records a clean terminal
+                    with lock:
+                        gateway.cancel(req, time.monotonic())
+                    return
+            if not settled and req.done_at is None:
+                with lock:
+                    gateway.cancel(req, time.monotonic())
+            if req.done_at is not None:
+                tail = {**_result_doc(req), "done": True}
+            else:
+                tail = {**_expiry_doc(gateway, req), "done": True}
+            try:
+                self.wfile.write(
+                    json.dumps(tail, sort_keys=True).encode() + b"\n")
+                self.wfile.flush()
+            except (BrokenPipeError, ConnectionResetError, OSError):
+                pass
 
     return Handler
 

@@ -69,6 +69,23 @@ class TrafficModel:
     # the homogeneous pre-tenant stream, byte-identical.
     tenant: str | None = None
     priority: int = 0
+    # multi-turn sessions (serving/fleet.py): a `session_share`
+    # fraction of arrivals OPEN a conversation of `session_turns`
+    # total turns. Follow-up turns arrive after seeded think-time gaps
+    # (exponential around `session_think_s`) carrying the SAME
+    # `session_id` — the fleet pins the whole conversation to one
+    # replica — and a prompt that GROWS by the previous turn's
+    # generation plus a fresh user utterance (capped at
+    # `session_prompt_cap` so late turns stay servable). Every session
+    # turn is tagged prefix_id="sess-<id>" with prefix_len covering its
+    # whole prompt: turn k+1's prefill chain-matches the KV blocks turn
+    # k registered in the PrefixStore, so the conversation re-prefills
+    # only the new tail. 0.0 share = no sessions, streams byte-
+    # identical to the pre-session model (the draws below are gated).
+    session_share: float = 0.0
+    session_turns: int = 3
+    session_think_s: float = 10.0
+    session_prompt_cap: int = 256
 
     def rate(self, t: float) -> float:
         rate = self.base_rps * (
@@ -120,6 +137,44 @@ def generate_arrivals(model: TrafficModel, duration_s: float,
                   and rng.random() < model.shared_prefix_share)
         prefix_len = (min(int(model.shared_prefix_len), int(prompt) - 1)
                       if shared else 0)
+        # session draw gated like the prefix draw above: legacy models
+        # (share 0) consume not one extra random number
+        session = (model.session_share > 0 and model.session_turns > 1
+                   and rng.random() < model.session_share)
+        if session:
+            sid = f"{model.seed}-{rid}"
+            turn_t = t
+            turn_prompt = int(prompt)
+            for turn in range(int(model.session_turns)):
+                turn_new = int(rng.choices(
+                    model.new_tokens_choices,
+                    weights=model.new_tokens_weights)[0])
+                out.append(Request(
+                    rid=rid, prompt_len=turn_prompt,
+                    max_new_tokens=turn_new,
+                    arrival=turn_t, deadline_s=model.deadline_s,
+                    key=(f"{model.key_prefix}-{rid}"
+                         if model.key_prefix is not None else None),
+                    # the whole conversation-so-far IS the reusable
+                    # prefix: turn k+1 chain-matches the blocks turn
+                    # k's prefill registered under the session id
+                    prefix_len=turn_prompt,
+                    prefix_id=f"sess-{sid}",
+                    tenant=model.tenant,
+                    priority=int(model.priority),
+                    session_id=sid, turn=turn,
+                ))
+                rid += 1
+                turn_t += rng.expovariate(
+                    1.0 / max(0.001, model.session_think_s))
+                # next prompt = conversation so far + a fresh utterance
+                turn_prompt = min(
+                    int(model.session_prompt_cap),
+                    turn_prompt + turn_new + int(rng.choices(
+                        model.prompt_lens,
+                        weights=model.prompt_weights)[0]),
+                )
+            continue
         out.append(Request(
             rid=rid, prompt_len=int(prompt), max_new_tokens=int(new),
             arrival=t, deadline_s=model.deadline_s,
@@ -130,6 +185,10 @@ def generate_arrivals(model: TrafficModel, duration_s: float,
             tenant=model.tenant, priority=int(model.priority),
         ))
         rid += 1
+    # session follow-ups land out of arrival order; the drivers sort,
+    # but the pregenerated stream's own contract stays time-ordered
+    if model.session_share > 0:
+        out.sort(key=lambda r: r.arrival)
     return out
 
 

@@ -1776,6 +1776,196 @@ class ServeInvariantChecker:
             ]
         return []
 
+    # -- 17: fleet — merged journal shards + the lease protocol ------------
+
+    def check_fleet(self, journals: list, ledger_records: list = (),
+                    metrics: dict | None = None) -> list:
+        """The federated request plane's verdict (serving/fleet.py)
+        from the evidence that survives any one replica's death: ALL N
+        journal shards merged into global time order plus the
+        supervisor's event ledger. The single-gateway contract must
+        hold on the MERGED stream — conservation and exactly-once
+        across replica kills, lease churn, and journal adoption — and
+        three fleet-only invariants on top: no key open in two shards
+        at once (partition exclusivity), no slice ever under two live
+        leases (lease exclusivity), and every dispatch inside a lease
+        its replica actually held (the epoch fence, PROVEN from the
+        records instead of trusted)."""
+        journals = [list(j) for j in journals]
+        merged = reqlog_mod.merge_records(*journals)
+        violations: list = []
+        violations += self.check_conservation(merged)
+        violations += self.check_no_double_service(merged)
+        violations += self.check_deadline_honesty(merged)
+        violations += self.check_retry_after_honesty(merged)
+        violations += self.check_view_staleness(merged)
+        violations += self.check_partition_exclusivity(journals)
+        ledger_records = list(ledger_records)
+        if ledger_records:
+            violations += self.check_lease_exclusivity(ledger_records)
+            violations += self.check_cross_lease_dispatch(
+                merged, ledger_records)
+        if metrics is not None:
+            violations += self.check_metrics_consistency(merged,
+                                                         metrics)
+        return violations
+
+    def check_partition_exclusivity(self, journals: list) -> list:
+        """No idempotency key is OPEN (accepted, not yet terminal) in
+        two journal shards at once. The key-partition contract routes
+        every key to exactly one replica; the only legal ways a key's
+        records span shards are adoption (REQUEUED/terminal land in
+        the successor's shard — never a second ACCEPTED) and a fresh
+        acceptance epoch opened AFTER the original settled."""
+        tagged = []
+        for j, records in enumerate(journals):
+            for i, r in enumerate(records):
+                ts = r.get("ts")
+                tagged.append((ts if ts is not None else 0.0, j, i, r))
+        tagged.sort(key=lambda t: (t[0], t[1], t[2]))
+        violations: list = []
+        open_in: dict = {}  # key -> shard index of the open epoch
+        for ts, j, _i, r in tagged:
+            key = r.get("key")
+            if not key:
+                continue
+            kind = r.get("kind")
+            if kind == reqlog_mod.ACCEPTED:
+                prior = open_in.get(key)
+                if prior is not None and prior != j:
+                    violations.append(
+                        f"partition-exclusivity: key {key} accepted "
+                        f"into journal shard {j} at t={ts:.3f} while "
+                        f"still open in shard {prior} — two replicas "
+                        "owned one key"
+                    )
+                open_in[key] = j
+            elif kind in (reqlog_mod.COMPLETED, reqlog_mod.EXPIRED):
+                open_in.pop(key, None)
+        return violations
+
+    def check_lease_exclusivity(self, ledger_records: list) -> list:
+        """The ledger's lease history, replayed: at no instant do two
+        live leases cover one slice, and grant epochs are fleet-
+        monotonic (the fence a stale holder can never re-present). A
+        GRANT while the slice's previous lease is still live — not
+        lapsed by TTL, not closed by an EXPIRE/REVOKE record — is the
+        double-ownership the lease protocol exists to rule out."""
+        violations: list = []
+        live: dict = {}  # slice -> {replica, epoch, expires_at}
+        last_epoch = 0
+        for idx, r in enumerate(ledger_records):
+            kind = r.get("kind")
+            if kind not in (events_mod.LEASE_GRANT,
+                            events_mod.LEASE_RENEW,
+                            events_mod.LEASE_EXPIRE,
+                            events_mod.LEASE_REVOKE):
+                continue
+            index = int(r.get("slice", -1))
+            ts = float(r.get("ts", 0.0))
+            epoch = int(r.get("epoch", 0))
+            cur = live.get(index)
+            if kind == events_mod.LEASE_GRANT:
+                if epoch <= last_epoch:
+                    violations.append(
+                        f"lease-exclusivity: grant at record {idx} "
+                        f"(slice {index}) reuses epoch {epoch} — the "
+                        f"fence high-water mark was {last_epoch}"
+                    )
+                last_epoch = max(last_epoch, epoch)
+                # expiry is inclusive at the boundary (a lease is DEAD
+                # at exactly its expires_at), so a re-grant AT the old
+                # expiry is legal
+                if (cur is not None
+                        and ts < float(cur["expires_at"]) - self._EPS):
+                    violations.append(
+                        f"lease-exclusivity: slice {index} granted to "
+                        f"{r.get('replica')} (epoch {epoch}) at "
+                        f"t={ts:.3f} while epoch {cur['epoch']} "
+                        f"({cur['replica']}) was live until "
+                        f"t={float(cur['expires_at']):.3f} "
+                        f"(record {idx})"
+                    )
+                live[index] = {
+                    "replica": r.get("replica"), "epoch": epoch,
+                    "expires_at": float(r.get("expires_at", ts)),
+                }
+            elif kind == events_mod.LEASE_RENEW:
+                if cur is not None and cur["epoch"] == epoch:
+                    cur["expires_at"] = float(r.get("expires_at", ts))
+            elif cur is not None and cur["epoch"] == epoch:
+                live.pop(index, None)  # EXPIRE/REVOKE close the lease
+        return violations
+
+    def check_cross_lease_dispatch(self, merged: list,
+                                   ledger_records: list) -> list:
+        """Every DISPATCHED record must land inside a lease interval
+        its replica actually held on that slice — the epoch fence
+        cross-checked between the two flight recorders. An interval
+        opens at the GRANT and closes at the earliest of its (last
+        renewed) expiry or an EXPIRE/REVOKE record; a dispatch outside
+        it is a stale holder pulling from a slot pool it no longer
+        owns."""
+        intervals: dict = {}  # (slice, replica, epoch) -> [start, end]
+        lease_evidence = False
+        for r in ledger_records:
+            kind = r.get("kind")
+            if kind == events_mod.LEASE_GRANT:
+                lease_evidence = True
+                k = (int(r.get("slice", -1)), r.get("replica"),
+                     int(r.get("epoch", 0)))
+                ts = float(r.get("ts", 0.0))
+                intervals[k] = [ts, float(r.get("expires_at", ts))]
+            elif kind == events_mod.LEASE_RENEW:
+                k = (int(r.get("slice", -1)), r.get("replica"),
+                     int(r.get("epoch", 0)))
+                if k in intervals:
+                    intervals[k][1] = max(
+                        intervals[k][1],
+                        float(r.get("expires_at", intervals[k][1])))
+            elif kind in (events_mod.LEASE_EXPIRE,
+                          events_mod.LEASE_REVOKE):
+                k = (int(r.get("slice", -1)), r.get("replica"),
+                     int(r.get("epoch", 0)))
+                if k in intervals:
+                    closed = float(r.get("at", r.get("ts", 0.0)))
+                    intervals[k][1] = min(intervals[k][1], closed)
+        if not lease_evidence:
+            return []
+        violations: list = []
+        for idx, r in enumerate(merged):
+            if r.get("kind") != reqlog_mod.DISPATCHED:
+                continue
+            replica = r.get("replica")
+            if replica is None:
+                continue  # standalone-gateway records in a mixed log
+            index = r.get("slice")
+            epoch = r.get("lease_epoch")
+            ts = float(r.get("ts", 0.0))
+            if epoch is None:
+                violations.append(
+                    f"cross-lease-dispatch: replica {replica} "
+                    f"dispatched on slice {index} at t={ts:.3f} with "
+                    f"no lease epoch (record {idx}) while the ledger "
+                    "records leases"
+                )
+                continue
+            span = intervals.get((int(index), replica, int(epoch)))
+            if span is None:
+                violations.append(
+                    f"cross-lease-dispatch: dispatch at record {idx} "
+                    f"cites lease epoch {epoch} on slice {index} that "
+                    f"the ledger never granted to replica {replica}"
+                )
+            elif not (span[0] - 1e-6 <= ts <= span[1] + 1e-6):
+                violations.append(
+                    f"cross-lease-dispatch: replica {replica} "
+                    f"dispatched on slice {index} at t={ts:.3f}, "
+                    f"outside its epoch-{epoch} lease "
+                    f"[{span[0]:.3f}, {span[1]:.3f}] (record {idx})"
+                )
+        return violations
+
 
 def _static_status_doc(now: float, num_slices: int,
                        generation: int = 1) -> dict:
@@ -3268,3 +3458,381 @@ def run_coschedule_campaign(scenario: CoscheduleScenario,
     out = run_coschedule_drive(Path(workdir), **kwargs)
     out["events"] = [e["kind"] for e in scenario.events]
     return out
+
+
+# ------------------------------------------------- gateway fleet (sharding)
+
+
+@dataclasses.dataclass
+class FleetScenario:
+    """One seeded composition of fleet fault primitives over the
+    sharded request plane (serving/fleet.py). Every scenario keeps at
+    least one replica alive and every lease re-grantable, so 'merged
+    N-shard conservation with zero lost keys' is always the expected
+    verdict."""
+
+    seed: int
+    replicas: int
+    num_slices: int
+    duration_s: float
+    base_rps: float
+    deadline_s: float
+    session_share: float
+    events: list
+    drain_grace_s: float = 1800.0
+
+    @property
+    def fault_times(self) -> list:
+        return sorted(e.get("at", 0.0) for e in self.events)
+
+
+FLEET_PRIMITIVES = ("replica-kill", "replica-revive", "lease-expiry")
+
+
+def generate_fleet_scenario(seed: int, replicas: int = 4,
+                            num_slices: int = 6) -> FleetScenario:
+    """Deterministic fleet scenario from `seed`: keyed + deadlined
+    open-loop traffic (a seeded share of it multi-turn sessions)
+    across N gateway replicas, one anchor replica-kill, and up to two
+    extra primitives — a revive of the victim (it rejoins the grant
+    rotation as a NEW process) and forced lease expiries (a holder
+    whose renewals stopped landing: the epoch fence must refuse its
+    residual pulls until the re-grant)."""
+    rng = random.Random(int(seed))
+    events: list = []
+    anchor_at = 40.0 + 10.0 * rng.randrange(0, 5)
+    if replicas > 1:
+        victim = rng.randrange(replicas)
+        events.append({"kind": "replica-kill",
+                       "replica": f"g{victim}", "at": anchor_at})
+        if rng.random() < 0.5:
+            events.append({
+                "kind": "replica-revive", "replica": f"g{victim}",
+                "at": anchor_at + 30.0 * (1 + rng.randrange(0, 3)),
+            })
+    for _ in range(rng.randrange(0, 3)):
+        events.append({
+            "kind": "lease-expiry",
+            "slice": rng.randrange(num_slices),
+            "at": 30.0 + 15.0 * rng.randrange(0, 10),
+        })
+    return FleetScenario(
+        seed=int(seed), replicas=int(replicas),
+        num_slices=int(num_slices),
+        duration_s=180.0 + 60.0 * rng.randrange(0, 2),
+        base_rps=3.0 + 1.0 * rng.randrange(0, 3),
+        deadline_s=90.0 + 30.0 * rng.randrange(0, 2),
+        session_share=(0.25 if rng.random() < 0.5 else 0.0),
+        events=events,
+    )
+
+
+def run_fleet_campaign(scenario: FleetScenario, workdir: Path,
+                       fleet_policy=None, gw_policy=None) -> dict:
+    """Drive one seeded fleet campaign, fully deterministic: ONE actor
+    on a SimClock — no supervisor co-actor, because the lease
+    protocol (not healing) is under test, so the replicas run with no
+    health source and serve on every slice they hold a lease for. A
+    replica kill drops its gateway's memory; the next fleet tick
+    revokes its leases, reassigns its key-partitions, and has the
+    successor adopt the dead journal shard. Afterwards `check_fleet`
+    folds ALL N shards plus the lease ledger; the campaign verdict
+    carries its violations."""
+    from tritonk8ssupervisor_tpu.serving import fleet as fleet_mod
+    from tritonk8ssupervisor_tpu.serving import gateway as gw_mod
+    from tritonk8ssupervisor_tpu.serving import traffic as traffic_mod
+
+    root = Path(workdir)
+    root.mkdir(parents=True, exist_ok=True)
+    clock = SimClock()
+    paths = RunPaths(root)
+    ledger = events_mod.EventLedger(paths.events, clock=clock.time,
+                                    echo=lambda line: None, fsync=False)
+    gw_policy = gw_policy or _fleet_gw_policy(scenario.deadline_s)
+    fleet_policy = fleet_policy or fleet_mod.FleetPolicy(
+        replicas=scenario.replicas,
+    )
+    fleet = fleet_mod.GatewayFleet(
+        _fleet_engines(scenario.num_slices, gw_policy), paths, ledger,
+        policy=fleet_policy, gateway_policy=gw_policy,
+        clock=clock.time, fsync=False,
+    )
+    model = traffic_mod.TrafficModel(
+        base_rps=scenario.base_rps, diurnal_amplitude=0.2,
+        diurnal_period_s=600.0, seed=scenario.seed,
+        deadline_s=scenario.deadline_s,
+        key_prefix=f"f{scenario.seed}",
+        session_share=scenario.session_share,
+        session_turns=3, session_think_s=5.0,
+    )
+    arrivals = traffic_mod.generate_arrivals(model, scenario.duration_s)
+    world_events = []
+    kills = 0
+    for event in scenario.events:
+        kind = event["kind"]
+        if kind == "replica-kill":
+            kills += 1
+            world_events.append(traffic_mod.WorldEvent(
+                at=float(event["at"]),
+                fn=_fleet_kill_fn(event["replica"])))
+        elif kind == "replica-revive":
+            world_events.append(traffic_mod.WorldEvent(
+                at=float(event["at"]),
+                fn=_fleet_revive_fn(event["replica"])))
+        elif kind == "lease-expiry":
+            world_events.append(traffic_mod.WorldEvent(
+                at=float(event["at"]),
+                fn=_fleet_expire_fn(event["slice"], event["at"])))
+
+    clock.launch()
+    clock.begin()
+    try:
+        report = fleet_mod.drive_fleet(
+            fleet, arrivals, clock, scenario.duration_s,
+            events=tuple(world_events),
+            drain_grace_s=scenario.drain_grace_s,
+        )
+    finally:
+        clock.release()
+
+    journals = [fleet.reqlogs[rid].replay()
+                for rid in fleet.replica_ids]
+    led_records = ledger.replay()
+    checker = ServeInvariantChecker(gw_policy)
+    violations = checker.check_fleet(journals, led_records)
+    if not report["quiescent"]:
+        violations.append(
+            f"convergence: fleet not quiescent by "
+            f"t={scenario.duration_s + scenario.drain_grace_s:.0f}s "
+            f"(seed {scenario.seed})"
+        )
+    view = reqlog_mod.fold(reqlog_mod.merge_records(*journals))
+    fenced = sum(
+        fleet.gateways[rid]._total(fleet.gateways[rid]._c_lease_fenced)
+        for rid in fleet.replica_ids
+    )
+    return {
+        "seed": scenario.seed,
+        "events": [e["kind"] for e in scenario.events],
+        "replicas": scenario.replicas,
+        "num_slices": scenario.num_slices,
+        "offered": report["offered"],
+        "accepted": sum(1 for kv in view.keys.values()
+                        if kv.accepts > 0),
+        "completed": sum(kv.completions for kv in view.keys.values()),
+        "expired": sum(kv.expiries for kv in view.keys.values()),
+        "requeues": sum(kv.requeues for kv in view.keys.values()),
+        "sheds": view.sheds,
+        "replica_kills": kills,
+        "reassignments": len(fleet.reassignments),
+        "lease_grants": sum(
+            1 for r in led_records
+            if r.get("kind") == events_mod.LEASE_GRANT),
+        "lease_expiries": sum(
+            1 for r in led_records
+            if r.get("kind") == events_mod.LEASE_EXPIRE),
+        "lease_revokes": sum(
+            1 for r in led_records
+            if r.get("kind") == events_mod.LEASE_REVOKE),
+        "lease_fenced_pulls": int(fenced),
+        "violations": violations,
+        "converged": report["quiescent"],
+        "end_s": clock.time(),
+    }
+
+
+def _fleet_gw_policy(deadline_s: float):
+    from tritonk8ssupervisor_tpu.serving import gateway as gw_mod
+
+    return gw_mod.GatewayPolicy(
+        max_seq_len=512, slots_per_slice=4, prefill_chunk=64,
+        queue_budget=64, bucket_bounds=(64, 128, 256),
+        poll_every_s=2.0, default_deadline_s=deadline_s,
+    )
+
+
+def _fleet_engines(num_slices: int, gw_policy) -> dict:
+    from tritonk8ssupervisor_tpu.serving import gateway as gw_mod
+
+    cost = gw_mod.DecodeCostModel()
+    return {
+        i: gw_mod.ModeledEngine(slots=gw_policy.slots_per_slice,
+                                prefill_chunk=gw_policy.prefill_chunk,
+                                cost=cost)
+        for i in range(num_slices)
+    }
+
+
+def _fleet_kill_fn(rid: str):
+    return lambda fleet: fleet.kill(rid)
+
+
+def _fleet_revive_fn(rid: str):
+    return lambda fleet: fleet.revive(rid)
+
+
+def _fleet_expire_fn(index: int, at: float):
+    def force(fleet) -> None:
+        # the missed-renewal fault: the WORKING COPY of the lease
+        # lapses NOW (the fence refuses the holder's next pull
+        # immediately); the next tick's sweep writes the LEASE_EXPIRE
+        # and re-grants. Mutating the table and not the ledger is the
+        # point — the renewals simply stopped landing.
+        entry = fleet.leases.table.get(int(index))
+        if entry is not None:
+            entry["expires_at"] = float(at)
+    return force
+
+
+def run_fleet_kill_drill(
+    workdir: Path,
+    replicas: int = 4,
+    num_slices: int = 4,
+    # off the tick grid on purpose: a kill AT a tick boundary would be
+    # reaped the same instant and report a degenerate 0s MTTR
+    kill_at: float = 61.0,
+    duration_s: float = 180.0,
+    base_rps: float = 4.0,
+    deadline_s: float = 120.0,
+    seed: int = 23,
+    resubmit: int = 3,
+) -> dict:
+    """THE fleet kill acceptance drill (bench_provision.py --fleet),
+    fully deterministic: at `kill_at` one replica dies mid-dispatch.
+    Measured: its key-partitions reassigned to a successor, requests
+    redone from the adopted journal shard vs LOST across the merged
+    N-shard fold (must be 0), pre-kill completions still answerable
+    as duplicates AT THE SUCCESSOR, and the kill-to-reassignment MTTR
+    (bounded by one fleet tick plus the adoption)."""
+    from tritonk8ssupervisor_tpu.serving import fleet as fleet_mod
+    from tritonk8ssupervisor_tpu.serving import gateway as gw_mod
+    from tritonk8ssupervisor_tpu.serving import traffic as traffic_mod
+
+    root = Path(workdir)
+    root.mkdir(parents=True, exist_ok=True)
+    clock = SimClock()
+    paths = RunPaths(root)
+    ledger = events_mod.EventLedger(paths.events, clock=clock.time,
+                                    echo=lambda line: None, fsync=False)
+    gw_policy = _fleet_gw_policy(deadline_s)
+    fleet = fleet_mod.GatewayFleet(
+        _fleet_engines(num_slices, gw_policy), paths, ledger,
+        policy=fleet_mod.FleetPolicy(replicas=replicas),
+        gateway_policy=gw_policy, clock=clock.time, fsync=False,
+    )
+    model = traffic_mod.TrafficModel(
+        base_rps=base_rps, diurnal_amplitude=0.0, seed=seed,
+        deadline_s=deadline_s, key_prefix="fkill",
+    )
+    arrivals = traffic_mod.generate_arrivals(model, duration_s)
+    victim = fleet.replica_ids[0]
+    drill: dict = {"pre_kill_done": [], "redone_keys": [],
+                   "resubmitted": 0, "replays_ok": 0,
+                   "inflight_at_kill": 0, "queued_at_kill": 0}
+
+    def kill_fn(fleet) -> None:
+        gw = fleet.gateways[victim]
+        drill["inflight_at_kill"] = sum(
+            len(w.inflight) for w in gw.workers.values())
+        drill["queued_at_kill"] = gw.queue_depth()
+        pre = reqlog_mod.fold(fleet.reqlogs[victim].replay())
+        drill["pre_kill_done"] = [
+            kv.key for kv in sorted(pre.keys.values(),
+                                    key=lambda kv: kv.key)
+            if kv.state == "completed"
+        ]
+        # the keys mid-flight in the dead shard — what adoption owes a
+        # terminal in the SUCCESSOR's shard
+        drill["redone_keys"] = [kv.key for kv in pre.incomplete()]
+        fleet.kill(victim, clock.time())
+
+    def resubmit_fn(fleet) -> None:
+        # duplicates of the DEAD replica's completions, offered after
+        # the reassignment window: they route to the successor, whose
+        # adopted journal must answer them without regenerating
+        now = clock.time()
+        for n, key in enumerate(drill["pre_kill_done"][:resubmit]):
+            drill["resubmitted"] += 1
+            duplicate = gw_mod.Request(
+                rid=900000 + n, prompt_len=8, max_new_tokens=4,
+                key=key,
+            )
+            admission = fleet.submit(duplicate, now)
+            if (admission.ok and admission.reason == gw_mod.REPLAYED
+                    and admission.result is not None):
+                drill["replays_ok"] += 1
+
+    world_events = (
+        traffic_mod.WorldEvent(at=kill_at, fn=kill_fn),
+        traffic_mod.WorldEvent(
+            at=kill_at + 5.0 * fleet.policy.tick_every_s,
+            fn=resubmit_fn),
+    )
+    clock.launch()
+    clock.begin()
+    try:
+        report = fleet_mod.drive_fleet(
+            fleet, arrivals, clock, duration_s, events=world_events)
+    finally:
+        clock.release()
+
+    journals = [fleet.reqlogs[rid].replay()
+                for rid in fleet.replica_ids]
+    merged = reqlog_mod.merge_records(*journals)
+    led_records = ledger.replay()
+    view = reqlog_mod.fold(merged)
+    lost = [kv.key for kv in view.incomplete()]
+    checker = ServeInvariantChecker(gw_policy)
+    violations = checker.check_fleet(journals, led_records)
+    if lost:
+        violations.append(
+            f"fleet-kill: {len(lost)} accepted request(s) lost across "
+            f"the replica death: {lost[:5]}"
+        )
+    audit = fleet.reassignments[0] if fleet.reassignments else None
+    if audit is None:
+        violations.append(
+            "fleet-kill: the dead replica's partitions were never "
+            "reassigned"
+        )
+    # kill -> partitions reassigned + shard adopted (the window during
+    # which the dead partitions 429); then the first REDONE key's
+    # completion closes the client-visible gap
+    mttr = (round(float(audit["at"]) - kill_at, 3)
+            if audit is not None else None)
+    redone_done = [
+        r.get("ts") for r in merged
+        if r.get("kind") == reqlog_mod.COMPLETED
+        and r.get("key") in set(drill["redone_keys"])
+        and r.get("ts", 0.0) >= kill_at
+    ]
+    return {
+        "replicas": replicas,
+        "num_slices": num_slices,
+        "kill_at_s": kill_at,
+        "victim": victim,
+        "duration_s": duration_s,
+        "offered": report["offered"],
+        "accepted": sum(1 for kv in view.keys.values()
+                        if kv.accepts > 0),
+        "completed": sum(kv.completions for kv in view.keys.values()),
+        "expired": sum(kv.expiries for kv in view.keys.values()),
+        "inflight_at_kill": drill["inflight_at_kill"],
+        "queued_at_kill": drill["queued_at_kill"],
+        "partitions_reassigned": (int(audit["partitions"])
+                                  if audit is not None else 0),
+        "successor": audit["to"] if audit is not None else None,
+        "requests_redone": (int(audit["redone"])
+                            if audit is not None else 0),
+        "redone_keys": drill["redone_keys"],
+        "requests_lost": len(lost),
+        "duplicates_resubmitted": drill["resubmitted"],
+        "duplicates_replayed_from_journal": drill["replays_ok"],
+        "kill_to_reassign_s": mttr,
+        "redone_first_completion_s": (
+            round(min(redone_done) - kill_at, 3)
+            if redone_done else None),
+        "dead_routed_429s": fleet.dead_routed,
+        "violations": violations,
+        "converged": report["quiescent"],
+    }
